@@ -1,0 +1,50 @@
+"""Test harness: an 8-device virtual CPU mesh replacing real TPU chips.
+
+This is the framework's "fake backend" (SURVEY §4): tests exercise the real
+SPMD train step, shardings and collectives on forced host devices, so the
+same code compiles unchanged on a TPU pod.
+
+The container's sitecustomize registers the remote TPU backend at interpreter
+startup (before pytest's conftest runs), so setting env vars here is too late
+— if the process isn't already on the CPU platform we re-exec pytest once
+with the corrected environment.
+"""
+
+import os
+import sys
+
+_WANT = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",  # disables the remote-TPU site hook
+    "XLA_FLAGS": (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip(),
+}
+
+if os.environ.get("JAX_PLATFORMS") != "cpu" and os.environ.get(
+    "TDDL_NO_REEXEC"
+) != "1":
+    env = dict(os.environ)
+    env.update(_WANT)
+    env["TDDL_NO_REEXEC"] = "1"  # belt-and-braces against exec loops
+    os.execve(
+        sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env
+    )
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = _WANT["XLA_FLAGS"]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return devices[:8]

@@ -1,0 +1,227 @@
+"""Golden tests for the trust subsystem against the reference math
+(SURVEY §2.2; trust_manager.py:92-181)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.trust import (
+    NodeStatus,
+    TrustManager,
+    adaptive_threshold,
+    can_assign_task,
+    contribution_weights,
+    init_trust_state,
+    initiate_recovery,
+    instantaneous_trust,
+    mark_compromised,
+    next_status,
+    predict_reliability,
+    select_best_nodes,
+    system_trust,
+    update_trust,
+)
+
+
+def test_instantaneous_trust_golden():
+    # components: (1-0.2)*0.3 + 0.9*0.3 + (1-2/10)*0.1 + 0.5*0.1 + (1-0.1)*0.15 + 1.0*0.05
+    metrics = jnp.array([[0.2, 0.9, 2.0, 0.5, 0.1, 1.0]])
+    expected = 0.8 * 0.3 + 0.9 * 0.3 + 0.8 * 0.1 + 0.5 * 0.1 + 0.9 * 0.15 + 1.0 * 0.05
+    got = float(instantaneous_trust(metrics)[0])
+    assert got == pytest.approx(expected, abs=1e-6)
+
+
+def test_instantaneous_trust_clipping():
+    # Extreme bad metrics floor at 0; perfect metrics give exactly 1.
+    bad = jnp.array([[5.0, 0.0, 100.0, 0.0, 5.0, 0.0]])
+    good = jnp.array([[0.0, 1.0, 0.0, 1.0, 0.0, 1.0]])
+    assert float(instantaneous_trust(bad)[0]) == pytest.approx(0.0)
+    assert float(instantaneous_trust(good)[0]) == pytest.approx(1.0)
+
+
+def test_ema_decay_blend():
+    # final = (1-alpha)*old*exp(-decay*dt) + alpha*new (trust_manager.py:112-119)
+    state = init_trust_state(2, now=0.0)
+    dev = jnp.array([0.0, 0.0])
+    cons = jnp.array([1.0, 1.0])
+    new_state = update_trust(state, dev, cons, now=10.0)
+    # metrics -> components: 1.0*0.3 + 1.0*0.3 + 1.0*0.1 + 0*0.1 + 1*0.15 + 1*0.05 = 0.9
+    expected_inst = 0.9
+    expected = 0.9 * 1.0 * math.exp(-0.01 * 10.0) + 0.1 * expected_inst
+    np.testing.assert_allclose(np.asarray(new_state.scores),
+                               np.full(2, expected), rtol=1e-6)
+    assert int(new_state.update_count[0]) == 1
+    assert float(new_state.last_updated[0]) == 10.0
+
+
+def test_update_mask_keeps_nodes_untouched():
+    state = init_trust_state(4, now=0.0)
+    mask = jnp.array([True, False, True, False])
+    new_state = update_trust(
+        state,
+        jnp.full((4,), 1.0),  # worst deviation
+        jnp.zeros((4,)),
+        now=1.0,
+        update_mask=mask,
+    )
+    s = np.asarray(new_state.scores)
+    assert s[1] == pytest.approx(1.0)
+    assert s[3] == pytest.approx(1.0)
+    assert s[0] < 1.0 and s[2] < 1.0
+    assert int(new_state.update_count[1]) == 0
+
+
+@pytest.mark.parametrize(
+    "current,trust,expected",
+    [
+        # trust_manager.py:162-181 branch order
+        (NodeStatus.TRUSTED, 0.2, NodeStatus.COMPROMISED),
+        (NodeStatus.TRUSTED, 0.5, NodeStatus.SUSPICIOUS),
+        (NodeStatus.COMPROMISED, 0.85, NodeStatus.RECOVERING),
+        (NodeStatus.RECOVERING, 0.95, NodeStatus.TRUSTED),
+        (NodeStatus.SUSPICIOUS, 0.75, NodeStatus.TRUSTED),
+        # Reference quirk preserved: COMPROMISED with trust in [thr, 0.8]
+        # falls through to TRUSTED via the >= threshold branch.
+        (NodeStatus.COMPROMISED, 0.75, NodeStatus.TRUSTED),
+        (NodeStatus.RECOVERING, 0.85, NodeStatus.TRUSTED),
+    ],
+)
+def test_status_machine(current, trust, expected):
+    status = jnp.array([int(current)], jnp.int32)
+    out = next_status(status, jnp.array([trust]), jnp.asarray(0.7))
+    assert NodeStatus(int(out[0])) == expected
+
+
+def test_mark_compromised_and_recovery():
+    state = init_trust_state(4)
+    state = mark_compromised(state, jnp.array([False, True, False, True]))
+    assert float(state.scores[1]) == pytest.approx(0.1)
+    assert NodeStatus(int(state.status[1])) == NodeStatus.COMPROMISED
+    assert int(state.attack_count[1]) == 1
+    assert float(state.scores[0]) == pytest.approx(1.0)
+    # can_assign excludes compromised
+    np.testing.assert_array_equal(
+        np.asarray(can_assign_task(state)), [True, False, True, False]
+    )
+    state = initiate_recovery(state, jnp.array([False, True, False, False]))
+    assert NodeStatus(int(state.status[1])) == NodeStatus.RECOVERING
+    assert float(state.recovery_rate[1]) == pytest.approx(0.02)
+    assert NodeStatus(int(state.status[3])) == NodeStatus.COMPROMISED
+
+
+def test_contribution_weights_gate():
+    state = init_trust_state(4)
+    state = mark_compromised(state, jnp.array([False, True, False, False]))
+    verdict_ok = jnp.array([True, True, False, True])
+    w = np.asarray(contribution_weights(state, verdict_ok))
+    np.testing.assert_array_equal(w, [1.0, 0.0, 0.0, 1.0])
+
+
+def test_system_trust_self_weighted():
+    state = init_trust_state(3)
+    state = state._replace(scores=jnp.array([1.0, 0.5, 0.1]))
+    # weighted avg with weights = values: sum(v^2)/sum(v)
+    expected = (1.0 + 0.25 + 0.01) / 1.6
+    assert float(system_trust(state)) == pytest.approx(expected, rel=1e-6)
+
+
+def test_select_best_nodes():
+    state = init_trust_state(4)
+    state = state._replace(scores=jnp.array([0.9, 0.95, 0.8, 0.99]))
+    state = mark_compromised(state, jnp.array([False, False, False, True]))
+    idx = np.asarray(select_best_nodes(state, 2))
+    np.testing.assert_array_equal(idx, [1, 0])
+
+
+def test_adaptive_threshold():
+    state = init_trust_state(4)
+    low = state._replace(scores=jnp.full((4,), 0.4))
+    assert float(adaptive_threshold(low).threshold) == pytest.approx(0.3)
+    high = state._replace(scores=jnp.full((4,), 0.95))
+    assert float(adaptive_threshold(high).threshold) == pytest.approx(0.8, abs=1e-6)
+    mid = state._replace(scores=jnp.full((4,), 0.7), threshold=jnp.asarray(0.6))
+    assert float(adaptive_threshold(mid).threshold) == pytest.approx(
+        0.6 + 0.01 * 0.1, abs=1e-6
+    )
+
+
+def test_predict_reliability_trend():
+    # Linearly decaying history: slope extrapolation matches np.polyfit.
+    window = 10
+    hist = np.zeros((2, window), np.float32)
+    series = np.linspace(1.0, 0.55, window)
+    hist[0] = series
+    hist[1, -3:] = 0.8  # only 3 valid entries -> returns latest
+    counts = jnp.array([10, 3])
+    pred = np.asarray(predict_reliability(jnp.array(hist), counts, horizon=10))
+    coeffs = np.polyfit(np.arange(window), series, 1)
+    expected = np.clip(coeffs[0] * (window + 10) + coeffs[1], 0, 1)
+    assert pred[0] == pytest.approx(expected, abs=1e-4)
+    assert pred[1] == pytest.approx(0.8, abs=1e-6)
+
+
+def test_update_is_jittable():
+    state = init_trust_state(8)
+
+    @jax.jit
+    def step(s, dev, cons, now):
+        return update_trust(s, dev, cons, now)
+
+    out = step(state, jnp.zeros(8), jnp.ones(8), 1.0)
+    assert out.scores.shape == (8,)
+
+
+# ---------------------------------------------------------------------------
+# Host TrustManager parity
+# ---------------------------------------------------------------------------
+
+
+def test_manager_update_and_status():
+    tm = TrustManager(num_nodes=4)
+    for _ in range(60):
+        tm.update_trust_score(1, output_deviation=1.0, gradient_consistency=0.0,
+                              error_rate=1.0, uptime=0.0)
+    assert tm.get_trust_score(1) < 0.3
+    assert tm.get_node_status(1) == NodeStatus.COMPROMISED
+    assert 1 in tm.get_compromised_nodes()
+    assert not tm.can_assign_task(1)
+    assert tm.can_assign_task(0)
+
+
+def test_manager_mark_compromised_records_prior_trust():
+    tm = TrustManager(num_nodes=2)
+    tm.mark_compromised(0, "gradient_poisoning")
+    record = tm.attack_history[0][-1]
+    # SURVEY §7.5: previous_trust must be the value before the overwrite.
+    assert record["previous_trust"] == pytest.approx(1.0)
+    assert tm.get_trust_score(0) == pytest.approx(0.1)
+
+
+def test_manager_statistics_and_export(tmp_path):
+    tm = TrustManager(num_nodes=3)
+    tm.update_trust_score(0, 0.1, 0.9)
+    tm.mark_compromised(2)
+    stats = tm.get_trust_statistics()
+    assert stats["node_status_counts"]["compromised"] == 1
+    assert stats["total_attacks"] == 1
+    path = tmp_path / "trust.json"
+    tm.export_trust_data(str(path))
+    import json
+
+    data = json.loads(path.read_text())
+    assert data["node_status"]["2"] == "compromised"
+    assert "statistics" in data
+
+
+def test_manager_device_round_trip():
+    tm = TrustManager(num_nodes=4)
+    state = tm.to_device_state()
+    state = mark_compromised(state, jnp.array([False, True, False, False]))
+    state = update_trust(state, jnp.zeros(4), jnp.ones(4), now=1.0)
+    tm.sync_from_device(state)
+    assert tm.get_trust_score(1) < 0.3
+    assert tm.get_node_status(1) == NodeStatus.COMPROMISED
+    assert len(tm.get_node_history(1)) == 1

@@ -1,0 +1,56 @@
+"""trustworthy_dl_tpu — TPU-native trustworthy distributed deep learning.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+Tanmoy058/Trustworthy-Distributed-Deep-Learning (reference mounted read-only
+at /root/reference): trust-scored nodes, in-step statistical attack detection,
+gradient verification, elastic task reassignment — all executed as SPMD
+programs over a `jax.sharding.Mesh` instead of the reference's NCCL/torch
+process groups (reference: distributed_trainer.py:99-114).
+
+The reference's "node" is re-interpreted as a mesh coordinate (a device or a
+device group along a mesh axis).  Detection and trust updates run *inside* the
+compiled train step as XLA reductions; gradient aggregation is a trust-gated
+weighted psum, so Byzantine mitigation costs no host round-trips.
+"""
+
+__version__ = "0.1.0"
+
+# Public API is re-exported lazily so importing the package stays cheap (no
+# jax tracing at import) and subpackages have no import-order constraints.
+_EXPORTS = {
+    "AttackConfig": "trustworthy_dl_tpu.core.config",
+    "ExperimentConfig": "trustworthy_dl_tpu.core.config",
+    "NodeConfig": "trustworthy_dl_tpu.core.config",
+    "TrainingConfig": "trustworthy_dl_tpu.core.config",
+    "load_config": "trustworthy_dl_tpu.core.config",
+    "TrustManager": "trustworthy_dl_tpu.trust.manager",
+    "NodeStatus": "trustworthy_dl_tpu.trust.state",
+    "TrustState": "trustworthy_dl_tpu.trust.state",
+    "AttackDetector": "trustworthy_dl_tpu.detect.detector",
+    "AttackType": "trustworthy_dl_tpu.detect.detector",
+    "AttackDetectionResult": "trustworthy_dl_tpu.detect.detector",
+    "GradientVerifier": "trustworthy_dl_tpu.detect.verifier",
+    "DistributedTrainer": "trustworthy_dl_tpu.engine.trainer",
+    "TrainingState": "trustworthy_dl_tpu.engine.trainer",
+    "ModelFactory": "trustworthy_dl_tpu.models.factory",
+    "get_dataloader": "trustworthy_dl_tpu.data.loader",
+    "MetricsCollector": "trustworthy_dl_tpu.utils.metrics",
+    "NodeMonitor": "trustworthy_dl_tpu.utils.monitor",
+    "AdversarialAttacker": "trustworthy_dl_tpu.attacks.adversarial",
+    "ExperimentRunner": "trustworthy_dl_tpu.experiments.runner",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module_path = _EXPORTS.get(name)
+    if module_path is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module_path), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,29 @@
+from trustworthy_dl_tpu.core.config import (
+    AttackConfig,
+    ExperimentConfig,
+    NodeConfig,
+    TrainingConfig,
+    load_config,
+)
+from trustworthy_dl_tpu.core.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    STAGE_AXIS,
+    build_mesh,
+    node_axis_for,
+)
+
+__all__ = [
+    "AttackConfig",
+    "DATA_AXIS",
+    "ExperimentConfig",
+    "MODEL_AXIS",
+    "NodeConfig",
+    "SEQ_AXIS",
+    "STAGE_AXIS",
+    "TrainingConfig",
+    "build_mesh",
+    "load_config",
+    "node_axis_for",
+]

@@ -1,0 +1,242 @@
+"""Configuration tree for the TPU-native framework.
+
+Mirrors the reference's three config surfaces and unifies them (the reference
+never unified its own: dataclasses at distributed_trainer.py:48-61 and
+experiment_runner.py:31-46, a YAML schema documented only in README.md:111-132,
+and an argparse CLI whose --config flag was parsed but ignored,
+experiment_runner.py:605,613-623).  Here one dataclass tree backs all three,
+and the YAML loader honours the README schema for real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+# Parallelism strategy names accepted by ``TrainingConfig.parallelism``.
+#  - "data":     node axis = data shards, trust-gated gradient psum
+#  - "model":    node axis = pipeline stages (the reference's only real
+#                strategy, distributed_trainer.py:124-135)
+#  - "tensor":   intra-layer sharding over a 'model' mesh axis (GSPMD)
+#  - "sequence": sequence-dim sharding (Ulysses all_to_all / ring attention)
+#  - "hybrid":   explicit mesh_shape dict combining several axes
+PARALLELISM_MODES = ("data", "model", "tensor", "sequence", "hybrid")
+
+
+@dataclass
+class NodeConfig:
+    """Per-node configuration (reference: distributed_trainer.py:37-46).
+
+    On TPU a "node" is a mesh coordinate; ``device_id`` generalises the
+    reference's ``gpu_id``.
+    """
+
+    node_id: int
+    rank: int
+    world_size: int
+    device_id: int = 0
+    model_partition: str = ""
+    trust_score: float = 1.0
+    status: str = "trusted"
+
+    # Back-compat alias for the reference's field name.
+    @property
+    def gpu_id(self) -> int:
+        return self.device_id
+
+
+@dataclass
+class TrainingConfig:
+    """Training configuration (reference: distributed_trainer.py:48-61,
+    extended with the TPU execution knobs the reference never had)."""
+
+    model_name: str = "gpt2"
+    dataset_name: str = "openwebtext"
+    batch_size: int = 32
+    learning_rate: float = 5e-5
+    num_epochs: int = 10
+    num_nodes: int = 4
+    trust_threshold: float = 0.7
+    attack_detection_enabled: bool = True
+    gradient_verification_enabled: bool = True
+    checkpoint_interval: int = 100
+    max_reassignment_attempts: int = 3
+
+    # ---- TPU-native execution knobs (no reference equivalent) ----
+    parallelism: str = "data"          # one of PARALLELISM_MODES
+    mesh_shape: Optional[Dict[str, int]] = None  # for "hybrid"
+    num_microbatches: int = 4          # pipeline schedule depth
+    dtype: str = "bfloat16"            # compute dtype (params stay f32)
+    seed: int = 0
+    remat: bool = False                # jax.checkpoint the blocks
+    # Trust/detector timing: the reference decays trust by wall-clock seconds
+    # (trust_manager.py:113-114); inside a compiled step we use
+    # step_count * time_per_step as the clock so the math stays pure.
+    time_per_step: float = 1.0
+    # Exact order statistics (median/percentiles) cost a sort on TPU
+    # (attack_detector.py:190-196 computes them on host numpy); disable to
+    # trade fidelity for speed — see SURVEY §7.4(2).
+    exact_order_stats: bool = True
+    detector_history: int = 1000       # rolling window (attack_detector.py:44)
+    detector_warmup: int = 10          # min history before verdicts (:91,:126)
+    checkpoint_dir: str = "checkpoints"
+    # Optimizer
+    optimizer: str = "adamw"
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 0.0        # 0 disables
+    # Trust dynamics (trust_manager.py:31-32,49-54; README.md:72-74 uses
+    # 0.1/0.05 — we expose both, defaulting to the code's values per SURVEY
+    # §7.5).
+    initial_trust: float = 1.0
+    trust_decay_rate: float = 0.01
+    trust_recovery_rate: float = 0.005
+    trust_alpha: float = 0.1           # EMA learning rate (trust_manager.py:117)
+
+    def __post_init__(self) -> None:
+        if self.parallelism not in PARALLELISM_MODES:
+            raise ValueError(
+                f"parallelism must be one of {PARALLELISM_MODES}, "
+                f"got {self.parallelism!r}"
+            )
+
+
+@dataclass
+class ExperimentConfig:
+    """Experiment configuration (reference: experiment_runner.py:31-46)."""
+
+    experiment_name: str
+    model_name: str = "gpt2"
+    dataset_name: str = "openwebtext"
+    num_nodes: int = 4
+    num_epochs: int = 10
+    batch_size: int = 32
+    learning_rate: float = 5e-5
+    attack_enabled: bool = True
+    attack_start_epoch: int = 2
+    attack_intensity: float = 0.5
+    trust_threshold: float = 0.7
+    save_interval: int = 100
+    output_dir: str = "results"
+    # TPU extensions
+    parallelism: str = "data"
+    steps_per_epoch: int = 50
+    seed: int = 0
+
+    def to_training_config(self) -> TrainingConfig:
+        """Build the trainer config the way the reference runner does
+        (experiment_runner.py:66-75)."""
+        return TrainingConfig(
+            model_name=self.model_name,
+            dataset_name=self.dataset_name,
+            batch_size=self.batch_size,
+            learning_rate=self.learning_rate,
+            num_epochs=self.num_epochs,
+            num_nodes=self.num_nodes,
+            trust_threshold=self.trust_threshold,
+            parallelism=self.parallelism,
+            seed=self.seed,
+        )
+
+
+@dataclass
+class AttackConfig:
+    """Adversarial attack configuration (implied module; call sites at
+    experiment_runner.py:90-97)."""
+
+    attack_types: List[str] = field(
+        default_factory=lambda: ["gradient_poisoning", "data_poisoning"]
+    )
+    target_nodes: List[int] = field(default_factory=lambda: [1, 3])
+    intensity: float = 0.5
+    start_step: int = 200
+    seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# YAML loading — honours the README schema (README.md:111-132):
+#   model: {name, size}
+#   training: {batch_size, learning_rate, num_epochs}
+#   distributed: {num_nodes, parallelism}
+#   security: {trust_threshold, attack_detection, gradient_verification}
+# Flat keys matching TrainingConfig fields are also accepted, and flag-style
+# overrides win over file values (fixing the reference's ignored --config).
+# ---------------------------------------------------------------------------
+
+_MODEL_SIZE_SUFFIX = {"small": "", "medium": "-medium", "large": "-large", "xl": "-xl"}
+
+
+def _config_from_mapping(raw: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten the README-schema nested mapping into TrainingConfig kwargs."""
+    out: Dict[str, Any] = {}
+    model = raw.get("model", {})
+    if isinstance(model, dict):
+        name = model.get("name")
+        if name:
+            size = str(model.get("size", "")).lower()
+            suffix = _MODEL_SIZE_SUFFIX.get(size, "")
+            out["model_name"] = f"{name}{suffix}" if name.startswith("gpt") else name
+    training = raw.get("training", {})
+    if isinstance(training, dict):
+        for key in ("batch_size", "learning_rate", "num_epochs"):
+            if key in training:
+                out[key] = training[key]
+    distributed = raw.get("distributed", {})
+    if isinstance(distributed, dict):
+        if "num_nodes" in distributed:
+            out["num_nodes"] = distributed["num_nodes"]
+        if "parallelism" in distributed:
+            out["parallelism"] = distributed["parallelism"]
+        if "mesh_shape" in distributed:
+            out["mesh_shape"] = dict(distributed["mesh_shape"])
+        if "num_microbatches" in distributed:
+            out["num_microbatches"] = distributed["num_microbatches"]
+    security = raw.get("security", {})
+    if isinstance(security, dict):
+        if "trust_threshold" in security:
+            out["trust_threshold"] = security["trust_threshold"]
+        if "attack_detection" in security:
+            out["attack_detection_enabled"] = bool(security["attack_detection"])
+        if "gradient_verification" in security:
+            out["gradient_verification_enabled"] = bool(
+                security["gradient_verification"]
+            )
+    if "dataset" in raw:
+        out["dataset_name"] = raw["dataset"]
+    # Flat TrainingConfig field names pass straight through.
+    valid = {f.name for f in dataclasses.fields(TrainingConfig)}
+    for key, value in raw.items():
+        if key in valid:
+            out[key] = value
+    return out
+
+
+def load_config(path: str, **overrides: Any) -> TrainingConfig:
+    """Load a TrainingConfig from a YAML (or JSON) file.
+
+    ``overrides`` (e.g. CLI flags) take precedence over file values — the
+    behaviour the reference documented but never implemented
+    (experiment_runner.py:605,613-623).
+    """
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    raw: Optional[Dict[str, Any]] = None
+    try:
+        import yaml  # type: ignore
+
+        raw = yaml.safe_load(text)
+    except ImportError:
+        try:
+            raw = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise RuntimeError(
+                f"pyyaml unavailable and {path} is not JSON: {e}"
+            ) from e
+    if not isinstance(raw, dict):
+        raise ValueError(f"config file {path} did not parse to a mapping")
+    kwargs = _config_from_mapping(raw)
+    kwargs.update({k: v for k, v in overrides.items() if v is not None})
+    return TrainingConfig(**kwargs)

@@ -1,0 +1,135 @@
+"""Device-mesh construction — the L1 communication layer, TPU-native.
+
+The reference's L1 is an NCCL process group that is initialised and destroyed
+but never used for a collective (distributed_trainer.py:99-114,523-527; see
+SURVEY §2.5).  Here L1 is a real `jax.sharding.Mesh`: collectives are XLA ops
+(psum / ppermute / all_gather / all_to_all) compiled into the train step and
+riding ICI (intra-slice) or DCN (multi-slice).  There is no rendezvous config
+to manage — `jax.distributed.initialize()` handles multi-host.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+# Canonical axis names (SURVEY §7.1).  The reference's "node" maps onto
+# whichever axis the chosen parallelism strategy uses.
+DATA_AXIS = "data"     # data parallel shards
+STAGE_AXIS = "stage"   # pipeline stages (reference's layer-split "nodes")
+MODEL_AXIS = "model"   # tensor parallel (attention heads / mlp hidden)
+SEQ_AXIS = "seq"       # sequence/context parallel
+
+_PARALLELISM_AXIS = {
+    "data": DATA_AXIS,
+    "model": STAGE_AXIS,
+    "tensor": MODEL_AXIS,
+    "sequence": SEQ_AXIS,
+}
+
+
+def node_axis_for(parallelism: str) -> str:
+    """Mesh axis that plays the role of the reference's node index."""
+    try:
+        return _PARALLELISM_AXIS[parallelism]
+    except KeyError:
+        raise ValueError(f"no canonical node axis for parallelism={parallelism!r}")
+
+
+def build_mesh(
+    num_nodes: int,
+    parallelism: str = "data",
+    mesh_shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the mesh for a training run.
+
+    For single-axis strategies the node axis gets ``num_nodes`` entries; any
+    leftover devices fold into a leading data axis so all chips stay busy.
+    For "hybrid", ``mesh_shape`` gives {axis: size} explicitly (axis order is
+    data, stage, model, seq — outermost first so DCN-adjacent axes come
+    first, per the scaling-book recipe).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = len(devices)
+
+    if parallelism == "hybrid":
+        if not mesh_shape:
+            raise ValueError("hybrid parallelism requires mesh_shape")
+        order = [a for a in (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, SEQ_AXIS) if a in mesh_shape]
+        extra = set(mesh_shape) - set(order)
+        if extra:
+            raise ValueError(f"unknown mesh axes {extra}")
+        sizes = [mesh_shape[a] for a in order]
+        total = int(np.prod(sizes))
+        if total > n_dev:
+            raise ValueError(f"mesh_shape {mesh_shape} needs {total} devices, have {n_dev}")
+        arr = np.array(devices[:total]).reshape(sizes)
+        return Mesh(arr, tuple(order))
+
+    axis = node_axis_for(parallelism)
+    if num_nodes > n_dev:
+        # Degenerate/dev mode: more logical nodes than devices.  The node
+        # axis still exists logically (vmapped), but the mesh carries every
+        # device on it only when divisible; otherwise run replicated.
+        logger.warning(
+            "num_nodes=%d exceeds device count %d; building a %d-wide mesh "
+            "(logical nodes are vmapped within devices)", num_nodes, n_dev, n_dev
+        )
+        num_nodes = n_dev
+    usable = (n_dev // num_nodes) * num_nodes
+    replicas = usable // num_nodes
+    arr = np.array(devices[:usable]).reshape(replicas, num_nodes)
+    if axis == DATA_AXIS:
+        # Pure DP: fold replicas into the data axis itself.
+        arr = arr.reshape(replicas * num_nodes)
+        return Mesh(arr, (DATA_AXIS,))
+    return Mesh(arr, (DATA_AXIS, axis))
+
+
+def node_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """Sharding for a per-node leading-axis array (e.g. [num_nodes, ...])."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Multi-host init — TPU replacement for the reference's
+    init_process_group (distributed_trainer.py:99-114).  On TPU pods all
+    arguments are discovered from the environment."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "Initialized distributed environment: process %d/%d",
+        jax.process_index(), jax.process_count(),
+    )
+
+
+def shutdown_multihost() -> None:
+    """Teardown parity with dist.destroy_process_group
+    (distributed_trainer.py:523-527)."""
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass  # never initialised — mirrors the reference's is_initialized() guard

@@ -1,0 +1,427 @@
+"""Host-side TrustManager — full API parity with the reference
+(trust_manager.py:44-398), backed by the pure-JAX TrustState.
+
+This class is the *reporting and control* surface: the per-batch trust math
+runs inside the compiled train step on TrustState (trust/state.py); the
+manager absorbs device state once per epoch (``sync_from_device``) and keeps
+the reference's history/export/recommendation features on the host where they
+belong.  It can also be driven standalone (update_trust_score per call) with
+wall-clock decay exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from trustworthy_dl_tpu.trust import state as ts
+from trustworthy_dl_tpu.trust.state import NodeStatus, TrustState
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrustScore:
+    """Trust score with metadata (trust_manager.py:25-32)."""
+
+    value: float
+    last_updated: float
+    update_count: int
+    decay_rate: float = 0.01
+    recovery_rate: float = 0.005
+
+
+@dataclass
+class NodeMetrics:
+    """Node metrics for trust calculation (trust_manager.py:34-42)."""
+
+    output_deviation: float = 0.0
+    gradient_consistency: float = 1.0
+    communication_latency: float = 0.0
+    resource_utilization: float = 0.0
+    error_rate: float = 0.0
+    uptime: float = 1.0
+
+
+class TrustManager:
+    """Manages trust scores and node status for distributed training."""
+
+    def __init__(
+        self,
+        num_nodes: int,
+        trust_threshold: float = 0.7,
+        initial_trust: float = 1.0,
+        max_history: int = 1000,
+        decay_rate: float = 0.01,
+        recovery_rate: float = 0.005,
+        alpha: float = 0.1,
+    ):
+        self.num_nodes = num_nodes
+        self.trust_threshold = trust_threshold
+        self.initial_trust = initial_trust
+        self.max_history = max_history
+        self.default_decay_rate = decay_rate
+        self.default_recovery_rate = recovery_rate
+        self.alpha = alpha
+
+        self.trust_scores: Dict[int, TrustScore] = {}
+        self.node_status: Dict[int, NodeStatus] = {}
+        self.node_metrics: Dict[int, NodeMetrics] = {}
+
+        self.trust_history: Dict[int, deque] = defaultdict(
+            lambda: deque(maxlen=max_history)
+        )
+        self.attack_history: Dict[int, List] = defaultdict(list)
+        self.performance_history: Dict[int, deque] = defaultdict(
+            lambda: deque(maxlen=max_history)
+        )
+
+        # Weighted-sum weights (trust_manager.py:67-74); kept as a dict for
+        # API parity, the device path uses trust/state.py:TRUST_WEIGHTS.
+        self.trust_weights = {
+            "output_deviation": 0.3,
+            "gradient_consistency": 0.3,
+            "communication_latency": 0.1,
+            "resource_utilization": 0.1,
+            "error_rate": 0.15,
+            "uptime": 0.05,
+        }
+
+        for node_id in range(num_nodes):
+            self.initialize_node(node_id)
+        logger.info("TrustManager initialized for %d nodes", num_nodes)
+
+    # ------------------------------------------------------------------
+    # Core update path (trust_manager.py:82-206)
+    # ------------------------------------------------------------------
+
+    def initialize_node(self, node_id: int) -> None:
+        self.trust_scores[node_id] = TrustScore(
+            value=self.initial_trust,
+            last_updated=time.time(),
+            update_count=0,
+            decay_rate=self.default_decay_rate,
+            recovery_rate=self.default_recovery_rate,
+        )
+        self.node_status[node_id] = NodeStatus.TRUSTED
+        self.node_metrics[node_id] = NodeMetrics()
+
+    def update_trust_score(
+        self,
+        node_id: int,
+        output_deviation: float,
+        gradient_consistency: float,
+        **kwargs: float,
+    ) -> None:
+        """Single-node host update, wall-clock decay
+        (trust_manager.py:92-140)."""
+        if node_id not in self.trust_scores:
+            self.initialize_node(node_id)
+        metrics = self.node_metrics[node_id]
+        metrics.output_deviation = output_deviation
+        metrics.gradient_consistency = gradient_consistency
+        for key, value in kwargs.items():
+            if hasattr(metrics, key):
+                setattr(metrics, key, value)
+
+        new_trust = self._calculate_trust_score(node_id, metrics)
+        old = self.trust_scores[node_id]
+        dt = time.time() - old.last_updated
+        decay = float(np.exp(-old.decay_rate * dt))
+        final = float(
+            np.clip((1 - self.alpha) * old.value * decay + self.alpha * new_trust, 0.0, 1.0)
+        )
+        self.trust_scores[node_id] = TrustScore(
+            value=final,
+            last_updated=time.time(),
+            update_count=old.update_count + 1,
+            decay_rate=old.decay_rate,
+            recovery_rate=old.recovery_rate,
+        )
+        self._update_node_status(node_id, final)
+        self.trust_history[node_id].append(
+            {
+                "timestamp": time.time(),
+                "trust_score": final,
+                "metrics": metrics.__dict__.copy(),
+            }
+        )
+        logger.debug("Node %d trust updated: %.3f", node_id, final)
+
+    def _calculate_trust_score(self, node_id: int, metrics: NodeMetrics) -> float:
+        components = {
+            "output_deviation": 1.0 - min(1.0, metrics.output_deviation),
+            "gradient_consistency": metrics.gradient_consistency,
+            "communication_latency": 1.0
+            - min(1.0, metrics.communication_latency / 10.0),
+            "resource_utilization": min(1.0, metrics.resource_utilization),
+            "error_rate": 1.0 - min(1.0, metrics.error_rate),
+            "uptime": metrics.uptime,
+        }
+        score = sum(self.trust_weights[k] * v for k, v in components.items())
+        return float(np.clip(score, 0.0, 1.0))
+
+    def _update_node_status(self, node_id: int, trust_score: float) -> None:
+        current = self.node_status[node_id]
+        if trust_score < 0.3:
+            new = NodeStatus.COMPROMISED
+        elif trust_score < self.trust_threshold:
+            new = NodeStatus.SUSPICIOUS
+        elif current == NodeStatus.COMPROMISED and trust_score > 0.8:
+            new = NodeStatus.RECOVERING
+        elif current == NodeStatus.RECOVERING and trust_score > 0.9:
+            new = NodeStatus.TRUSTED
+        elif trust_score >= self.trust_threshold:
+            new = NodeStatus.TRUSTED
+        else:
+            new = current
+        if new != current:
+            logger.info(
+                "Node %d status changed: %s -> %s", node_id, current.label, new.label
+            )
+            self.node_status[node_id] = new
+
+    def mark_compromised(self, node_id: int, attack_type: str = "unknown") -> None:
+        """Severe trust penalty (trust_manager.py:183-196).  Unlike the
+        reference, ``previous_trust`` records the value *before* the
+        overwrite (SURVEY §7.5 fix)."""
+        previous = self.trust_scores[node_id].value
+        self.node_status[node_id] = NodeStatus.COMPROMISED
+        self.trust_scores[node_id].value = 0.1
+        self.attack_history[node_id].append(
+            {
+                "timestamp": time.time(),
+                "attack_type": attack_type,
+                "previous_trust": previous,
+            }
+        )
+        logger.warning("Node %d marked as compromised: %s", node_id, attack_type)
+
+    def initiate_recovery(self, node_id: int) -> None:
+        if self.node_status[node_id] == NodeStatus.COMPROMISED:
+            self.node_status[node_id] = NodeStatus.RECOVERING
+            self.trust_scores[node_id].recovery_rate = 0.02
+            logger.info("Recovery initiated for node %d", node_id)
+
+    # ------------------------------------------------------------------
+    # Queries (trust_manager.py:208-257)
+    # ------------------------------------------------------------------
+
+    def get_trust_score(self, node_id: int) -> float:
+        if node_id not in self.trust_scores:
+            return 0.0
+        return self.trust_scores[node_id].value
+
+    def get_node_status(self, node_id: int) -> NodeStatus:
+        return self.node_status.get(node_id, NodeStatus.OFFLINE)
+
+    def get_trusted_nodes(self) -> List[int]:
+        return [
+            i for i in range(self.num_nodes)
+            if self.node_status[i] == NodeStatus.TRUSTED
+        ]
+
+    def get_suspicious_nodes(self) -> List[int]:
+        return [
+            i for i in range(self.num_nodes)
+            if self.node_status[i] == NodeStatus.SUSPICIOUS
+        ]
+
+    def get_compromised_nodes(self) -> List[int]:
+        return [
+            i for i in range(self.num_nodes)
+            if self.node_status[i] == NodeStatus.COMPROMISED
+        ]
+
+    def can_assign_task(self, node_id: int) -> bool:
+        status = self.node_status.get(node_id, NodeStatus.OFFLINE)
+        return status in (NodeStatus.TRUSTED, NodeStatus.RECOVERING)
+
+    def select_best_nodes(self, num_nodes: int) -> List[int]:
+        available = [
+            (i, self.get_trust_score(i))
+            for i in range(self.num_nodes)
+            if self.can_assign_task(i)
+        ]
+        available.sort(key=lambda x: x[1], reverse=True)
+        return [i for i, _ in available[:num_nodes]]
+
+    # ------------------------------------------------------------------
+    # Aggregates / reporting (trust_manager.py:259-331)
+    # ------------------------------------------------------------------
+
+    def calculate_system_trust(self) -> float:
+        if not self.trust_scores:
+            return 0.0
+        values = [s.value for s in self.trust_scores.values()]
+        weights = np.array(values)
+        if weights.sum() <= 0:
+            return 0.0
+        return float(np.average(values, weights=weights))
+
+    def get_trust_statistics(self) -> Dict:
+        values = [s.value for s in self.trust_scores.values()]
+        if not values:
+            return {}
+        return {
+            "mean_trust": float(np.mean(values)),
+            "std_trust": float(np.std(values)),
+            "min_trust": float(np.min(values)),
+            "max_trust": float(np.max(values)),
+            "system_trust": self.calculate_system_trust(),
+            "node_status_counts": {
+                status.label: sum(1 for s in self.node_status.values() if s == status)
+                for status in NodeStatus
+            },
+            "total_attacks": sum(len(a) for a in self.attack_history.values()),
+        }
+
+    def get_node_history(self, node_id: int, limit: int = 100) -> List[Dict]:
+        if node_id not in self.trust_history:
+            return []
+        history = list(self.trust_history[node_id])
+        return history[-limit:] if limit else history
+
+    def export_trust_data(self, filepath: str) -> None:
+        export_data = {
+            "trust_scores": {
+                str(i): {
+                    "value": s.value,
+                    "last_updated": s.last_updated,
+                    "update_count": s.update_count,
+                }
+                for i, s in self.trust_scores.items()
+            },
+            "node_status": {
+                str(i): status.label for i, status in self.node_status.items()
+            },
+            "trust_history": {
+                str(i): list(h) for i, h in self.trust_history.items()
+            },
+            "attack_history": {
+                str(i): a for i, a in self.attack_history.items()
+            },
+            "statistics": self.get_trust_statistics(),
+        }
+        with open(filepath, "w") as f:
+            json.dump(export_data, f, indent=2)
+        logger.info("Trust data exported to %s", filepath)
+
+    # ------------------------------------------------------------------
+    # Adaptation / prediction (trust_manager.py:333-394)
+    # ------------------------------------------------------------------
+
+    def adaptive_threshold_adjustment(self) -> None:
+        stats = self.get_trust_statistics()
+        mean_trust = stats.get("mean_trust", 0.7)
+        if mean_trust < 0.5:
+            self.trust_threshold = max(0.3, mean_trust - 0.1)
+        elif mean_trust > 0.9:
+            self.trust_threshold = min(0.8, mean_trust - 0.1)
+        else:
+            self.trust_threshold += 0.01 * (0.7 - self.trust_threshold)
+        logger.debug("Trust threshold adjusted to %.3f", self.trust_threshold)
+
+    def predict_node_reliability(self, node_id: int, horizon: int = 10) -> float:
+        if node_id not in self.trust_history or len(self.trust_history[node_id]) < 5:
+            return self.get_trust_score(node_id)
+        recent = [e["trust_score"] for e in list(self.trust_history[node_id])[-10:]]
+        x = np.arange(len(recent))
+        coeffs = np.polyfit(x, recent, 1)
+        future = coeffs[0] * (len(recent) + horizon) + coeffs[1]
+        return float(np.clip(future, 0.0, 1.0))
+
+    def get_recommendations(self) -> List[str]:
+        recommendations = []
+        stats = self.get_trust_statistics()
+        if stats.get("mean_trust", 1.0) < 0.6:
+            recommendations.append(
+                "System trust is low - consider investigating compromised nodes"
+            )
+        compromised = self.get_compromised_nodes()
+        if len(compromised) > self.num_nodes * 0.3:
+            recommendations.append(
+                "High number of compromised nodes - check security measures"
+            )
+        if stats.get("total_attacks", 0) > 10:
+            recommendations.append(
+                "Frequent attacks detected - strengthen attack detection"
+            )
+        suspicious = self.get_suspicious_nodes()
+        if suspicious:
+            recommendations.append(f"Monitor suspicious nodes: {suspicious}")
+        return recommendations
+
+    def reset_node_trust(self, node_id: int) -> None:
+        self.initialize_node(node_id)
+        logger.info("Trust reset for node %d", node_id)
+
+    def cleanup(self) -> None:
+        logger.info("TrustManager cleanup completed")
+
+    # ------------------------------------------------------------------
+    # Device-state bridge (TPU-native; no reference equivalent)
+    # ------------------------------------------------------------------
+
+    def to_device_state(self, now: float = 0.0) -> TrustState:
+        """Materialise the current host view as a TrustState pytree."""
+        import jax.numpy as jnp
+
+        n = self.num_nodes
+        state = ts.init_trust_state(
+            n,
+            trust_threshold=self.trust_threshold,
+            initial_trust=self.initial_trust,
+            decay_rate=self.default_decay_rate,
+            recovery_rate=self.default_recovery_rate,
+            now=now,
+        )
+        scores = jnp.array([self.get_trust_score(i) for i in range(n)], jnp.float32)
+        status = jnp.array([int(self.get_node_status(i)) for i in range(n)], jnp.int32)
+        counts = jnp.array(
+            [self.trust_scores[i].update_count for i in range(n)], jnp.int32
+        )
+        return state._replace(scores=scores, status=status, update_count=counts)
+
+    def sync_from_device(self, state: TrustState, wall_time: Optional[float] = None
+                         ) -> None:
+        """Absorb a TrustState computed inside the train step (called once
+        per epoch / reporting interval, not per batch)."""
+        wall_time = wall_time if wall_time is not None else time.time()
+        scores = np.asarray(state.scores)
+        status = np.asarray(state.status)
+        counts = np.asarray(state.update_count)
+        metrics = np.asarray(state.metrics)
+        self.trust_threshold = float(np.asarray(state.threshold))
+        for i in range(min(self.num_nodes, scores.shape[0])):
+            old = self.trust_scores[i]
+            self.trust_scores[i] = TrustScore(
+                value=float(scores[i]),
+                last_updated=wall_time,
+                update_count=int(counts[i]),
+                decay_rate=old.decay_rate,
+                recovery_rate=old.recovery_rate,
+            )
+            self.node_status[i] = NodeStatus(int(status[i]))
+            m = metrics[i]
+            self.node_metrics[i] = NodeMetrics(
+                output_deviation=float(m[0]),
+                gradient_consistency=float(m[1]),
+                communication_latency=float(m[2]),
+                resource_utilization=float(m[3]),
+                error_rate=float(m[4]),
+                uptime=float(m[5]),
+            )
+            self.trust_history[i].append(
+                {
+                    "timestamp": wall_time,
+                    "trust_score": float(scores[i]),
+                    "metrics": self.node_metrics[i].__dict__.copy(),
+                }
+            )

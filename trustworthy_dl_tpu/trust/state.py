@@ -1,0 +1,290 @@
+"""Trust state as a JAX pytree with pure update functions.
+
+Re-designs the reference TrustManager's per-node dict-of-dataclasses
+(trust_manager.py:44-181) as fixed-shape arrays so the whole trust update runs
+inside the compiled train step — no host round-trip per batch.  The math is
+kept exactly (SURVEY §2.2):
+
+  * 6-component weighted score, weights {output_deviation:0.3,
+    gradient_consistency:0.3, communication_latency:0.1,
+    resource_utilization:0.1, error_rate:0.15, uptime:0.05}
+    (trust_manager.py:67-74), components mapped higher-is-better
+    (trust_manager.py:142-160, latency normalised /10).
+  * EMA blend with temporal decay:
+    final = (1-alpha) * old * exp(-decay_rate * dt) + alpha * new, alpha=0.1,
+    clipped to [0,1] (trust_manager.py:112-119).
+  * 5-state status machine evaluated in the reference's exact branch order
+    (trust_manager.py:162-181) — including its quirk that a COMPROMISED node
+    with trust in [threshold, 0.8] jumps straight to TRUSTED.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class NodeStatus(enum.IntEnum):
+    """Node status (trust_manager.py:18-23).  IntEnum so status lives in an
+    i32 array on device; `.label` gives the reference's string values."""
+
+    TRUSTED = 0
+    SUSPICIOUS = 1
+    COMPROMISED = 2
+    RECOVERING = 3
+    OFFLINE = 4
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+# Metric component order for the [n, 6] metrics array.
+METRIC_NAMES = (
+    "output_deviation",
+    "gradient_consistency",
+    "communication_latency",
+    "resource_utilization",
+    "error_rate",
+    "uptime",
+)
+# Weighted-sum weights (trust_manager.py:67-74).
+TRUST_WEIGHTS = jnp.array([0.3, 0.3, 0.1, 0.1, 0.15, 0.05], dtype=jnp.float32)
+# Default metric values: NodeMetrics defaults (trust_manager.py:34-42).
+METRIC_DEFAULTS = jnp.array([0.0, 1.0, 0.0, 0.0, 0.0, 1.0], dtype=jnp.float32)
+
+
+class TrustState(NamedTuple):
+    """Per-node trust world-view, carried through the jitted step."""
+
+    scores: jax.Array        # f32[n]  current trust in [0,1]
+    status: jax.Array        # i32[n]  NodeStatus codes
+    update_count: jax.Array  # i32[n]
+    last_updated: jax.Array  # f32[n]  clock of last update (step-time units)
+    decay_rate: jax.Array    # f32[n]
+    recovery_rate: jax.Array # f32[n]
+    metrics: jax.Array       # f32[n, 6] last NodeMetrics per node
+    threshold: jax.Array     # f32[]   current trust threshold (adaptive)
+    attack_count: jax.Array  # i32[n]  attacks recorded per node
+
+    @property
+    def num_nodes(self) -> int:
+        return self.scores.shape[0]
+
+
+def init_trust_state(
+    num_nodes: int,
+    trust_threshold: float = 0.7,
+    initial_trust: float = 1.0,
+    decay_rate: float = 0.01,
+    recovery_rate: float = 0.005,
+    now: float = 0.0,
+) -> TrustState:
+    """Defaults from trust_manager.py:25-32,49-54,82-90."""
+    n = num_nodes
+    return TrustState(
+        scores=jnp.full((n,), initial_trust, jnp.float32),
+        status=jnp.zeros((n,), jnp.int32),
+        update_count=jnp.zeros((n,), jnp.int32),
+        last_updated=jnp.full((n,), now, jnp.float32),
+        decay_rate=jnp.full((n,), decay_rate, jnp.float32),
+        recovery_rate=jnp.full((n,), recovery_rate, jnp.float32),
+        metrics=jnp.tile(METRIC_DEFAULTS[None, :], (n, 1)),
+        threshold=jnp.asarray(trust_threshold, jnp.float32),
+        attack_count=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def instantaneous_trust(metrics: jax.Array) -> jax.Array:
+    """Weighted 6-component score for metrics [..., 6]
+    (trust_manager.py:142-160)."""
+    components = jnp.stack(
+        [
+            1.0 - jnp.minimum(1.0, metrics[..., 0]),          # output_deviation
+            metrics[..., 1],                                   # gradient_consistency
+            1.0 - jnp.minimum(1.0, metrics[..., 2] / 10.0),    # comm_latency
+            jnp.minimum(1.0, metrics[..., 3]),                 # resource_util
+            1.0 - jnp.minimum(1.0, metrics[..., 4]),           # error_rate
+            metrics[..., 5],                                   # uptime
+        ],
+        axis=-1,
+    )
+    return jnp.clip(components @ TRUST_WEIGHTS, 0.0, 1.0)
+
+
+def next_status(status: jax.Array, trust: jax.Array, threshold: jax.Array) -> jax.Array:
+    """Vectorised status machine, reference branch order
+    (trust_manager.py:162-181)."""
+    compromised = status == NodeStatus.COMPROMISED
+    recovering = status == NodeStatus.RECOVERING
+    return jnp.select(
+        [
+            trust < 0.3,
+            trust < threshold,
+            compromised & (trust > 0.8),
+            recovering & (trust > 0.9),
+            trust >= threshold,
+        ],
+        [
+            jnp.full_like(status, NodeStatus.COMPROMISED),
+            jnp.full_like(status, NodeStatus.SUSPICIOUS),
+            jnp.full_like(status, NodeStatus.RECOVERING),
+            jnp.full_like(status, NodeStatus.TRUSTED),
+            jnp.full_like(status, NodeStatus.TRUSTED),
+        ],
+        default=status,
+    )
+
+
+def update_trust(
+    state: TrustState,
+    output_deviation: jax.Array,
+    gradient_consistency: jax.Array,
+    now: jax.Array | float,
+    extra_metrics: Optional[jax.Array] = None,
+    update_mask: Optional[jax.Array] = None,
+    alpha: float = 0.1,
+) -> TrustState:
+    """One trust update for all nodes at once (trust_manager.py:92-140).
+
+    ``extra_metrics`` optionally supplies columns 2..5 ([n, 4]: latency,
+    resource_util, error_rate, uptime) — the reference's **kwargs path
+    (trust_manager.py:103-106).  ``update_mask`` ([n] bool) keeps masked-out
+    nodes untouched (used when a node produced no signal this step).
+    """
+    now = jnp.asarray(now, jnp.float32)
+    metrics = state.metrics
+    metrics = metrics.at[:, 0].set(output_deviation.astype(jnp.float32))
+    metrics = metrics.at[:, 1].set(gradient_consistency.astype(jnp.float32))
+    if extra_metrics is not None:
+        metrics = metrics.at[:, 2:6].set(extra_metrics.astype(jnp.float32))
+
+    new_trust = instantaneous_trust(metrics)
+    dt = now - state.last_updated
+    decay = jnp.exp(-state.decay_rate * dt)
+    final = jnp.clip((1.0 - alpha) * state.scores * decay + alpha * new_trust, 0.0, 1.0)
+
+    if update_mask is None:
+        update_mask = jnp.ones_like(final, dtype=bool)
+    final = jnp.where(update_mask, final, state.scores)
+    metrics = jnp.where(update_mask[:, None], metrics, state.metrics)
+
+    status = jnp.where(
+        update_mask, next_status(state.status, final, state.threshold), state.status
+    )
+    return state._replace(
+        scores=final,
+        status=status,
+        update_count=state.update_count + update_mask.astype(jnp.int32),
+        last_updated=jnp.where(update_mask, now, state.last_updated),
+        metrics=metrics,
+    )
+
+
+def mark_compromised(state: TrustState, node_mask: jax.Array) -> TrustState:
+    """Force trust to 0.1 and status to COMPROMISED for masked nodes
+    (trust_manager.py:183-196).  Also counts the attack."""
+    node_mask = node_mask.astype(bool)
+    return state._replace(
+        scores=jnp.where(node_mask, 0.1, state.scores),
+        status=jnp.where(
+            node_mask, jnp.int32(NodeStatus.COMPROMISED), state.status
+        ),
+        attack_count=state.attack_count + node_mask.astype(jnp.int32),
+    )
+
+
+def initiate_recovery(state: TrustState, node_mask: jax.Array) -> TrustState:
+    """COMPROMISED -> RECOVERING with boosted recovery rate
+    (trust_manager.py:198-206)."""
+    eligible = node_mask.astype(bool) & (state.status == NodeStatus.COMPROMISED)
+    return state._replace(
+        status=jnp.where(eligible, jnp.int32(NodeStatus.RECOVERING), state.status),
+        recovery_rate=jnp.where(eligible, 0.02, state.recovery_rate),
+    )
+
+
+def can_assign_task(state: TrustState) -> jax.Array:
+    """bool[n]: TRUSTED or RECOVERING (trust_manager.py:239-242)."""
+    return (state.status == NodeStatus.TRUSTED) | (
+        state.status == NodeStatus.RECOVERING
+    )
+
+
+def contribution_weights(state: TrustState, verdict_ok: Optional[jax.Array] = None
+                         ) -> jax.Array:
+    """f32[n] gradient-contribution gate for the trust-gated psum.
+
+    The reference silently *skips* compromised nodes in the forward pass
+    (distributed_trainer.py:154-157) and applies optimizer steps regardless of
+    verification (:441-446) — both flagged as bugs in SURVEY §7.5.  Here the
+    gate is explicit: a node contributes iff its task-assignable status holds
+    and (when supplied) this step's verification verdict passed.
+    """
+    ok = can_assign_task(state) | (state.status == NodeStatus.SUSPICIOUS)
+    if verdict_ok is not None:
+        ok = ok & verdict_ok.astype(bool)
+    return ok.astype(jnp.float32)
+
+
+def system_trust(state: TrustState) -> jax.Array:
+    """Self-weighted average (trust_manager.py:259-270)."""
+    s = state.scores
+    denom = jnp.maximum(jnp.sum(s), 1e-12)
+    return jnp.sum(s * s) / denom
+
+
+def select_best_nodes(state: TrustState, k: int) -> jax.Array:
+    """Top-k assignable nodes by trust, -1 padding
+    (trust_manager.py:244-257)."""
+    score = jnp.where(can_assign_task(state), state.scores, -jnp.inf)
+    _, idx = jax.lax.top_k(score, k)
+    valid = jnp.take(score, idx) > -jnp.inf
+    return jnp.where(valid, idx, -1)
+
+
+def adaptive_threshold(state: TrustState, default: float = 0.7) -> TrustState:
+    """Adaptive threshold adjustment (trust_manager.py:333-348)."""
+    mean = jnp.mean(state.scores)
+    thr = state.threshold
+    new_thr = jnp.where(
+        mean < 0.5,
+        jnp.maximum(0.3, mean - 0.1),
+        jnp.where(
+            mean > 0.9,
+            jnp.minimum(0.8, mean - 0.1),
+            thr + 0.01 * (default - thr),
+        ),
+    )
+    return state._replace(threshold=new_thr)
+
+
+def predict_reliability(history: jax.Array, valid_count: jax.Array, horizon: int = 10
+                        ) -> jax.Array:
+    """Degree-1 least-squares trend over the last ``window`` trust samples,
+    extrapolated ``horizon`` steps (trust_manager.py:350-368).
+
+    ``history`` is [n, window] (most recent last, left-padded), ``valid_count``
+    [n] the number of valid entries.  Nodes with <5 samples return their
+    latest score, like the reference.
+    """
+    n, window = history.shape
+    x = jnp.arange(window, dtype=jnp.float32)
+    mask = x[None, :] >= (window - valid_count[:, None]).astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    # Re-index x per node so the first valid sample is x=0 (matches polyfit
+    # over the dense recent window in the reference).
+    x_local = jnp.where(mask, x[None, :] - (window - valid_count[:, None]), 0.0)
+    y = jnp.where(mask, history, 0.0)
+    xm = jnp.sum(x_local, axis=1) / cnt
+    ym = jnp.sum(y, axis=1) / cnt
+    cov = jnp.sum(jnp.where(mask, (x_local - xm[:, None]) * (history - ym[:, None]), 0.0), axis=1)
+    var = jnp.sum(jnp.where(mask, (x_local - xm[:, None]) ** 2, 0.0), axis=1)
+    slope = jnp.where(var > 0, cov / jnp.maximum(var, 1e-12), 0.0)
+    intercept = ym - slope * xm
+    pred = slope * (valid_count.astype(jnp.float32) + horizon) + intercept
+    latest = history[:, -1]
+    return jnp.clip(jnp.where(valid_count >= 5, pred, latest), 0.0, 1.0)
