@@ -1,0 +1,230 @@
+"""Detector math vs hand-built numpy/scipy references
+(attack_detector.py:185-363 semantics; SURVEY §2.2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+from trustworthy_dl_tpu.detect import (
+    AttackDetector,
+    AttackType,
+    GRADIENT_STAT_NAMES,
+    GradientVerifier,
+    NUM_GRADIENT_STATS,
+    STAT_INDEX,
+    TENSOR_STAT_NAMES,
+    anomaly_verdicts,
+    backdoor_divergence,
+    baseline_moments,
+    byzantine_verdicts,
+    gradient_statistics,
+    init_baseline_state,
+    init_verifier_state,
+    push_stats,
+    push_then_detect,
+    tensor_statistics,
+    verify_gradients_array,
+)
+
+
+def test_tensor_statistics_match_numpy_scipy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.5, 2.0, size=1000).astype(np.float32)
+    got = np.asarray(tensor_statistics(jnp.asarray(x)))
+    expected = [
+        np.mean(x), np.std(x), np.min(x), np.max(x), np.median(x),
+        sps.skew(x), sps.kurtosis(x),
+        np.percentile(x, 25), np.percentile(x, 75),
+        np.linalg.norm(x, 1), np.linalg.norm(x, 2), np.linalg.norm(x, np.inf),
+    ]
+    np.testing.assert_allclose(got, expected, rtol=2e-4)
+    assert list(TENSOR_STAT_NAMES) == [
+        "mean", "std", "min", "max", "median", "skewness", "kurtosis",
+        "percentile_25", "percentile_75", "norm_l1", "norm_l2", "norm_inf",
+    ]
+
+
+def test_gradient_statistics():
+    rng = np.random.default_rng(1)
+    grads = [rng.normal(size=(8, 4)).astype(np.float32) for _ in range(3)]
+    got = np.asarray(gradient_statistics([jnp.asarray(g) for g in grads]))
+    assert got.shape == (NUM_GRADIENT_STATS,)
+    norms = [np.linalg.norm(g) for g in grads]
+    assert got[STAT_INDEX["num_gradients"]] == pytest.approx(3)
+    assert got[STAT_INDEX["grad_norms_mean"]] == pytest.approx(np.mean(norms), rel=1e-5)
+    assert got[STAT_INDEX["grad_norms_max"]] == pytest.approx(np.max(norms), rel=1e-5)
+    # pairwise cosine
+    flat = [g.reshape(-1) for g in grads]
+    sims = []
+    for i in range(3):
+        for j in range(i + 1, 3):
+            sims.append(
+                np.dot(flat[i], flat[j])
+                / (np.linalg.norm(flat[i]) * np.linalg.norm(flat[j]))
+            )
+    assert got[STAT_INDEX["cosine_similarity"]] == pytest.approx(np.mean(sims), rel=1e-4)
+
+
+def test_ring_buffer_baseline_matches_window():
+    n, window, s = 2, 8, NUM_GRADIENT_STATS
+    state = init_baseline_state(n, window=window, num_stats=s)
+    rng = np.random.default_rng(2)
+    samples = rng.normal(size=(12, n, s)).astype(np.float32)
+    for t in range(12):
+        state = push_stats(state, jnp.asarray(samples[t]))
+    mean, std, valid = baseline_moments(state)
+    # Window keeps the last 8 samples.
+    recent = samples[-window:]
+    np.testing.assert_allclose(np.asarray(mean), recent.mean(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(std), recent.std(axis=0), rtol=1e-3)
+    np.testing.assert_array_equal(np.asarray(valid), [window, window])
+
+
+def test_anomaly_detection_fires_on_outlier():
+    n = 4
+    state = init_baseline_state(n, window=100)
+    rng = np.random.default_rng(3)
+    # Build 20 steps of benign stats history.
+    for _ in range(20):
+        stats_step = rng.normal(1.0, 0.1, size=(n, NUM_GRADIENT_STATS)).astype(
+            np.float32
+        )
+        state, verdicts = push_then_detect(state, jnp.asarray(stats_step))
+    assert not bool(verdicts.is_attack.any())
+    # Node 2 suddenly produces wildly shifted stats.
+    attacked = rng.normal(1.0, 0.1, size=(n, NUM_GRADIENT_STATS)).astype(np.float32)
+    attacked[2] += 10.0
+    state, verdicts = push_then_detect(state, jnp.asarray(attacked))
+    flags = np.asarray(verdicts.is_attack)
+    assert flags[2]
+    assert not flags[[0, 1, 3]].any()
+    assert float(verdicts.confidence[2]) > 0.8  # score well above threshold
+
+
+def test_warmup_suppresses_detection():
+    n = 2
+    state = init_baseline_state(n, window=100)
+    rng = np.random.default_rng(4)
+    for t in range(9):  # below the 10-entry warm-up (attack_detector.py:91)
+        stats_step = rng.normal(size=(n, NUM_GRADIENT_STATS)).astype(np.float32)
+        stats_step[1] += 100.0  # blatant outlier
+        state, verdicts = push_then_detect(state, jnp.asarray(stats_step))
+        assert not bool(verdicts.is_attack.any())
+
+
+def test_classifier_rules():
+    n = 1
+    z = np.ones((n, NUM_GRADIENT_STATS), np.float32)
+    ev = np.zeros((n, NUM_GRADIENT_STATS), bool)
+    from trustworthy_dl_tpu.detect import classify_attack
+
+    # L2 z>5 -> gradient poisoning
+    z1, ev1 = z.copy(), ev.copy()
+    z1[0, STAT_INDEX["norm_l2"]] = 6.0
+    ev1[0, STAT_INDEX["norm_l2"]] = True
+    assert AttackType(int(classify_attack(jnp.asarray(z1), jnp.asarray(ev1))[0])) \
+        == AttackType.GRADIENT_POISONING
+    # std z>4 -> data poisoning
+    z2, ev2 = z.copy(), ev.copy()
+    z2[0, STAT_INDEX["std"]] = 4.5
+    ev2[0, STAT_INDEX["std"]] = True
+    assert AttackType(int(classify_attack(jnp.asarray(z2), jnp.asarray(ev2))[0])) \
+        == AttackType.DATA_POISONING
+    # skew evidence -> adversarial input
+    z3, ev3 = z.copy(), ev.copy()
+    ev3[0, STAT_INDEX["skewness"]] = True
+    assert AttackType(int(classify_attack(jnp.asarray(z3), jnp.asarray(ev3))[0])) \
+        == AttackType.ADVERSARIAL_INPUT
+    # nothing specific -> byzantine
+    assert AttackType(int(classify_attack(jnp.asarray(z), jnp.asarray(ev))[0])) \
+        == AttackType.BYZANTINE
+
+
+def test_byzantine_verdicts():
+    rng = np.random.default_rng(5)
+    base = rng.normal(size=(64,)).astype(np.float32)
+    outputs = np.stack([
+        base + rng.normal(scale=0.05, size=64).astype(np.float32) for _ in range(4)
+    ])
+    outputs[3] = rng.normal(size=(64,)).astype(np.float32)  # uncorrelated node
+    flags = np.asarray(byzantine_verdicts(jnp.asarray(outputs)))
+    assert flags[3]
+    assert not flags[:3].any()
+    # <3 nodes: no verdicts (attack_detector.py:146)
+    assert not np.asarray(byzantine_verdicts(jnp.asarray(outputs[:2]))).any()
+
+
+def test_backdoor_divergence():
+    logits = np.zeros((4, 10), np.float32)
+    same = backdoor_divergence(jnp.asarray(logits), jnp.asarray(logits))
+    assert float(same) == pytest.approx(0.0, abs=1e-6)
+    shifted = logits.copy()
+    shifted[:, 0] = 50.0  # sharply different distribution
+    div = backdoor_divergence(jnp.asarray(shifted), jnp.asarray(logits))
+    assert float(div) > 2.0
+
+
+def test_gradient_verifier_state_catches_inflation_and_nan():
+    n = 4
+    state = init_verifier_state(n)
+    rng = np.random.default_rng(6)
+    for _ in range(20):
+        norms = jnp.asarray(rng.normal(1.0, 0.02, size=n).astype(np.float32))
+        state, valid = verify_gradients_array(state, norms, jnp.ones(n, bool))
+        assert bool(valid.all())
+    # Inflated norm on node 1 (1000x) must fail; NaN on node 2 must fail.
+    norms = jnp.asarray(np.array([1.0, 1000.0, 1.0, 1.0], np.float32))
+    finite = jnp.asarray(np.array([True, True, False, True]))
+    state2, valid = verify_gradients_array(state, norms, finite)
+    np.testing.assert_array_equal(np.asarray(valid), [True, False, False, True])
+    # Failed nodes must not have polluted their baselines.
+    assert int(state2.count[1]) == int(state.count[1])
+
+
+def test_host_detector_end_to_end():
+    det = AttackDetector()
+    rng = np.random.default_rng(7)
+    # Benign history then a poisoned gradient set on node 0.
+    for step in range(15):
+        grads = [rng.normal(0, 0.1, size=(16,)).astype(np.float32) for _ in range(3)]
+        assert not det.detect_gradient_poisoning(grads, node_id=0, step=step)
+    poisoned = [
+        rng.normal(0, 0.1, size=(16,)).astype(np.float32) * 1000 for _ in range(3)
+    ]
+    assert det.detect_gradient_poisoning(poisoned, node_id=0, step=99)
+    stats = det.get_detection_statistics()
+    assert stats["total_detections"] == 1
+
+
+def test_host_detector_none_output_is_attack():
+    det = AttackDetector()
+    assert det.detect_output_anomaly(None, node_id=0, step=0)  # :74-75
+
+
+def test_host_verifier_api():
+    ver = GradientVerifier()
+    rng = np.random.default_rng(8)
+    for step in range(15):
+        grads = [rng.normal(0, 0.1, size=(8,)).astype(np.float32)]
+        assert ver.verify_gradients(grads, node_id=3, step=step)
+    bad = [np.full((8,), 1e6, np.float32)]
+    assert not ver.verify_gradients(bad, node_id=3, step=99)
+    nan = [np.full((8,), np.nan, np.float32)]
+    assert not ver.verify_gradients(nan, node_id=3, step=100)
+
+
+def test_host_detector_export(tmp_path):
+    det = AttackDetector()
+    rng = np.random.default_rng(9)
+    for step in range(12):
+        det.detect_output_anomaly(
+            rng.normal(size=(32,)).astype(np.float32), node_id=1, step=step
+        )
+    path = tmp_path / "detect.json"
+    det.export_detection_data(str(path))
+    import json
+
+    data = json.loads(path.read_text())
+    assert "1" in data["baselines"]["output"]
+    assert data["history_lengths"]["1"] == 12

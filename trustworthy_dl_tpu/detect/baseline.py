@@ -1,0 +1,79 @@
+"""Rolling statistical baselines as a fixed-shape ring buffer pytree.
+
+The reference keeps a deque of the last ``history_size`` stat dicts per node
+and recomputes baseline mean/std over the window every step
+(attack_detector.py:49-55,241-290).  Inside a jitted step we cannot grow
+deques, so the window is a ring buffer [n, K, S] with a per-node write count;
+baseline mean/std are masked reductions over the valid window — numerically
+identical to the reference's window math.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.detect.stats import NUM_GRADIENT_STATS
+
+
+class BaselineState(NamedTuple):
+    """Rolling window of per-node stat vectors."""
+
+    ring: jax.Array   # f32[n, K, S] — circular history of stat vectors
+    count: jax.Array  # i32[n] — total writes per node (monotonic)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.ring.shape[0]
+
+    @property
+    def window(self) -> int:
+        return self.ring.shape[1]
+
+
+def init_baseline_state(
+    num_nodes: int,
+    window: int = 1000,
+    num_stats: int = NUM_GRADIENT_STATS,
+) -> BaselineState:
+    return BaselineState(
+        ring=jnp.zeros((num_nodes, window, num_stats), jnp.float32),
+        count=jnp.zeros((num_nodes,), jnp.int32),
+    )
+
+
+def push_stats(state: BaselineState, stats: jax.Array,
+               mask: Optional[jax.Array] = None) -> BaselineState:
+    """Append one stat vector per node ([n, S]); ``mask`` ([n] bool) skips
+    nodes that produced no signal this step."""
+    n, window, _ = state.ring.shape
+    if mask is None:
+        mask = jnp.ones((n,), bool)
+    idx = state.count % window
+    current = state.ring[jnp.arange(n), idx]
+    new_row = jnp.where(mask[:, None], stats.astype(jnp.float32), current)
+    ring = state.ring.at[jnp.arange(n), idx].set(new_row)
+    return BaselineState(ring=ring, count=state.count + mask.astype(jnp.int32))
+
+
+def baseline_moments(state: BaselineState) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(mean[n,S], std[n,S], valid_count[n]) over the valid window — the
+    baseline the z-scores compare against (attack_detector.py:254-266).
+    Population std, matching np.std."""
+    n, window, s = state.ring.shape
+    valid = jnp.minimum(state.count, window)                       # [n]
+    slot = jnp.arange(window)[None, :]                             # [1, K]
+    mask = (slot < valid[:, None]).astype(jnp.float32)[..., None]  # [n, K, 1]
+    denom = jnp.maximum(valid.astype(jnp.float32), 1.0)[:, None]   # [n, 1]
+    mean = jnp.sum(state.ring * mask, axis=1) / denom
+    var = jnp.sum(((state.ring - mean[:, None, :]) ** 2) * mask, axis=1) / denom
+    return mean, jnp.sqrt(var), valid
+
+
+def zscores(stats: jax.Array, mean: jax.Array, std: jax.Array) -> jax.Array:
+    """Per-stat |z| with zero-variance stats reporting z=0 and flagged
+    invalid by the caller via ``std > 0`` (attack_detector.py:315-318)."""
+    safe = jnp.where(std > 0, std, 1.0)
+    return jnp.where(std > 0, jnp.abs(stats - mean) / safe, 0.0)
