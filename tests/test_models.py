@@ -1,0 +1,120 @@
+"""Model zoo: shapes, jit-ability, gradient flow, factory contract
+(README.md:85-92 model list; distributed_trainer.py:116-146 partitioning)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.models import ModelFactory, create_model
+
+TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=64,
+                seq_len=16)
+
+
+def test_gpt2_forward_and_loss():
+    bundle = create_model("gpt2", **TINY_GPT)
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.example_batch(2)
+    logits = jax.jit(bundle.apply)(params, batch["input"])
+    assert logits.shape == (2, 16, 128)
+    loss = jax.jit(bundle.loss)(params, batch)
+    assert np.isfinite(float(loss))
+    # Random init ≈ uniform over vocab
+    assert float(loss) == pytest.approx(np.log(128), rel=0.2)
+
+
+def test_gpt2_blocks_are_stacked_and_sliceable():
+    bundle = create_model("gpt2", **TINY_GPT)
+    params = bundle.init(jax.random.PRNGKey(0))
+    # `transformer.h` parity: leading axis = layers, sliceable per stage.
+    leaves = jax.tree_util.tree_leaves(params["blocks"])
+    assert all(l.shape[0] == 2 for l in leaves)
+    assert bundle.num_blocks == 2
+
+
+def test_gpt2_gradients_flow():
+    bundle = create_model("gpt2", **TINY_GPT)
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.example_batch(2)
+    grads = jax.jit(jax.grad(bundle.loss))(params, batch)
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree_util.tree_leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(norms) > 0
+
+
+@pytest.mark.parametrize("name,num_blocks", [
+    ("resnet32", 15), ("resnet50", 16), ("resnet101", 33),
+])
+def test_resnet_variants(name, num_blocks):
+    bundle = create_model(name, num_classes=10)
+    assert bundle.num_blocks == num_blocks
+    params = bundle.init(jax.random.PRNGKey(0))
+    batch = bundle.example_batch(2)
+    logits = jax.jit(bundle.apply)(params, batch["input"])
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+@pytest.mark.parametrize("name,convs", [("vgg11", 8), ("vgg13", 10), ("vgg16", 13)])
+def test_vgg_variants(name, convs):
+    bundle = create_model(name, num_classes=10)
+    assert bundle.num_blocks == convs
+    params = bundle.init(jax.random.PRNGKey(0))
+    logits = jax.jit(bundle.apply)(params, bundle.example_batch(2)["input"])
+    assert logits.shape == (2, 10)
+
+
+def test_resnet32_param_count_reasonable():
+    # CIFAR ResNet-32 is ~0.46M params in the literature; GroupNorm adds a
+    # hair. Sanity-check the architecture is the CIFAR variant, not a giant.
+    bundle = create_model("resnet32")
+    params = bundle.init(jax.random.PRNGKey(0))
+    n = bundle.num_params(params)
+    assert 3e5 < n < 8e5, n
+
+
+def test_factory_rejects_unknown():
+    with pytest.raises(ValueError):
+        ModelFactory().create_model("alexnet")
+
+
+def test_lm_dataloader_contract():
+    dl = get_dataloader("openwebtext", split="train", batch_size=4, seq_len=16,
+                        num_examples=32)
+    batches = list(dl)
+    assert len(batches) == 8
+    b = batches[0]
+    assert b["input"].shape == (4, 16)
+    assert b["target"].shape == (4, 16)
+    # target is the shifted stream
+    np.testing.assert_array_equal(b["input"][:, 1:], b["target"][:, :-1])
+
+
+def test_vision_dataloader_contract():
+    dl = get_dataloader("cifar10", split="validation", batch_size=8,
+                        num_examples=64)
+    b = next(iter(dl))
+    assert b["input"].shape == (8, 32, 32, 3)
+    assert b["target"].shape == (8,)
+    assert b["target"].dtype == np.int32
+
+
+def test_dataloader_deterministic_across_constructions():
+    a = next(iter(get_dataloader("cifar10", batch_size=4, num_examples=16, seed=3)))
+    b = next(iter(get_dataloader("cifar10", batch_size=4, num_examples=16, seed=3)))
+    np.testing.assert_array_equal(a["input"], b["input"])
+
+
+def test_synthetic_vision_is_learnable():
+    # A linear probe should beat chance easily on class-conditional data.
+    dl = get_dataloader("cifar10", batch_size=256, num_examples=256)
+    b = next(iter(dl))
+    x = b["input"].reshape(256, -1)
+    y = b["target"]
+    # nearest-class-mean classifier
+    means = np.stack([x[y == c].mean(axis=0) if (y == c).any() else np.zeros(x.shape[1])
+                      for c in range(10)])
+    pred = np.argmin(((x[:, None, :] - means[None]) ** 2).sum(-1), axis=1)
+    assert (pred == y).mean() > 0.5

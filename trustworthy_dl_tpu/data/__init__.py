@@ -1,0 +1,3 @@
+from trustworthy_dl_tpu.data.loader import ArrayDataLoader, get_dataloader
+
+__all__ = ["ArrayDataLoader", "get_dataloader"]

@@ -1,0 +1,3 @@
+from trustworthy_dl_tpu.models.factory import ModelBundle, ModelFactory, create_model, get_model
+
+__all__ = ["ModelBundle", "ModelFactory", "create_model", "get_model"]

@@ -1,0 +1,229 @@
+"""GPT-2 family, TPU-first.
+
+The reference only ever touches GPT-2 through ``model.transformer.h`` — a
+python list of blocks it slices into contiguous per-node chunks
+(distributed_trainer.py:124-135).  Here the blocks are a *stacked* pytree
+(leading axis = layer), which is the TPU-native analogue: a pipeline stage is
+a leading-axis slice, `lax.scan` applies the stack with one compiled block
+body, and sharding the leading axis over the 'stage' mesh axis IS the
+reference's layer partitioning.
+
+Sizes follow the public GPT-2 family: small 12L/768/12H, medium 24L/1024/16H,
+large 36L/1280/20H, xl 48L/1600/25H (vocab 50257, context 1024).
+
+The attention implementation is pluggable (``attn_impl``): "full" (fused
+softmax attention), "ring" / "ulysses" (sequence-parallel variants from
+trustworthy_dl_tpu.parallel.sequence) — long-context support is first-class,
+not bolted on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.models import layers as L
+
+Params = Dict[str, Any]
+
+GPT2_SIZES = {
+    "gpt2": dict(n_layer=12, n_embd=768, n_head=12),
+    "gpt2-small": dict(n_layer=12, n_embd=768, n_head=12),
+    "gpt2-medium": dict(n_layer=24, n_embd=1024, n_head=16),
+    "gpt2-large": dict(n_layer=36, n_embd=1280, n_head=20),
+    "gpt2-xl": dict(n_layer=48, n_embd=1600, n_head=25),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_layer: int = 12
+    n_embd: int = 768
+    n_head: int = 12
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "full"   # full | ring | ulysses
+    remat: bool = False
+
+    @staticmethod
+    def from_name(name: str, **overrides: Any) -> "GPT2Config":
+        key = name.lower()
+        if key not in GPT2_SIZES:
+            raise ValueError(f"unknown GPT-2 size {name!r}")
+        kwargs = dict(GPT2_SIZES[key])
+        kwargs.update(overrides)
+        return GPT2Config(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# Attention registry — parallel/sequence.py registers "ring" and "ulysses".
+# --------------------------------------------------------------------------
+
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array, bool], jax.Array]
+_ATTN_REGISTRY: Dict[str, AttnFn] = {}
+
+
+def register_attention(name: str, fn: AttnFn) -> None:
+    _ATTN_REGISTRY[name] = fn
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True) -> jax.Array:
+    """[B, H, T, D] softmax attention.  XLA fuses the softmax chain; the
+    matmuls land on the MXU in bf16."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        t_q, t_k = q.shape[-2], k.shape[-2]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+register_attention("full", full_attention)
+
+
+def get_attention(name: str) -> AttnFn:
+    if name not in _ATTN_REGISTRY:
+        # Late registration: sequence-parallel impls live in parallel/.
+        if name in ("ring", "ulysses"):
+            import trustworthy_dl_tpu.parallel.sequence  # noqa: F401
+        if name not in _ATTN_REGISTRY:
+            raise ValueError(f"unknown attention impl {name!r}")
+    return _ATTN_REGISTRY[name]
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+
+def init_block_params(key: jax.Array, cfg: GPT2Config) -> Params:
+    ks = jax.random.split(key, 4)
+    d = cfg.n_embd
+    scale = 0.02
+    return {
+        "ln_1": L.layernorm_init(d),
+        "attn": {
+            "qkv": {
+                "w": L.uniform_scaling_init(ks[0], (d, 3 * d), scale),
+                "b": jnp.zeros((3 * d,), jnp.float32),
+            },
+            "proj": {
+                "w": L.uniform_scaling_init(
+                    ks[1], (d, d), scale / math.sqrt(2 * cfg.n_layer)
+                ),
+                "b": jnp.zeros((d,), jnp.float32),
+            },
+        },
+        "ln_2": L.layernorm_init(d),
+        "mlp": {
+            "fc": {
+                "w": L.uniform_scaling_init(ks[2], (d, 4 * d), scale),
+                "b": jnp.zeros((4 * d,), jnp.float32),
+            },
+            "proj": {
+                "w": L.uniform_scaling_init(
+                    ks[3], (4 * d, d), scale / math.sqrt(2 * cfg.n_layer)
+                ),
+                "b": jnp.zeros((d,), jnp.float32),
+            },
+        },
+    }
+
+
+def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
+    k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layer)
+    # Stacked blocks: every leaf has leading axis n_layer — the
+    # `transformer.h` equivalent, partitionable by slicing axis 0.
+    blocks = jax.vmap(lambda k: init_block_params(k, cfg))(block_keys)
+    return {
+        "wte": L.embedding_init(k_wte, cfg.vocab_size, cfg.n_embd),
+        "wpe": L.embedding_init(k_wpe, cfg.n_positions, cfg.n_embd),
+        "blocks": blocks,
+        "ln_f": L.layernorm_init(cfg.n_embd),
+        # lm_head is tied to wte (standard GPT-2 weight tying).
+    }
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+
+def block_forward(block: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """One transformer block on [B, T, D] activations."""
+    dtype = cfg.dtype
+    attn_fn = get_attention(cfg.attn_impl)
+    b, t, d = x.shape
+    h = cfg.n_head
+
+    y = L.layernorm(block["ln_1"], x).astype(dtype)
+    qkv = L.dense(block["attn"]["qkv"], y, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # [B, T, D] -> [B, H, T, D/H]
+    reshape = lambda a: a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3)
+    out = attn_fn(reshape(q), reshape(k), reshape(v), True)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    x = x + L.dense(block["attn"]["proj"], out, dtype).astype(x.dtype)
+
+    y = L.layernorm(block["ln_2"], x).astype(dtype)
+    y = L.dense(block["mlp"]["fc"], y, dtype)
+    y = jax.nn.gelu(y)
+    x = x + L.dense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+    return x
+
+
+def apply_blocks(blocks: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """Scan the stacked block params over the activations — one compiled
+    block body regardless of depth."""
+    body = block_forward
+    if cfg.remat:
+        body = jax.checkpoint(body, static_argnums=(2,))
+
+    def scan_fn(h, block):
+        return body(block, h, cfg), None
+
+    x, _ = jax.lax.scan(scan_fn, x, blocks)
+    return x
+
+
+def embed(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    t = tokens.shape[-1]
+    pos = jnp.arange(t)
+    x = params["wte"][tokens] + params["wpe"][pos]
+    return x.astype(jnp.float32)
+
+
+def unembed(params: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
+    x = L.layernorm(params["ln_f"], x)
+    return (x.astype(cfg.dtype) @ params["wte"].T.astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+
+
+def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] -> logits [B, T, vocab]."""
+    x = embed(params, tokens, cfg)
+    x = apply_blocks(params["blocks"], x, cfg)
+    return unembed(params, x, cfg)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: GPT2Config
+            ) -> jax.Array:
+    """Next-token cross entropy on {'input','target'} batches (targets are
+    the shifted stream, produced by data/loader.py)."""
+    logits = forward(params, batch["input"], cfg)
+    return L.cross_entropy_loss(logits, batch["target"])
+
+
+def num_params(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))

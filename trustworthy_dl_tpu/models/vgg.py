@@ -1,0 +1,90 @@
+"""VGG family (11 / 13 / 16) in pure JAX, NHWC (README.md:90-91).
+
+Convolution plans follow the standard configurations (A/B/D); the classifier
+head is size-adaptive (global average pool + linear) so the same model serves
+CIFAR-10 (32x32) and ImageNet-sized inputs without hardcoded flatten dims.
+GroupNorm replaces BatchNorm (see models/layers.py docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.models import layers as L
+
+Params = Dict[str, Any]
+
+# 'M' = maxpool; numbers = conv output channels.
+VGG_PLANS: Dict[str, Tuple[Union[int, str], ...]] = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    name: str = "vgg16"
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def from_name(name: str, num_classes: int = 10, **overrides: Any
+                  ) -> "VGGConfig":
+        key = name.lower()
+        if key not in VGG_PLANS:
+            raise ValueError(f"unknown vgg {name!r}")
+        return VGGConfig(name=key, num_classes=num_classes, **overrides)
+
+    @property
+    def plan(self) -> Tuple[Union[int, str], ...]:
+        return VGG_PLANS[self.name]
+
+
+def init_params(key: jax.Array, cfg: VGGConfig) -> Params:
+    convs = [c for c in cfg.plan if c != "M"]
+    keys = jax.random.split(key, len(convs) + 1)
+    params: Params = {"blocks": []}
+    cin = 3
+    ki = 0
+    for entry in cfg.plan:
+        if entry == "M":
+            continue
+        cout = int(entry)
+        params["blocks"].append(
+            {"conv": L.conv_init(keys[ki], 3, 3, cin, cout),
+             "gn": L.groupnorm_init(cout)}
+        )
+        cin = cout
+        ki += 1
+    params["head"] = L.dense_init(keys[-1], cin, cfg.num_classes, scale=0.01)
+    return params
+
+
+def forward(params: Params, x: jax.Array, cfg: VGGConfig) -> jax.Array:
+    dtype = cfg.dtype
+    y = x.astype(dtype)
+    bi = 0
+    for entry in cfg.plan:
+        if entry == "M":
+            # Guard tiny feature maps (CIFAR inputs hit 1x1 before plan end).
+            if y.shape[-3] >= 2 and y.shape[-2] >= 2:
+                y = L.max_pool(y, 2, 2)
+            continue
+        p = params["blocks"][bi]
+        y = jax.nn.relu(L.groupnorm(p["gn"], L.conv2d(p["conv"], y, 1, "SAME", dtype)))
+        bi += 1
+    pooled = L.avg_pool_global(y).astype(jnp.float32)
+    return L.dense(params["head"], pooled)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: VGGConfig
+            ) -> jax.Array:
+    logits = forward(params, batch["input"], cfg)
+    return L.cross_entropy_loss(logits, batch["target"])
