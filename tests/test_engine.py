@@ -1,0 +1,200 @@
+"""End-to-end engine tests — the simulated-cluster integration tier
+(SURVEY §4.2): real SPMD train step on the 8-device CPU mesh, injected
+attacks on nodes {1,3} (mirroring experiment_runner.py:93), assertions on
+detection, trust collapse, gating, and loss progress.
+
+Workloads are deliberately tiny (single-core CI box): a 2-layer GPT-2 is the
+main vehicle; ResNet-32 covers the vision/BASELINE-config-2 path with few
+steps."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker, null_plan
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer, TrainingState
+from trustworthy_dl_tpu.trust.state import NodeStatus
+
+TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+                seq_len=16)
+
+
+def gpt_trainer(tmp_path, num_nodes=8, **cfg_kwargs):
+    cfg_kwargs.setdefault("learning_rate", 3e-3)
+    cfg_kwargs.setdefault("detector_warmup", 4)
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=2 * num_nodes,
+        num_epochs=1, num_nodes=num_nodes, optimizer="adamw",
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        **cfg_kwargs,
+    )
+    return DistributedTrainer(config, model_overrides=dict(TINY_GPT))
+
+
+def gpt_loader(num_nodes=8, num_examples=96):
+    return get_dataloader("openwebtext", batch_size=2 * num_nodes, seq_len=16,
+                          vocab_size=128, num_examples=num_examples)
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """Clean tiny-GPT data-parallel run over 8 virtual devices."""
+    tmp_path = tmp_path_factory.mktemp("clean")
+    trainer = gpt_trainer(tmp_path)
+    dl = gpt_loader()
+    trainer.initialize()
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(4)]
+    return trainer, losses
+
+
+def test_clean_training_loss_decreases(clean_run):
+    trainer, losses = clean_run
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_clean_training_no_false_attacks(clean_run):
+    trainer, _ = clean_run
+    assert len(trainer.attack_history) == 0
+    assert trainer.training_state != TrainingState.UNDER_ATTACK
+    scores = [trainer.trust_manager.get_trust_score(i) for i in range(8)]
+    assert min(scores) > 0.6, scores
+
+
+def test_clean_training_stats_contract(clean_run):
+    trainer, _ = clean_run
+    stats = trainer.get_training_stats()
+    assert stats["attack_count"] == 0
+    assert stats["global_step"] == 24  # 4 epochs x 6 batches
+    assert set(stats["trust_scores"]) == set(range(8))
+    assert stats["metrics"]["num_batches"] == 24
+    assert "step_time" in stats["metrics"]
+
+
+@pytest.fixture(scope="module")
+def attacked_run(tmp_path_factory):
+    """ResNet-32/CIFAR-10 with gradient poisoning on nodes {1,3}
+    (BASELINE config 2 shape: poisoning + detector enabled)."""
+    tmp_path = tmp_path_factory.mktemp("attacked")
+    config = TrainingConfig(
+        model_name="resnet32", dataset_name="cifar10", batch_size=16,
+        learning_rate=5e-2, num_epochs=1, num_nodes=8, optimizer="sgd",
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4,
+    )
+    trainer = DistributedTrainer(config)
+    dl = get_dataloader("cifar10", batch_size=16, num_examples=160, seed=0)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[1, 3],
+                     intensity=0.5, start_step=12)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(2)]
+    return trainer, losses
+
+
+def test_attack_is_detected(attacked_run):
+    trainer, _ = attacked_run
+    attacked_nodes = {rec["node_id"] for rec in trainer.attack_history}
+    assert {1, 3} <= attacked_nodes, trainer.attack_history[:5]
+    # No false positives on clean nodes.
+    assert attacked_nodes <= {1, 3}
+
+
+def test_attacked_nodes_lose_trust_and_status(attacked_run):
+    trainer, _ = attacked_run
+    for node in (1, 3):
+        assert trainer.trust_manager.get_trust_score(node) < 0.3
+        assert trainer.trust_manager.get_node_status(node) == NodeStatus.COMPROMISED
+    for node in (0, 2, 4, 5, 6, 7):
+        assert trainer.trust_manager.get_trust_score(node) > 0.5
+
+
+def test_attacked_nodes_are_gated_on_device(attacked_run):
+    trainer, _ = attacked_run
+    dev_scores = np.asarray(trainer.state.trust.scores)
+    assert dev_scores[1] < 0.3 and dev_scores[3] < 0.3
+    status = np.asarray(trainer.state.trust.status)
+    assert status[1] == int(NodeStatus.COMPROMISED)
+
+
+def test_training_survives_attack(attacked_run):
+    trainer, losses = attacked_run
+    assert all(np.isfinite(l) for l in losses)
+    assert trainer.training_state in (
+        TrainingState.RECOVERING, TrainingState.COMPLETED,
+        TrainingState.UNDER_ATTACK,
+    )
+
+
+def test_reassignment_recorded(attacked_run):
+    trainer, _ = attacked_run
+    assert len(trainer.reassignment_history) >= 1
+    rec = trainer.reassignment_history[0]
+    assert rec["from_node"] in (1, 3)
+    assert rec["to_node"] not in (1, 3)
+    assert rec["migration_time"] > 2.0  # transfer + setup model
+
+
+def test_detection_disabled_no_verdicts(tmp_path):
+    trainer = gpt_trainer(tmp_path, num_nodes=4,
+                          attack_detection_enabled=False,
+                          gradient_verification_enabled=False)
+    dl = gpt_loader(num_nodes=4, num_examples=32)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[1],
+                     intensity=0.5, start_step=0)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    trainer.train_epoch(dl, 0)
+    assert len(trainer.attack_history) == 0  # nothing watches, nothing fires
+
+
+def test_checkpoint_round_trip_restores_trust_world(tmp_path):
+    trainer = gpt_trainer(tmp_path, num_nodes=4, detector_warmup=3)
+    dl = gpt_loader(num_nodes=4, num_examples=64)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[2],
+                     intensity=0.5, start_step=6)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    for epoch in range(2):
+        trainer.train_epoch(dl, epoch)
+    assert trainer.trust_manager.get_trust_score(2) < 0.3
+    path = trainer.save_checkpoint()
+    assert path
+
+    # Fresh trainer restores the full world-view, not just weights
+    # (SURVEY §3.5: resume must restore the trust world-view).
+    trainer2 = gpt_trainer(tmp_path, num_nodes=4, detector_warmup=3)
+    trainer2.initialize()
+    trainer2.load_checkpoint()
+    assert trainer2.global_step == trainer.global_step
+    assert trainer2.trust_manager.get_trust_score(2) < 0.3
+    assert trainer2.trust_manager.get_node_status(2) == NodeStatus.COMPROMISED
+    np.testing.assert_allclose(
+        np.asarray(trainer2.state.trust.scores),
+        np.asarray(trainer.state.trust.scores), rtol=1e-6,
+    )
+    # Detector baselines travel too.
+    np.testing.assert_array_equal(
+        np.asarray(trainer2.state.grad_baseline.count),
+        np.asarray(trainer.state.grad_baseline.count),
+    )
+
+
+def test_validate_runs(tmp_path):
+    trainer = gpt_trainer(tmp_path, num_nodes=4)
+    trainer.initialize()
+    val = gpt_loader(num_nodes=4, num_examples=32)
+    loss = trainer.validate(val)
+    assert np.isfinite(loss)

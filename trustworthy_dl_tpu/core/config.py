@@ -82,6 +82,10 @@ class TrainingConfig:
     detector_history: int = 1000       # rolling window (attack_detector.py:44)
     detector_warmup: int = 10          # min history before verdicts (:91,:126)
     checkpoint_dir: str = "checkpoints"
+    # Migration-time model rate for reassignment estimates.  The reference
+    # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer
+    # rides ICI, so measure and override (elastic/reassignment.py).
+    migration_gbps: float = 1.0
     # Optimizer
     optimizer: str = "adamw"
     weight_decay: float = 0.0

@@ -76,20 +76,24 @@ def build_mesh(
     axis = node_axis_for(parallelism)
     if num_nodes > n_dev:
         # Degenerate/dev mode: more logical nodes than devices.  The node
-        # axis still exists logically (vmapped), but the mesh carries every
-        # device on it only when divisible; otherwise run replicated.
+        # axis still exists logically (vmapped); the mesh axis takes the
+        # largest divisor of num_nodes that fits so [num_nodes, ...] arrays
+        # still shard evenly (worst case 1 → fully replicated execution).
+        fit = max(d for d in range(1, n_dev + 1) if num_nodes % d == 0)
         logger.warning(
-            "num_nodes=%d exceeds device count %d; building a %d-wide mesh "
-            "(logical nodes are vmapped within devices)", num_nodes, n_dev, n_dev
+            "num_nodes=%d exceeds device count %d; using a %d-wide mesh "
+            "(logical nodes are vmapped within devices)",
+            num_nodes, n_dev, fit,
         )
-        num_nodes = n_dev
+        num_nodes = fit
+    if axis == DATA_AXIS:
+        # Pure DP: the data axis IS the node axis — per-node arrays shard
+        # one (or an equal group of) logical node(s) per device.
+        arr = np.array(devices[:num_nodes])
+        return Mesh(arr, (DATA_AXIS,))
     usable = (n_dev // num_nodes) * num_nodes
     replicas = usable // num_nodes
     arr = np.array(devices[:usable]).reshape(replicas, num_nodes)
-    if axis == DATA_AXIS:
-        # Pure DP: fold replicas into the data axis itself.
-        arr = arr.reshape(replicas * num_nodes)
-        return Mesh(arr, (DATA_AXIS,))
     return Mesh(arr, (DATA_AXIS, axis))
 
 

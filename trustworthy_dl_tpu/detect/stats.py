@@ -96,6 +96,50 @@ def tensor_statistics(x: jax.Array, exact_order_stats: bool = True) -> jax.Array
     )
 
 
+def tensor_statistics_sampled(x: jax.Array, max_sort: int = 65536) -> jax.Array:
+    """f32[12] statistics with exact moments/extrema/norms over the full
+    tensor but order statistics (median/p25/p75) over a strided subsample of
+    at most ``max_sort`` elements.
+
+    This is the engine's hot-path variant: sorts dominate the detector cost
+    on TPU once tensors reach model-gradient sizes (SURVEY §7.4(2)); a fixed
+    deterministic subsample keeps the rolling baselines self-consistent, so
+    z-scores retain their meaning while the sort stays O(max_sort).
+    """
+    x = x.reshape(-1).astype(jnp.float32)
+    full = tensor_statistics(x, exact_order_stats=False)
+    n = x.shape[0]
+    if n <= max_sort:
+        sample = x
+    else:
+        stride = n // max_sort
+        sample = jax.lax.slice(x, (0,), (max_sort * stride,), (stride,))
+    median = jnp.median(sample)
+    p25 = jnp.percentile(sample, 25)
+    p75 = jnp.percentile(sample, 75)
+    idx_med = TENSOR_STAT_NAMES.index("median")
+    idx_p25 = TENSOR_STAT_NAMES.index("percentile_25")
+    idx_p75 = TENSOR_STAT_NAMES.index("percentile_75")
+    return full.at[idx_med].set(median).at[idx_p25].set(p25).at[idx_p75].set(p75)
+
+
+def chunked_cosine_mean(flat: jax.Array, chunks: int = 4) -> jax.Array:
+    """Mean pairwise cosine similarity among equal chunks of one flattened
+    gradient vector — the engine's O(P) stand-in for the reference's
+    O(k²·P) tensor-pairwise battery (attack_detector.py:225-239); it tracks
+    directional instability of the gradient within a step and feeds the same
+    'cosine_similarity' baseline column."""
+    n = flat.shape[0] // chunks
+    if n == 0:
+        return jnp.asarray(1.0, jnp.float32)
+    mat = flat[: n * chunks].reshape(chunks, n)
+    norms = jnp.sqrt(jnp.sum(mat * mat, axis=1))
+    normed = mat / jnp.maximum(norms, 1e-12)[:, None]
+    sim = normed @ normed.T
+    off = (jnp.sum(sim) - jnp.trace(sim)) / (chunks * (chunks - 1))
+    return off
+
+
 def _pairwise_cosine_mean(flat_grads: Sequence[jax.Array]) -> jax.Array:
     """Mean pairwise cosine similarity (attack_detector.py:225-239)."""
     k = len(flat_grads)

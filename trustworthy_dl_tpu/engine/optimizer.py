@@ -1,0 +1,31 @@
+"""Optimizer factory (optax) — replaces the reference's per-node
+torch.optim dict (distributed_trainer.py:90-91,441-446).
+
+One optimizer over the replicated params: gradients are already the
+trust-gated aggregate by the time they reach the update, which fixes the
+reference bug where ``optimizer_step`` ignored the verified gradients
+entirely (SURVEY §7.5)."""
+
+from __future__ import annotations
+
+import optax
+
+from trustworthy_dl_tpu.core.config import TrainingConfig
+
+
+def build_optimizer(config: TrainingConfig) -> optax.GradientTransformation:
+    chain = []
+    if config.grad_clip_norm and config.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(config.grad_clip_norm))
+    name = config.optimizer.lower()
+    if name == "adamw":
+        chain.append(
+            optax.adamw(config.learning_rate, weight_decay=config.weight_decay)
+        )
+    elif name == "adam":
+        chain.append(optax.adam(config.learning_rate))
+    elif name == "sgd":
+        chain.append(optax.sgd(config.learning_rate, momentum=0.9))
+    else:
+        raise ValueError(f"unknown optimizer {config.optimizer!r}")
+    return optax.chain(*chain)

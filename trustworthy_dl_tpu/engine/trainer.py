@@ -1,0 +1,415 @@
+"""DistributedTrainer — the L4 orchestrator, TPU-native.
+
+API parity with the reference trainer (distributed_trainer.py:63-527):
+``train`` / ``train_epoch`` / ``validate`` / ``get_training_stats`` /
+``save_checkpoint`` / ``load_checkpoint`` (new — the reference had no load
+path) / ``cleanup``, the same host-facing component objects (TrustManager,
+NodeMonitor, GradientVerifier, AttackDetector, MetricsCollector), and the
+same attack/reassignment bookkeeping.
+
+Execution is re-designed: instead of a sequential Python loop over node
+partitions (:148-175), every batch runs one jitted SPMD step
+(engine/step.py) over a device mesh; the host loop only feeds batches,
+reacts to verdicts (recording attack/reassignment history, flipping the
+TrainingState machine) and syncs reporting state at epoch cadence.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, null_plan
+from trustworthy_dl_tpu.core.config import NodeConfig, TrainingConfig
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
+from trustworthy_dl_tpu.detect.detector import AttackDetector, AttackType
+from trustworthy_dl_tpu.detect.verifier import GradientVerifier
+from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
+from trustworthy_dl_tpu.engine.optimizer import build_optimizer
+from trustworthy_dl_tpu.engine.state import TrainState, init_train_state
+from trustworthy_dl_tpu.engine.step import StepMetrics, build_eval_step, \
+    build_train_step
+from trustworthy_dl_tpu.models.factory import ModelFactory
+from trustworthy_dl_tpu.trust.manager import TrustManager
+from trustworthy_dl_tpu.trust.state import NodeStatus
+from trustworthy_dl_tpu.utils.metrics import MetricsCollector
+from trustworthy_dl_tpu.utils.monitor import NodeMonitor
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingState(enum.Enum):
+    """Trainer lifecycle (distributed_trainer.py:30-35)."""
+
+    INITIALIZING = "initializing"
+    TRAINING = "training"
+    UNDER_ATTACK = "under_attack"
+    RECOVERING = "recovering"
+    COMPLETED = "completed"
+
+
+class DistributedTrainer:
+    """Main distributed training orchestrator with adversarial attack
+    mitigation."""
+
+    def __init__(self, config: TrainingConfig,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 model_overrides: Optional[Dict[str, Any]] = None):
+        self.config = config
+        self.training_state = TrainingState.INITIALIZING
+        self.current_epoch = 0
+        self.global_step = 0
+
+        # Host-facing components (reference: distributed_trainer.py:74-84).
+        self.trust_manager = TrustManager(
+            num_nodes=config.num_nodes,
+            trust_threshold=config.trust_threshold,
+            initial_trust=config.initial_trust,
+            decay_rate=config.trust_decay_rate,
+            recovery_rate=config.trust_recovery_rate,
+            alpha=config.trust_alpha,
+        )
+        self.node_monitor = NodeMonitor()
+        self.gradient_verifier = GradientVerifier()
+        self.attack_detector = AttackDetector(
+            exact_order_stats=config.exact_order_stats
+        )
+        self.metrics_collector = MetricsCollector()
+
+        # Node configurations (reference: :85-87).  On TPU, rank == mesh
+        # coordinate along the node axis.
+        self.node_configs: Dict[int, NodeConfig] = {
+            i: NodeConfig(node_id=i, rank=i, world_size=config.num_nodes,
+                          device_id=i, model_partition=f"shard_{i}")
+            for i in range(config.num_nodes)
+        }
+
+        self.attack_history: List[Dict] = []
+        self.reassignment_history: List[Dict] = []
+        # Nodes currently in a recorded-compromised episode: a sustained
+        # attack fires the detector every batch, but we record the incident
+        # and trigger reassignment only on the clean→compromised transition
+        # (the reference re-records per batch, which grows history without
+        # bound on long runs).
+        self._open_incidents: set = set()
+
+        # Model / optimizer / mesh / step.
+        self.model = ModelFactory().create_model(
+            config.model_name, **(model_overrides or {})
+        )
+        self.optimizer = build_optimizer(config)
+        self.mesh = mesh if mesh is not None else build_mesh(
+            config.num_nodes, config.parallelism, config.mesh_shape
+        )
+        self._train_step = jax.jit(
+            build_train_step(self.model, config, self.optimizer),
+            donate_argnums=(0,),
+        )
+        self._eval_step = jax.jit(build_eval_step(self.model))
+        self.checkpointer = CheckpointManager(config.checkpoint_dir)
+
+        self.state: Optional[TrainState] = None
+        self.attack_plan: AttackPlan = null_plan(config.num_nodes)
+        logger.info(
+            "Initialized DistributedTrainer with %d nodes (%s parallelism, "
+            "mesh %s)", config.num_nodes, config.parallelism,
+            dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
+        )
+
+    # ------------------------------------------------------------------
+    # Setup
+    # ------------------------------------------------------------------
+
+    def initialize(self, seed: Optional[int] = None) -> TrainState:
+        """Init params/optimizer/world-view.  Params are replicated over the
+        mesh; per-node batches shard over the data axis."""
+        seed = self.config.seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
+        k_params, k_state = jax.random.split(rng)
+        params = self.model.init(k_params)
+        opt_state = self.optimizer.init(params)
+        self.state = init_train_state(
+            k_state, params, opt_state,
+            num_nodes=self.config.num_nodes,
+            trust_threshold=self.config.trust_threshold,
+            initial_trust=self.config.initial_trust,
+            decay_rate=self.config.trust_decay_rate,
+            recovery_rate=self.config.trust_recovery_rate,
+            detector_window=self.config.detector_history,
+        )
+        if DATA_AXIS in self.mesh.axis_names and self.mesh.size > 1:
+            replicated = NamedSharding(self.mesh, P())
+            self.state = jax.device_put(self.state, replicated)
+        self.training_state = TrainingState.TRAINING
+        return self.state
+
+    def set_attack_plan(self, plan: AttackPlan) -> None:
+        """Install the experiment's fault-injection schedule."""
+        self.attack_plan = plan
+
+    # ------------------------------------------------------------------
+    # Batch plumbing
+    # ------------------------------------------------------------------
+
+    def _node_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        """[B, ...] -> [n, B//n, ...] with the node axis laid over the
+        mesh's data axis — the reference's per-node data split, as sharding."""
+        n = self.config.num_nodes
+        out = {}
+        for key, arr in batch.items():
+            b = (arr.shape[0] // n) * n
+            if b == 0:
+                raise ValueError(
+                    f"batch size {arr.shape[0]} < num_nodes {n}"
+                )
+            reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
+            data_size = dict(
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+            ).get(DATA_AXIS, 1)
+            if data_size > 1 and n % data_size == 0:
+                sharding = NamedSharding(
+                    self.mesh, P(DATA_AXIS, *([None] * (reshaped.ndim - 1)))
+                )
+                out[key] = jax.device_put(reshaped, sharding)
+            else:
+                out[key] = jnp.asarray(reshaped)
+        return out
+
+    # ------------------------------------------------------------------
+    # Training (distributed_trainer.py:382-433,465-492)
+    # ------------------------------------------------------------------
+
+    def train_epoch(self, dataloader: Iterable[Dict[str, np.ndarray]],
+                    epoch: int) -> float:
+        if self.state is None:
+            self.initialize()
+        self.current_epoch = epoch
+        epoch_loss, num_batches = 0.0, 0
+
+        for batch_idx, batch in enumerate(dataloader):
+            self.global_step += 1
+            node_batch = self._node_batch(batch)
+            self.state, metrics = self._train_step(
+                self.state, node_batch, self.attack_plan
+            )
+            self.metrics_collector.tick()
+            loss = float(metrics.loss)
+            self._record_batch(metrics, epoch, loss)
+            epoch_loss += loss
+            num_batches += 1
+
+            if self.global_step % self.config.checkpoint_interval == 0:
+                self.save_checkpoint()
+            if batch_idx % 10 == 0:
+                logger.info("Epoch %d, Batch %d, Loss: %.4f",
+                            epoch, batch_idx, loss)
+
+        # Epoch-cadence host sync: reporting objects absorb device state.
+        self.sync_host_state()
+        avg = epoch_loss / max(num_batches, 1)
+        logger.info("Epoch %d completed. Average loss: %.4f", epoch, avg)
+        return avg
+
+    def _record_batch(self, metrics: StepMetrics, epoch: int, loss: float
+                      ) -> None:
+        attacked = np.asarray(metrics.attacked)
+        verified = np.asarray(metrics.verified)
+        trust = np.asarray(metrics.trust_scores)
+        self.metrics_collector.collect_batch_metrics(
+            {
+                "loss": loss,
+                "step": self.global_step,
+                "epoch": epoch,
+                "trust_scores": {i: float(trust[i]) for i in range(len(trust))},
+            }
+        )
+        flagged = attacked | ~verified
+        # Close incidents for nodes the device-side state machine has
+        # rehabilitated, so a later re-attack records a fresh incident.
+        status = np.asarray(metrics.status)
+        for node_id in list(self._open_incidents):
+            if not flagged[node_id] and status[node_id] != int(
+                NodeStatus.COMPROMISED
+            ):
+                self._open_incidents.discard(node_id)
+        if flagged.any():
+            types = np.asarray(metrics.attack_type)
+            for node_id in np.nonzero(flagged)[0]:
+                if int(node_id) in self._open_incidents:
+                    continue
+                self._open_incidents.add(int(node_id))
+                self._handle_detected_attack(
+                    int(node_id),
+                    attack_type=AttackType(int(types[node_id])).label
+                    if attacked[node_id] else "gradient_verification_failure",
+                    metrics=metrics,
+                )
+
+    def _handle_detected_attack(self, node_id: int, attack_type: str,
+                                metrics: StepMetrics) -> None:
+        """Host-side reaction (distributed_trainer.py:273-322): record the
+        incident, mirror compromise into the host TrustManager, trigger
+        reassignment.  The in-step mitigation (grad gating) already happened
+        on device in the same step."""
+        logger.error("Attack detected on node %d (%s)", node_id, attack_type)
+        self.attack_history.append(
+            {
+                "node_id": node_id,
+                "timestamp": time.time(),
+                "step": self.global_step,
+                "attack_type": attack_type,
+                "output_stats": {
+                    "anomaly_score": float(np.asarray(metrics.out_score)[node_id]),
+                    "gradient_score": float(np.asarray(metrics.grad_score)[node_id]),
+                },
+            }
+        )
+        self.trust_manager.mark_compromised(node_id, attack_type)
+        self.reassign_node_tasks(node_id)
+        self.training_state = TrainingState.UNDER_ATTACK
+
+    # ------------------------------------------------------------------
+    # Reassignment (distributed_trainer.py:324-380)
+    # ------------------------------------------------------------------
+
+    def reassign_node_tasks(self, compromised_node_id: int) -> None:
+        trusted = self.trust_manager.get_trusted_nodes()
+        trusted = [n for n in trusted if n != compromised_node_id]
+        if not trusted:
+            logger.error("No trusted nodes available for reassignment")
+            return
+        best = max(trusted, key=self.trust_manager.get_trust_score)
+        migration_time = self.estimate_migration_time(compromised_node_id, best)
+        self.perform_task_reassignment(compromised_node_id, best)
+        self.reassignment_history.append(
+            {
+                "from_node": compromised_node_id,
+                "to_node": best,
+                "timestamp": time.time(),
+                "migration_time": migration_time,
+                "step": self.global_step,
+            }
+        )
+
+    def estimate_migration_time(self, source_node: int, target_node: int
+                                ) -> float:
+        """Migration model (distributed_trainer.py:354-365): bytes / rate +
+        setup.  The reference hardcodes 1 GB/s + 2 s — on TPU the transfer
+        rides ICI, so the rate is configurable via ``migration_gbps`` (the
+        elastic subsystem measures it; see elastic/reassignment.py)."""
+        if self.state is None:
+            return 2.0
+        n_params = self.model.num_params(self.state.params)
+        # In data-parallel the migrating unit is the node's optimizer+param
+        # replica share; in stage parallel it is the stage slice.
+        shard = n_params / max(self.config.num_nodes, 1)
+        transfer = shard * 4 / (self.config.migration_gbps * 1024**3)
+        return transfer + 2.0
+
+    def perform_task_reassignment(self, source_node: int, target_node: int
+                                  ) -> None:
+        """In SPMD data-parallel the compromised node's contribution is
+        already zero-weighted inside the step (the immediate mitigation,
+        SURVEY §5.3); reassignment relabels the shard ownership so the
+        recovered data shard flows to the target node.  Real device-set
+        resharding lives in elastic/reassignment.py."""
+        self.node_configs[target_node].model_partition = (
+            f"shard_{source_node}+{self.node_configs[target_node].model_partition}"
+        )
+        logger.info("Task reassignment completed: %d -> %d",
+                    source_node, target_node)
+
+    # ------------------------------------------------------------------
+    # Epochs / validation / stats
+    # ------------------------------------------------------------------
+
+    def train(self, train_dataloader, val_dataloader=None,
+              num_epochs: Optional[int] = None) -> Dict[str, Any]:
+        if num_epochs is None:
+            num_epochs = self.config.num_epochs
+        logger.info("Starting training for %d epochs", num_epochs)
+        if self.state is None:
+            self.initialize()
+        self.training_state = TrainingState.TRAINING
+        history = []
+        for epoch in range(num_epochs):
+            avg_loss = self.train_epoch(train_dataloader, epoch)
+            record = {"epoch": epoch, "train_loss": avg_loss}
+            if val_dataloader is not None:
+                val = self.validate(val_dataloader)
+                record.update(val_loss=val)
+                logger.info("Validation loss: %.4f", val)
+            if self.training_state == TrainingState.UNDER_ATTACK:
+                logger.info(
+                    "Training under attack - implementing recovery measures"
+                )
+                self.training_state = TrainingState.RECOVERING
+            history.append(record)
+        self.training_state = TrainingState.COMPLETED
+        logger.info("Training completed successfully")
+        return {"epochs": history, "stats": self.get_training_stats()}
+
+    def validate(self, val_dataloader) -> float:
+        total, batches = 0.0, 0
+        for batch in val_dataloader:
+            out = self._eval_step(
+                self.state.params,
+                {k: jnp.asarray(v) for k, v in batch.items()},
+            )
+            total += float(out["loss"])
+            batches += 1
+        return total / max(batches, 1)
+
+    def sync_host_state(self) -> None:
+        """Epoch-cadence absorption of device state into the host reporting
+        objects (TrustManager / NodeMonitor)."""
+        if self.state is None:
+            return
+        self.trust_manager.sync_from_device(self.state.trust)
+        self.node_monitor.sync_from_device(self.state.monitor)
+
+    def get_training_stats(self) -> Dict[str, Any]:
+        """distributed_trainer.py:510-521."""
+        return {
+            "current_epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "training_state": self.training_state.value,
+            "trust_scores": {
+                i: self.trust_manager.get_trust_score(i)
+                for i in range(self.config.num_nodes)
+            },
+            "attack_count": len(self.attack_history),
+            "reassignment_count": len(self.reassignment_history),
+            "metrics": self.metrics_collector.get_summary(),
+        }
+
+    # ------------------------------------------------------------------
+    # Checkpointing (distributed_trainer.py:448-463 + restore, new)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self) -> Optional[str]:
+        if self.state is None:
+            return None
+        return self.checkpointer.save(self.state, self.global_step)
+
+    def load_checkpoint(self, step: Optional[int] = None) -> TrainState:
+        """Restore the full world-view — weights AND trust state — then
+        mirror into the host objects."""
+        if self.state is None:
+            self.initialize()
+        self.state = self.checkpointer.restore(self.state, step)
+        self.global_step = int(self.state.step)
+        self.sync_host_state()
+        return self.state
+
+    def cleanup(self) -> None:
+        """distributed_trainer.py:523-527."""
+        self.state = None
+        logger.info("Distributed training cleanup completed")

@@ -1,0 +1,4 @@
+from trustworthy_dl_tpu.utils.metrics import MetricsCollector
+from trustworthy_dl_tpu.utils.monitor import NodeMonitor
+
+__all__ = ["MetricsCollector", "NodeMonitor"]

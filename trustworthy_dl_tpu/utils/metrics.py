@@ -1,0 +1,75 @@
+"""MetricsCollector — the implied ``utils.metrics`` module (imported at
+distributed_trainer.py:23, experiment_runner.py:25; call sites
+collect_batch_metrics at distributed_trainer.py:417 and get_summary at
+:520)."""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class MetricsCollector:
+    """Accumulates per-batch metric dicts and summarises them."""
+
+    def __init__(self, max_records: int = 100_000):
+        self.max_records = max_records
+        self.batch_metrics: List[Dict[str, Any]] = []
+        self.epoch_metrics: List[Dict[str, Any]] = []
+        self._step_times: List[float] = []
+        self._last_tick: Optional[float] = None
+
+    def collect_batch_metrics(self, metrics: Dict[str, Any]) -> None:
+        if len(self.batch_metrics) >= self.max_records:
+            self.batch_metrics.pop(0)
+        record = dict(metrics)
+        record.setdefault("timestamp", time.time())
+        self.batch_metrics.append(record)
+
+    def collect_epoch_metrics(self, metrics: Dict[str, Any]) -> None:
+        record = dict(metrics)
+        record.setdefault("timestamp", time.time())
+        self.epoch_metrics.append(record)
+
+    def tick(self) -> None:
+        """Step-time histogram support (SURVEY §5.1)."""
+        now = time.perf_counter()
+        if self._last_tick is not None:
+            self._step_times.append(now - self._last_tick)
+        self._last_tick = now
+
+    def step_time_stats(self) -> Dict[str, float]:
+        if not self._step_times:
+            return {}
+        arr = np.array(self._step_times)
+        return {
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+            "max_s": float(arr.max()),
+            "count": int(arr.size),
+        }
+
+    def get_summary(self) -> Dict[str, Any]:
+        summary: Dict[str, Any] = {
+            "num_batches": len(self.batch_metrics),
+            "num_epochs": len(self.epoch_metrics),
+        }
+        losses = [m["loss"] for m in self.batch_metrics if "loss" in m]
+        if losses:
+            summary["mean_loss"] = float(np.mean(losses))
+            summary["final_loss"] = float(losses[-1])
+            summary["min_loss"] = float(np.min(losses))
+        st = self.step_time_stats()
+        if st:
+            summary["step_time"] = st
+        return summary
+
+    def reset(self) -> None:
+        self.batch_metrics.clear()
+        self.epoch_metrics.clear()
+        self._step_times.clear()
+        self._last_tick = None

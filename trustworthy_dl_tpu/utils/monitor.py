@@ -1,0 +1,74 @@
+"""NodeMonitor — the implied ``core.node_monitor`` module (imported at
+distributed_trainer.py:20; call sites get_expected_mean/std at :234-235 and
+get_expected_gradient_norms at :259).
+
+The live expected-behaviour statistics are computed inside the train step as
+``MonitorState`` (engine/state.py); this host class mirrors that state for
+the reference API and for host-driven loops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class NodeMonitor:
+    """Per-node expected output/gradient behaviour (running averages)."""
+
+    def __init__(self, warmup: int = 5):
+        self.warmup = warmup
+        self._count: Dict[int, int] = {}
+        self._mean_avg: Dict[int, float] = {}
+        self._std_avg: Dict[int, float] = {}
+        self._grad_norms_avg: Dict[int, np.ndarray] = {}
+
+    # -- absorption --------------------------------------------------------
+
+    def observe_output(self, node_id: int, mean: float, std: float) -> None:
+        c = self._count.get(node_id, 0) + 1
+        w = 1.0 / c
+        self._mean_avg[node_id] = self._mean_avg.get(node_id, 0.0) * (1 - w) + mean * w
+        self._std_avg[node_id] = self._std_avg.get(node_id, 0.0) * (1 - w) + std * w
+        self._count[node_id] = c
+
+    def observe_gradient_norms(self, node_id: int, norms: List[float]) -> None:
+        arr = np.asarray(norms, np.float64)
+        prev = self._grad_norms_avg.get(node_id)
+        c = self._count.get(node_id, 1)
+        if prev is None or prev.shape != arr.shape:
+            self._grad_norms_avg[node_id] = arr
+        else:
+            w = 1.0 / max(c, 1)
+            self._grad_norms_avg[node_id] = prev * (1 - w) + arr * w
+
+    def sync_from_device(self, monitor_state) -> None:
+        """Absorb an engine MonitorState pytree."""
+        counts = np.asarray(monitor_state.count)
+        means = np.asarray(monitor_state.out_mean_avg)
+        stds = np.asarray(monitor_state.out_std_avg)
+        norms = np.asarray(monitor_state.grad_norm_avg)
+        for i in range(counts.shape[0]):
+            self._count[i] = int(counts[i])
+            self._mean_avg[i] = float(means[i])
+            self._std_avg[i] = float(stds[i])
+            self._grad_norms_avg[i] = norms[i].astype(np.float64)
+
+    # -- reference API -----------------------------------------------------
+
+    def get_expected_mean(self, node_id: int) -> Optional[float]:
+        if self._count.get(node_id, 0) < self.warmup:
+            return None
+        return self._mean_avg.get(node_id)
+
+    def get_expected_std(self, node_id: int) -> Optional[float]:
+        if self._count.get(node_id, 0) < self.warmup:
+            return None
+        return self._std_avg.get(node_id)
+
+    def get_expected_gradient_norms(self, node_id: int) -> List[float]:
+        if self._count.get(node_id, 0) < self.warmup:
+            return []
+        arr = self._grad_norms_avg.get(node_id)
+        return [] if arr is None else [float(v) for v in arr]
