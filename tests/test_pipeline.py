@@ -1,0 +1,155 @@
+"""Pipeline (stage) parallelism: numerical equivalence with the sequential
+model, GPipe schedule on a real 8-stage mesh, per-stage detection and
+trust-gated stage freezing (distributed_trainer.py:124-175 re-designed)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.core.mesh import build_mesh
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.models import create_model
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.parallel.pipeline import (
+    build_pipeline_apply,
+    stack_stages,
+    unstack_stages,
+)
+from trustworthy_dl_tpu.trust.state import NodeStatus
+
+TINY = dict(n_layer=8, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+
+
+def test_stack_unstack_round_trip():
+    bundle = create_model("gpt2", **TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    stacked = stack_stages(params["blocks"], 4)
+    leaves = jax.tree_util.tree_leaves(stacked)
+    assert all(l.shape[:2] == (4, 2) for l in leaves)
+    back = unstack_stages(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(params["blocks"]),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipeline_matches_sequential_forward():
+    """The 8-stage GPipe schedule must produce exactly the sequential
+    model's activations (ring rotation + microbatching is a pure
+    reordering)."""
+    bundle = create_model("gpt2", **TINY)
+    cfg = bundle.config
+    params = bundle.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+
+    expected = bundle.apply(params, tokens)  # sequential reference
+
+    mesh = build_mesh(8, "model")
+    stacked = stack_stages(params["blocks"], 8)
+    pipe = build_pipeline_apply(cfg, mesh, num_stages=8, num_microbatches=2)
+    x = gpt2.embed(params, tokens, cfg)
+    x_mb = x.reshape(2, 2, 16, 32)
+    y_mb, stage_stats, act_mean, act_std = jax.jit(pipe)(stacked, x_mb)
+    y = y_mb.reshape(4, 16, 32)
+    got = gpt2.unembed(params, y, cfg)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-2, atol=2e-2)
+    assert stage_stats.shape == (8, 17)
+    assert act_mean.shape == (8,)
+    # Each stage saw both microbatches: stats are finite and non-degenerate.
+    assert np.all(np.isfinite(np.asarray(stage_stats)[:, :12]))
+
+
+def test_pipeline_grads_match_sequential():
+    bundle = create_model("gpt2", **TINY)
+    cfg = bundle.config
+    params = bundle.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 128)
+    batch = {"input": tokens[:, :-1], "target": tokens[:, 1:]}
+
+    seq_grads = jax.grad(bundle.loss)(params, batch)
+
+    mesh = build_mesh(4, "model")
+    pipe = build_pipeline_apply(cfg, mesh, num_stages=4, num_microbatches=2)
+
+    def pipe_loss(p, b):
+        x = gpt2.embed(p, b["input"], cfg)
+        bs, t, d = x.shape
+        y_mb, _, _, _ = pipe(p["blocks"], x.reshape(2, bs // 2, t, d))
+        logits = gpt2.unembed(p, y_mb.reshape(bs, t, d), cfg)
+        from trustworthy_dl_tpu.models import layers as L
+
+        return L.cross_entropy_loss(logits, b["target"])
+
+    stacked_params = dict(params)
+    stacked_params["blocks"] = stack_stages(params["blocks"], 4)
+    pipe_grads = jax.jit(jax.grad(pipe_loss))(stacked_params, batch)
+    pipe_grads_blocks = unstack_stages(pipe_grads["blocks"])
+
+    for a, b in zip(jax.tree_util.tree_leaves(seq_grads["blocks"]),
+                    jax.tree_util.tree_leaves(pipe_grads_blocks)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-3)
+    # Embedding grads flow through the pipeline too.
+    np.testing.assert_allclose(np.asarray(seq_grads["wte"]),
+                               np.asarray(pipe_grads["wte"]),
+                               rtol=5e-2, atol=5e-3)
+
+
+@pytest.fixture(scope="module")
+def pipeline_attack_run(tmp_path_factory):
+    """GPT-2 8-stage pipeline with a poisoned stage — BASELINE config 3/4
+    shape (model-parallel + compromised-node reassignment)."""
+    tmp_path = tmp_path_factory.mktemp("pipe")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_epochs=1, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=64)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[5],
+                     intensity=0.5, start_step=8)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(2)]
+    return trainer, losses
+
+
+def test_pipeline_training_loss_decreases(pipeline_attack_run):
+    trainer, losses = pipeline_attack_run
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_stage_attack_detected(pipeline_attack_run):
+    trainer, _ = pipeline_attack_run
+    attacked = {rec["node_id"] for rec in trainer.attack_history}
+    assert 5 in attacked, trainer.attack_history[:3]
+    assert attacked <= {5}
+    assert trainer.trust_manager.get_trust_score(5) < 0.3
+    assert trainer.trust_manager.get_node_status(5) == NodeStatus.COMPROMISED
+
+
+def test_pipeline_clean_stages_unaffected(pipeline_attack_run):
+    trainer, _ = pipeline_attack_run
+    for stage in (0, 1, 2, 3, 4, 6, 7):
+        assert trainer.trust_manager.get_trust_score(stage) > 0.5
+
+
+def test_pipeline_validate(pipeline_attack_run):
+    trainer, _ = pipeline_attack_run
+    val = get_dataloader("openwebtext", split="validation", batch_size=8,
+                         seq_len=16, vocab_size=128, num_examples=16)
+    assert np.isfinite(trainer.validate(val))

@@ -95,8 +95,16 @@ def init_train_state(
     decay_rate: float = 0.01,
     recovery_rate: float = 0.005,
     detector_window: int = 1000,
+    num_monitor_leaves: Optional[int] = None,
 ) -> TrainState:
-    num_leaves = len(jax.tree_util.tree_leaves(params))
+    """``num_monitor_leaves`` overrides the per-node gradient-norm vector
+    width (pipeline mode monitors only each stage's block-slice leaves,
+    not the full param tree)."""
+    num_leaves = (
+        num_monitor_leaves
+        if num_monitor_leaves is not None
+        else len(jax.tree_util.tree_leaves(params))
+    )
     return TrainState(
         params=params,
         opt_state=opt_state,

@@ -108,11 +108,26 @@ class DistributedTrainer:
         self.mesh = mesh if mesh is not None else build_mesh(
             config.num_nodes, config.parallelism, config.mesh_shape
         )
-        self._train_step = jax.jit(
-            build_train_step(self.model, config, self.optimizer),
-            donate_argnums=(0,),
-        )
-        self._eval_step = jax.jit(build_eval_step(self.model))
+        if config.parallelism == "model":
+            from trustworthy_dl_tpu.parallel.pipeline import (
+                build_pipeline_eval_step,
+                build_pipeline_train_step,
+            )
+
+            self._train_step = jax.jit(
+                build_pipeline_train_step(self.model, config, self.optimizer,
+                                          self.mesh),
+                donate_argnums=(0,),
+            )
+            self._eval_step = jax.jit(
+                build_pipeline_eval_step(self.model, config, self.mesh)
+            )
+        else:
+            self._train_step = jax.jit(
+                build_train_step(self.model, config, self.optimizer),
+                donate_argnums=(0,),
+            )
+            self._eval_step = jax.jit(build_eval_step(self.model))
         self.checkpointer = CheckpointManager(config.checkpoint_dir)
 
         self.state: Optional[TrainState] = None
@@ -134,6 +149,23 @@ class DistributedTrainer:
         rng = jax.random.PRNGKey(seed)
         k_params, k_state = jax.random.split(rng)
         params = self.model.init(k_params)
+        num_monitor_leaves = None
+        if self.config.parallelism == "model":
+            # Stage-major stacking: [L, ...] -> [S, L/S, ...], sharded over
+            # the 'stage' mesh axis — the reference's layer partitioning
+            # (distributed_trainer.py:126-134) as a sharding.
+            from trustworthy_dl_tpu.parallel.pipeline import stack_stages
+
+            params = dict(params)
+            params["blocks"] = stack_stages(params["blocks"],
+                                            self.config.num_nodes)
+            num_monitor_leaves = len(
+                jax.tree_util.tree_leaves(params["blocks"])
+            )
+            stage_sharding = NamedSharding(self.mesh, P("stage"))
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, stage_sharding), params["blocks"]
+            )
         opt_state = self.optimizer.init(params)
         self.state = init_train_state(
             k_state, params, opt_state,
@@ -143,10 +175,8 @@ class DistributedTrainer:
             decay_rate=self.config.trust_decay_rate,
             recovery_rate=self.config.trust_recovery_rate,
             detector_window=self.config.detector_history,
+            num_monitor_leaves=num_monitor_leaves,
         )
-        if DATA_AXIS in self.mesh.axis_names and self.mesh.size > 1:
-            replicated = NamedSharding(self.mesh, P())
-            self.state = jax.device_put(self.state, replicated)
         self.training_state = TrainingState.TRAINING
         return self.state
 
@@ -160,7 +190,20 @@ class DistributedTrainer:
 
     def _node_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         """[B, ...] -> [n, B//n, ...] with the node axis laid over the
-        mesh's data axis — the reference's per-node data split, as sharding."""
+        mesh's data axis — the reference's per-node data split, as sharding.
+        Pipeline mode keeps the global batch (microbatching is internal) but
+        trims B to a multiple of num_microbatches."""
+        if self.config.parallelism == "model":
+            m = self.config.num_microbatches
+            out = {}
+            for key, arr in batch.items():
+                b = (arr.shape[0] // m) * m
+                if b == 0:
+                    raise ValueError(
+                        f"batch size {arr.shape[0]} < num_microbatches {m}"
+                    )
+                out[key] = jnp.asarray(np.asarray(arr[:b]))
+            return out
         n = self.config.num_nodes
         out = {}
         for key, arr in batch.items():
@@ -359,10 +402,11 @@ class DistributedTrainer:
     def validate(self, val_dataloader) -> float:
         total, batches = 0.0, 0
         for batch in val_dataloader:
-            out = self._eval_step(
-                self.state.params,
-                {k: jnp.asarray(v) for k, v in batch.items()},
-            )
+            if self.config.parallelism == "model":
+                batch = self._node_batch(batch)  # trims to microbatch multiple
+            else:
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            out = self._eval_step(self.state.params, batch)
             total += float(out["loss"])
             batches += 1
         return total / max(batches, 1)

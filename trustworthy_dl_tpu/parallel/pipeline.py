@@ -1,0 +1,376 @@
+"""Pipeline (stage) parallelism — the reference's one real strategy,
+TPU-native.
+
+The reference splits ``transformer.h`` into contiguous per-node chunks and
+runs them in a *sequential Python loop in one process*
+(distributed_trainer.py:124-135, 148-175).  Here the same partitioning is an
+SPMD program: stacked block params [L, ...] reshape to [S, L/S, ...] and
+shard over the mesh's 'stage' axis; a GPipe microbatch schedule runs inside
+``shard_map``, rotating activations to the next stage with ``lax.ppermute``
+each tick.  The backward schedule is not hand-written — JAX transposes the
+``ppermute`` under ``jax.grad``, so reverse-mode AD *is* the backward
+pipeline.
+
+Per-stage trust integration:
+  * each stage computes the detector battery over its boundary activations
+    (masked mean over its active ticks) — the pipeline analogue of the
+    reference's per-node ``detect_output_anomaly`` hook (:168-170);
+  * per-stage gradient batteries come from the [S, ...] leading axis of the
+    block gradients;
+  * the trust gate zeroes a compromised stage's *parameter updates* (its
+    layers freeze until reassignment) — unlike the reference, which silently
+    drops compromised layers from the forward pass and corrupts the model
+    (:154-157, flagged in SURVEY §7.5).
+  * the cross-sectional outlier filter used in data-parallel mode is OFF
+    here: different stages legitimately have different activation
+    distributions, so only temporal z-scores apply (SURVEY §7.4(4)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, poison_gradients
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.core.mesh import STAGE_AXIS
+from trustworthy_dl_tpu.detect import baseline as bl
+from trustworthy_dl_tpu.detect import stats as st
+from trustworthy_dl_tpu.detect.detector import anomaly_verdicts
+from trustworthy_dl_tpu.detect.verifier import verify_gradients_array
+from trustworthy_dl_tpu.engine.state import TrainState, update_monitor
+from trustworthy_dl_tpu.engine.step import StepMetrics, _gradient_stat_vector
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models import layers as L
+from trustworthy_dl_tpu.trust import state as ts
+
+Array = jax.Array
+
+
+def stack_stages(blocks: Any, num_stages: int) -> Any:
+    """[L, ...] stacked blocks -> [S, L/S, ...] stage-major stacking — the
+    TPU analogue of the reference's contiguous layer chunks
+    (distributed_trainer.py:126-134)."""
+    def reshape(leaf):
+        l = leaf.shape[0]
+        if l % num_stages:
+            raise ValueError(
+                f"{l} layers not divisible by {num_stages} stages"
+            )
+        return leaf.reshape((num_stages, l // num_stages) + leaf.shape[1:])
+    return jax.tree_util.tree_map(reshape, blocks)
+
+
+def unstack_stages(blocks: Any) -> Any:
+    """Inverse of stack_stages."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:]),
+        blocks,
+    )
+
+
+def _right_rotation(axis: str, size: int):
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def build_pipeline_apply(
+    cfg: gpt2.GPT2Config,
+    mesh: Mesh,
+    num_stages: int,
+    num_microbatches: int,
+    max_sort: int = 65536,
+) -> Callable[[Any, Array], Tuple[Array, Array, Array, Array]]:
+    """Returns pipe_apply(stage_blocks, x_microbatches) ->
+    (y_microbatches, stage_stats[S,17], act_mean[S], act_std[S]).
+
+    ``stage_blocks`` leaves are [S, L/S, ...] (sharded P('stage')),
+    ``x_microbatches`` is [M, mb, T, D] (replicated).  The schedule runs
+    M + S - 1 ticks; each tick every stage applies its layer slice to its
+    current activation and passes it right around the ring.
+    """
+    S, M = num_stages, num_microbatches
+    total_ticks = M + S - 1
+
+    def apply_local(local_blocks, x):
+        def body(h, block):
+            return gpt2.block_forward(block, h, cfg), None
+        y, _ = jax.lax.scan(body, x, local_blocks)
+        return y
+
+    def pipe_local(local_blocks, x_mb):
+        # Inside shard_map: local_blocks [1, L/S, ...] (this stage's slice),
+        # x_mb [M, mb, T, D] (full, replicated).
+        local_blocks = jax.tree_util.tree_map(lambda a: a[0], local_blocks)
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        mb_shape = x_mb.shape[1:]
+        state0 = jnp.zeros(mb_shape, x_mb.dtype)
+        outputs0 = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        # Sufficient statistics of boundary activations over active ticks.
+        stats0 = jnp.zeros((st.NUM_GRADIENT_STATS,), jnp.float32)
+        acc0 = (state0, outputs0, stats0, jnp.zeros((), jnp.float32),
+                jnp.asarray(0.0), jnp.asarray(0.0))
+
+        def tick(carry, t):
+            state, outputs, stats_sum, n_active, mean_sum, std_sum = carry
+            mb_idx = t - stage
+            active = (mb_idx >= 0) & (mb_idx < M)
+            safe_idx = jnp.clip(mb_idx, 0, M - 1)
+            # Stage 0 ingests a fresh microbatch; others use the ring input.
+            fresh = x_mb[jnp.clip(t, 0, M - 1)]
+            current = jnp.where(stage == 0, fresh, state)
+            out = apply_local(local_blocks, current)
+            # Boundary battery for this tick (zeros batched out when idle).
+            tick_stats = st.tensor_statistics_sampled(
+                out.reshape(-1).astype(jnp.float32), max_sort
+            )
+            tick_stats = jnp.concatenate(
+                [tick_stats,
+                 jnp.zeros((st.NUM_GRADIENT_STATS - st.NUM_TENSOR_STATS,),
+                           jnp.float32)]
+            )
+            stats_sum = stats_sum + jnp.where(active, tick_stats, 0.0)
+            mean_sum = mean_sum + jnp.where(active, jnp.mean(out), 0.0)
+            std_sum = std_sum + jnp.where(active, jnp.std(out), 0.0)
+            n_active = n_active + active.astype(jnp.float32)
+            # Final stage records completed microbatches.
+            write = active & (stage == S - 1)
+            outputs = jnp.where(
+                write,
+                outputs.at[safe_idx].set(out),
+                outputs,
+            )
+            # Rotate activations one stage rightward over ICI.
+            nxt = jax.lax.ppermute(
+                out, STAGE_AXIS, _right_rotation(STAGE_AXIS, S)
+            )
+            return (nxt, outputs, stats_sum, n_active, mean_sum, std_sum), None
+
+        (_, outputs, stats_sum, n_active, mean_sum, std_sum), _ = jax.lax.scan(
+            tick, acc0, jnp.arange(total_ticks)
+        )
+        denom = jnp.maximum(n_active, 1.0)
+        stage_stats = (stats_sum / denom)[None, :]           # [1, 17] local
+        act_mean = (mean_sum / denom)[None]
+        act_std = (std_sum / denom)[None]
+        # Completed outputs live only on the last stage; psum replicates
+        # them (other stages contribute zeros) so unembed/loss is SPMD.
+        outputs = jax.lax.psum(outputs, STAGE_AXIS)
+        return outputs, stage_stats, act_mean, act_std
+
+    pipe = shard_map(
+        pipe_local,
+        mesh=mesh,
+        in_specs=(P(STAGE_AXIS), P()),
+        out_specs=(P(), P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS)),
+        check_vma=False,
+    )
+    return pipe
+
+
+def build_pipeline_train_step(
+    bundle,
+    config: TrainingConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    max_sort: int = 65536,
+) -> Callable[[TrainState, Dict[str, Array], AttackPlan],
+              Tuple[TrainState, StepMetrics]]:
+    """Jitted pipeline train step.  TrainState.params must hold 'blocks'
+    stacked as [S, L/S, ...] (see stack_stages); the trainer handles that.
+
+    Batches are global {'input': [B, T], 'target': [B, T]} with
+    B % num_microbatches == 0.
+    """
+    if bundle.kind != "lm":
+        raise ValueError(
+            "pipeline parallelism currently supports the GPT family only "
+            "(the reference's partitioner also only implemented GPT, "
+            "distributed_trainer.py:124-144)"
+        )
+    cfg = bundle.config
+    S = config.num_nodes
+    M = config.num_microbatches
+    detection = config.attack_detection_enabled
+    verification = config.gradient_verification_enabled
+    pipe_apply = build_pipeline_apply(cfg, mesh, S, M, max_sort)
+
+    def forward(params, tokens):
+        x = gpt2.embed(params, tokens, cfg)
+        b, t, d = x.shape
+        mb = b // M
+        x_mb = x.reshape(M, mb, t, d)
+        y_mb, stage_stats, act_mean, act_std = pipe_apply(params["blocks"], x_mb)
+        y = y_mb.reshape(b, t, d)
+        logits = gpt2.unembed(params, y, cfg)
+        return logits, (stage_stats, act_mean, act_std)
+
+    def loss_fn(params, batch):
+        logits, aux = forward(params, batch["input"])
+        return L.cross_entropy_loss(logits, batch["target"]), aux
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train_step(state: TrainState, batch: Dict[str, Array],
+                   plan: AttackPlan) -> Tuple[TrainState, StepMetrics]:
+        rng, k_grad = jax.random.split(state.rng)
+        now = state.step.astype(jnp.float32) * config.time_per_step
+
+        (loss, aux), grads = grad_fn(state.params, batch)
+        stage_stats_out, act_mean, act_std = aux
+
+        # Attack injection: a compromised stage emits poisoned block
+        # gradients (the [S, ...] leading axis maps nodes → stages).
+        grads = dict(grads)
+        grads["blocks"] = jax.lax.cond(
+            plan.is_live(state.step),
+            lambda g: poison_gradients(plan, g, state.step, k_grad),
+            lambda g: g,
+            grads["blocks"],
+        )
+
+        # Per-stage gradient batteries over each stage's block slice.
+        grad_stats, leaf_norms, finite = jax.vmap(
+            lambda g: _gradient_stat_vector(g, max_sort)
+        )(grads["blocks"])
+        global_norms = jnp.sqrt(jnp.sum(leaf_norms**2, axis=1))
+
+        if detection:
+            out_v = anomaly_verdicts(stage_stats_out, state.out_baseline,
+                                     warmup=config.detector_warmup)
+            grad_v = anomaly_verdicts(grad_stats, state.grad_baseline,
+                                      warmup=config.detector_warmup)
+            # Compromise verdicts come from the gradient battery (and the
+            # verifier below): stage activation distributions drift
+            # legitimately as the model trains and, unlike DP, there is no
+            # cross-node population to separate drift from attack — so the
+            # output battery feeds the output_deviation *trust signal* and
+            # the reported score, not the hard verdict.
+            candidates = grad_v.is_attack
+            out_bl = bl.push_stats(state.out_baseline, stage_stats_out)
+            grad_bl = bl.push_stats(state.grad_baseline, grad_stats,
+                                    mask=~candidates)
+            attacked = candidates & state.prev_suspects
+            out_score, grad_score = out_v.score, grad_v.score
+            attack_type = jnp.where(grad_v.is_attack, grad_v.attack_type,
+                                    out_v.attack_type)
+        else:
+            out_bl, grad_bl = state.out_baseline, state.grad_baseline
+            candidates = attacked = jnp.zeros((S,), bool)
+            out_score = grad_score = jnp.zeros((S,), jnp.float32)
+            attack_type = jnp.zeros((S,), jnp.int32)
+
+        if verification:
+            verifier, verified = verify_gradients_array(
+                state.verifier, global_norms, finite
+            )
+        else:
+            verifier = state.verifier
+            verified = finite.astype(bool)
+
+        trust = ts.mark_compromised(state.trust, attacked | ~verified)
+
+        # Trust signals per stage (distributed_trainer.py:228-271 analogue).
+        warm = state.monitor.warm
+        exp_mean = state.monitor.out_mean_avg
+        exp_std = jnp.maximum(state.monitor.out_std_avg, 1e-6)
+        deviation = jnp.where(
+            warm,
+            jnp.minimum(
+                1.0,
+                (jnp.abs(act_mean - exp_mean) / exp_std
+                 + jnp.abs(act_std - state.monitor.out_std_avg) / exp_std) / 2.0,
+            ),
+            0.0,
+        )
+        per_leaf = jnp.minimum(
+            1.0, leaf_norms / jnp.maximum(state.monitor.grad_norm_avg, 1e-12)
+        )
+        usable = state.monitor.grad_norm_avg > 0
+        consistency = jnp.where(
+            warm,
+            jnp.sum(jnp.where(usable, per_leaf, 0.0), axis=1)
+            / jnp.maximum(jnp.sum(usable, axis=1), 1),
+            1.0,
+        )
+        trust = ts.update_trust(trust, deviation, consistency, now,
+                                alpha=config.trust_alpha)
+
+        # Gate: a flagged stage's parameters freeze (update zeroed) — the
+        # model topology is preserved, unlike the reference's layer-drop.
+        weights = ts.contribution_weights(trust, verified & ~candidates)
+        grads["blocks"] = jax.tree_util.tree_map(
+            lambda g: g * weights.reshape((S,) + (1,) * (g.ndim - 1)).astype(
+                g.dtype
+            ),
+            grads["blocks"],
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        absorb = verified & ~candidates
+        monitor = update_monitor(state.monitor, act_mean, act_std, leaf_norms,
+                                 absorb)
+        new_state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            trust=trust,
+            out_baseline=out_bl,
+            grad_baseline=grad_bl,
+            verifier=verifier,
+            monitor=monitor,
+            prev_suspects=candidates,
+            step=state.step + 1,
+            epoch=state.epoch,
+            rng=rng,
+        )
+        metrics = StepMetrics(
+            loss=loss,
+            per_node_loss=jnp.broadcast_to(loss, (S,)),
+            trust_scores=trust.scores,
+            status=trust.status,
+            attacked=attacked,
+            verified=verified,
+            weights=weights,
+            system_trust=ts.system_trust(trust),
+            grad_norm=optax.global_norm(grads),
+            out_score=out_score,
+            grad_score=grad_score,
+            attack_type=attack_type,
+            byzantine=jnp.zeros((S,), bool),
+            backdoor=jnp.zeros((S,), bool),
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def build_pipeline_eval_step(bundle, config: TrainingConfig, mesh: Mesh
+                             ) -> Callable[[Any, Dict[str, Array]],
+                                           Dict[str, Array]]:
+    """Validation through the pipeline (params hold stacked [S, L/S, ...]
+    blocks, so the DP eval path cannot be reused)."""
+    cfg = bundle.config
+    pipe_apply = build_pipeline_apply(cfg, mesh, config.num_nodes,
+                                      config.num_microbatches)
+
+    def eval_step(params, batch):
+        tokens = batch["input"]
+        x = gpt2.embed(params, tokens, cfg)
+        b, t, d = x.shape
+        mb = b // config.num_microbatches
+        x_mb = x.reshape(config.num_microbatches, mb, t, d)
+        y_mb, _, _, _ = pipe_apply(params["blocks"], x_mb)
+        logits = gpt2.unembed(params, y_mb.reshape(b, t, d), cfg)
+        return {
+            "loss": L.cross_entropy_loss(logits, batch["target"]),
+            "accuracy": L.accuracy(logits, batch["target"]),
+        }
+
+    return eval_step
