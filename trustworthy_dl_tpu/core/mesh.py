@@ -92,8 +92,16 @@ def build_mesh(
         arr = np.array(devices[:num_nodes])
         return Mesh(arr, (DATA_AXIS,))
     usable = (n_dev // num_nodes) * num_nodes
-    replicas = usable // num_nodes
-    arr = np.array(devices[:usable]).reshape(replicas, num_nodes)
+    group = usable // num_nodes
+    if axis == STAGE_AXIS:
+        # Pipeline: the stage axis carries the nodes; leftover devices form
+        # data-parallel pipeline replicas.
+        arr = np.array(devices[:usable]).reshape(group, num_nodes)
+        return Mesh(arr, (DATA_AXIS, axis))
+    # Tensor / sequence: trust nodes stay data shards; each node owns a
+    # TP / sequence group of the remaining devices (SURVEY §2.4 plan — the
+    # detection unit is the DP shard, intra-node sharding is transparent).
+    arr = np.array(devices[:usable]).reshape(num_nodes, group)
     return Mesh(arr, (DATA_AXIS, axis))
 
 

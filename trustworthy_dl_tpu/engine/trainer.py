@@ -101,13 +101,22 @@ class DistributedTrainer:
         self._open_incidents: set = set()
 
         # Model / optimizer / mesh / step.
+        model_overrides = dict(model_overrides or {})
+        if config.parallelism == "sequence" and config.model_name.startswith(
+            "gpt"
+        ):
+            model_overrides.setdefault("attn_impl", "ring")
         self.model = ModelFactory().create_model(
-            config.model_name, **(model_overrides or {})
+            config.model_name, **model_overrides
         )
         self.optimizer = build_optimizer(config)
         self.mesh = mesh if mesh is not None else build_mesh(
             config.num_nodes, config.parallelism, config.mesh_shape
         )
+        if config.parallelism == "sequence":
+            from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
+
+            set_sequence_mesh(self.mesh)
         if config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
@@ -166,6 +175,12 @@ class DistributedTrainer:
             params["blocks"] = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, stage_sharding), params["blocks"]
             )
+        if self.config.parallelism == "tensor":
+            from trustworthy_dl_tpu.parallel.tensor_parallel import (
+                apply_tp_sharding,
+            )
+
+            params = apply_tp_sharding(params, self.mesh)
         opt_state = self.optimizer.init(params)
         self.state = init_train_state(
             k_state, params, opt_state,
