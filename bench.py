@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Throughput benchmark: GPT-2 trusted training, detection ON vs OFF.
+
+Measures tokens/sec/chip of the jitted trusted train step (engine/step.py)
+on the available accelerator, with the full in-step detection battery
+(17-stat batteries, Byzantine/backdoor checks, verification, trust update,
+trust-gated aggregation) enabled vs disabled.  The detection overhead is the
+framework's headline number — BASELINE.md sets a ≤15 % target (the reference
+publishes no numbers of its own).
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": tokens/sec/chip with detection ON,
+   "unit": "tokens/sec/chip",
+   "vs_baseline": ON/OFF throughput ratio (1.0 = free detection; the
+                  baseline is this framework's own detection-off path)}
+Diagnostics go to stderr.
+
+Env overrides: TDDL_BENCH_MODEL (gpt2), TDDL_BENCH_NODES (4),
+TDDL_BENCH_BATCH (per-node, 2), TDDL_BENCH_SEQ (512),
+TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_mode(detection: bool, model: str, num_nodes: int,
+               per_node_batch: int, seq_len: int, steps: int,
+               warmup: int) -> float:
+    """Steps/sec of the jitted step, driven device-side (no host sync in
+    the timed loop beyond dispatch)."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    config = TrainingConfig(
+        model_name=model,
+        dataset_name="openwebtext",
+        batch_size=num_nodes * per_node_batch,
+        num_nodes=num_nodes,
+        optimizer="adamw",
+        learning_rate=1e-4,
+        checkpoint_interval=10 ** 9,
+        attack_detection_enabled=detection,
+        gradient_verification_enabled=detection,
+        parallelism="data",
+    )
+    trainer = DistributedTrainer(config, model_overrides={"seq_len": seq_len})
+    trainer.initialize()
+
+    rng = np.random.default_rng(0)
+    vocab = trainer.model.config.vocab_size
+    tokens = rng.integers(
+        0, vocab, (num_nodes * per_node_batch, seq_len + 1), dtype=np.int32
+    )
+    batch = trainer._node_batch(
+        {"input": tokens[:, :-1], "target": tokens[:, 1:]}
+    )
+    plan = trainer.attack_plan
+
+    state = trainer.state
+    for _ in range(max(warmup, 1)):
+        state, metrics = trainer._train_step(state, batch, plan)
+    jax.block_until_ready(metrics.loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer._train_step(state, batch, plan)
+    jax.block_until_ready(metrics.loss)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(float(metrics.loss)), "bench step produced NaN loss"
+    return steps / elapsed
+
+
+def main() -> None:
+    model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
+    num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
+    per_node_batch = int(os.environ.get("TDDL_BENCH_BATCH", "2"))
+    seq_len = int(os.environ.get("TDDL_BENCH_SEQ", "512"))
+    steps = int(os.environ.get("TDDL_BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("TDDL_BENCH_WARMUP", "3"))
+
+    import jax
+
+    n_chips = max(jax.device_count(), 1)
+    platform = jax.devices()[0].platform
+    log(f"bench: {model} nodes={num_nodes} batch/node={per_node_batch} "
+        f"seq={seq_len} steps={steps} on {n_chips} {platform} device(s)")
+
+    tokens_per_step = num_nodes * per_node_batch * seq_len
+
+    sps_off = bench_mode(False, model, num_nodes, per_node_batch, seq_len,
+                         steps, warmup)
+    log(f"detection OFF: {sps_off:.3f} steps/s "
+        f"({sps_off * tokens_per_step / n_chips:,.0f} tok/s/chip)")
+    sps_on = bench_mode(True, model, num_nodes, per_node_batch, seq_len,
+                        steps, warmup)
+    log(f"detection ON:  {sps_on:.3f} steps/s "
+        f"({sps_on * tokens_per_step / n_chips:,.0f} tok/s/chip)")
+
+    tps_on = sps_on * tokens_per_step / n_chips
+    ratio = sps_on / sps_off
+    overhead_pct = (1.0 - ratio) * 100.0
+    log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
+
+    print(json.dumps({
+        "metric": f"{model}_tokens_per_sec_per_chip_detection_on",
+        "value": round(tps_on, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(ratio, 4),
+        "detection_overhead_pct": round(overhead_pct, 2),
+        "platform": platform,
+        "num_chips": n_chips,
+        "tokens_per_step": tokens_per_step,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -192,6 +192,48 @@ def test_checkpoint_round_trip_restores_trust_world(tmp_path):
     )
 
 
+def test_nan_gradient_node_does_not_corrupt_training(tmp_path):
+    """Regression (advisor r1, high): 0 * NaN = NaN, so a node emitting
+    non-finite gradients must be hard-masked out of the aggregate — scaling
+    by its zero weight is not enough.  One NaN node must not NaN the params,
+    the loss, or the honest nodes' update."""
+    trainer = gpt_trainer(tmp_path, num_nodes=4)
+    dl = gpt_loader(num_nodes=4, num_examples=32)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[2],
+                     intensity=float("inf"), start_step=0)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    loss = trainer.train_epoch(dl, 0)
+    assert np.isfinite(loss), loss
+    for leaf in jax.tree_util.tree_leaves(trainer.state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    # The NaN node was caught by verification and carries zero weight.
+    assert trainer.trust_manager.get_trust_score(2) < 0.3
+
+
+def test_all_nodes_gated_skips_update(tmp_path):
+    """Regression (advisor r1, medium): when every node is gated out the
+    step must skip the update (zero aggregate) — the old fallback applied
+    uniform weights to the very gradients that failed verification."""
+    trainer = gpt_trainer(tmp_path, num_nodes=4)
+    dl = gpt_loader(num_nodes=4, num_examples=32)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"],
+                     target_nodes=[0, 1, 2, 3],
+                     intensity=float("inf"), start_step=0)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    loss = trainer.train_epoch(dl, 0)
+    assert np.isfinite(loss)
+    for leaf in jax.tree_util.tree_leaves(trainer.state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
 def test_validate_runs(tmp_path):
     trainer = gpt_trainer(tmp_path, num_nodes=4)
     trainer.initialize()

@@ -148,6 +148,33 @@ def test_pipeline_clean_stages_unaffected(pipeline_attack_run):
         assert trainer.trust_manager.get_trust_score(stage) > 0.5
 
 
+def test_pipeline_nan_stage_does_not_corrupt_params(tmp_path):
+    """Regression (advisor r1, high): a frozen stage's NaN gradients must be
+    hard-masked (jnp.where), not scaled by zero, or they poison the shared
+    optimizer update."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_epochs=1, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=32)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[5],
+                     intensity=float("inf"), start_step=0)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    loss = trainer.train_epoch(dl, 0)
+    assert np.isfinite(loss)
+    for leaf in jax.tree_util.tree_leaves(trainer.state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
 def test_pipeline_validate(pipeline_attack_run):
     trainer, _ = pipeline_attack_run
     val = get_dataloader("openwebtext", split="validation", batch_size=8,

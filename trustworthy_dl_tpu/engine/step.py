@@ -303,17 +303,22 @@ def build_train_step(
         )
 
         # 8. Trust-gated aggregation — the psum the reference never issued
-        # (SURVEY §2.5).  Zero-trust fallback keeps training alive if every
-        # node is gated out simultaneously.
+        # (SURVEY §2.5).  Gated-out nodes are hard-masked with jnp.where,
+        # not merely scaled: 0 * NaN = NaN, so a node emitting non-finite
+        # gradients would otherwise poison the aggregate despite its zero
+        # weight.  When every node is gated out, the update is skipped
+        # entirely (zero aggregate) — falling back to uniform weighting
+        # would apply the very gradients that failed verification.
         weights = ts.contribution_weights(trust, verified & ~candidates)
         denom = jnp.sum(weights)
-        safe_w = jnp.where(denom > 0, weights, jnp.ones_like(weights))
-        safe_d = jnp.maximum(jnp.sum(safe_w), 1.0)
-        agg = jax.tree_util.tree_map(
-            lambda g: jnp.einsum("n,n...->...", safe_w.astype(g.dtype), g)
-            / safe_d.astype(g.dtype),
-            grads,
-        )
+        inv = jnp.where(denom > 0, 1.0 / jnp.maximum(denom, 1e-30), 0.0)
+
+        def _gate(g):
+            mask = (weights > 0).reshape((n_nodes,) + (1,) * (g.ndim - 1))
+            w = (weights * inv).astype(g.dtype)
+            return jnp.einsum("n,n...->...", w, jnp.where(mask, g, 0))
+
+        agg = jax.tree_util.tree_map(_gate, grads)
 
         # 9. Optimizer + monitor absorption (clean samples only).
         updates, opt_state = optimizer.update(agg, state.opt_state, state.params)
@@ -323,7 +328,10 @@ def build_train_step(
                                  absorb)
 
         agg_norm = optax.global_norm(agg)
-        loss = jnp.sum(safe_w * losses) / safe_d
+        # Same masking for the reported loss: a gated node's (possibly NaN)
+        # loss must not contaminate the aggregate.  All-gated → 0.0, with
+        # weights all-zero in the metrics making the cause unambiguous.
+        loss = jnp.sum(jnp.where(weights > 0, losses, 0.0) * weights) * inv
         new_state = TrainState(
             params=params,
             opt_state=opt_state,

@@ -303,13 +303,26 @@ def build_pipeline_train_step(
 
         # Gate: a flagged stage's parameters freeze (update zeroed) — the
         # model topology is preserved, unlike the reference's layer-drop.
+        # Hard-mask with jnp.where, not scale: 0 * NaN = NaN, so a frozen
+        # stage emitting non-finite gradients would otherwise still poison
+        # its own (and via the optimizer, the shared) parameter updates.
         weights = ts.contribution_weights(trust, verified & ~candidates)
-        grads["blocks"] = jax.tree_util.tree_map(
-            lambda g: g * weights.reshape((S,) + (1,) * (g.ndim - 1)).astype(
-                g.dtype
-            ),
-            grads["blocks"],
-        )
+
+        def _gate_stage(g):
+            shape = (S,) + (1,) * (g.ndim - 1)
+            mask = (weights > 0).reshape(shape)
+            return jnp.where(mask, g * weights.reshape(shape).astype(g.dtype), 0)
+
+        blocks = jax.tree_util.tree_map(_gate_stage, grads["blocks"])
+        # Shared leaves (embed/unembed) are not per-stage gated; zero any
+        # non-finite leaf so a NaN forward cannot corrupt shared params.
+        # (Block grads are already handled by _gate_stage — a non-finite
+        # stage always fails the finite check and carries weight 0.)
+        grads = {
+            k: (blocks if k == "blocks" else jax.tree_util.tree_map(
+                lambda g: jnp.where(jnp.all(jnp.isfinite(g)), g, 0), v))
+            for k, v in grads.items()
+        }
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
