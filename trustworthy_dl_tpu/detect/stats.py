@@ -123,6 +123,123 @@ def tensor_statistics_sampled(x: jax.Array, max_sort: int = 65536) -> jax.Array:
     return full.at[idx_med].set(median).at[idx_p25].set(p25).at[idx_p75].set(p75)
 
 
+def _stats_from_raw_moments(s1, s2, s3, s4, mn, mx, l1, linf, count,
+                            median, p25, p75) -> jax.Array:
+    """Assemble the f32[12] battery from raw-moment sums.
+
+    Raw-moment (uncentered) formulas trade a little precision for a single
+    pass over the data; gradients are near zero-mean so cancellation is
+    negligible, and the z-score baselines only need self-consistency.
+    """
+    n = jnp.maximum(count, 1.0)
+    mean = s1 / n
+    ex2, ex3, ex4 = s2 / n, s3 / n, s4 / n
+    var = jnp.maximum(ex2 - mean * mean, 0.0)
+    std = jnp.sqrt(var)
+    safe = jnp.where(std > 0, std, 1.0)
+    m3 = ex3 - 3.0 * mean * ex2 + 2.0 * mean**3
+    m4 = ex4 - 4.0 * mean * ex3 + 6.0 * mean**2 * ex2 - 3.0 * mean**4
+    skew = jnp.where(std > 0, m3 / safe**3, 0.0)
+    kurt = jnp.where(std > 0, m4 / safe**4 - 3.0, -3.0)
+    return jnp.stack([mean, std, mn, mx, median, skew, kurt, p25, p75,
+                      l1, jnp.sqrt(s2), linf])
+
+
+def strided_sample_of_leaves(leaves: Sequence[jax.Array], max_sort: int,
+                             n_chunks: int = 16) -> jax.Array:
+    """Deterministic ≤~max_sort-element subsample across flattened leaves,
+    proportional to leaf size — the order-statistics sample without ever
+    concatenating the full vectors.  Shapes are static (leaf sizes are trace
+    constants), so this jits cleanly.
+
+    Each leaf contributes up to ``n_chunks`` *contiguous* chunks spread
+    evenly across its extent: contiguous slices are straight DMA reads on
+    TPU, where an element-strided gather costs nearly a full pass over the
+    leaf (measured ~3× the whole moment battery for GPT-2-sized tensors).
+    Self-consistency across steps — not unbiasedness — is what the z-score
+    baselines need."""
+    total = sum(int(f.shape[0]) for f in leaves)
+    if total <= max_sort:
+        return jnp.concatenate(leaves) if len(leaves) > 1 else leaves[0]
+    out = []
+    for f in leaves:
+        sz = int(f.shape[0])
+        if sz == 0:
+            continue
+        q = min(max(1, (sz * max_sort) // total), sz)
+        chunks = max(1, min(n_chunks, q // 1024))
+        clen = q // chunks
+        if clen == 0:
+            chunks, clen = 1, q
+        span = sz // chunks
+        for i in range(chunks):
+            off = min(i * span, sz - clen)
+            out.append(jax.lax.slice(f, (off,), (off + clen,)))
+    return jnp.concatenate(out) if len(out) > 1 else out[0]
+
+
+def quantiles_from_sorted(sorted_x: jax.Array, qs: Sequence[float]
+                          ) -> List[jax.Array]:
+    """Linear-interpolated quantiles from an already-sorted vector — one
+    sort shared across median/p25/p75 instead of three (XLA does not
+    reliably CSE repeated sorts)."""
+    n = sorted_x.shape[0]
+    out = []
+    for q in qs:
+        pos = (n - 1) * (q / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, n - 1)
+        frac = pos - lo
+        out.append(sorted_x[lo] * (1.0 - frac) + sorted_x[hi] * frac)
+    return out
+
+
+def leafwise_statistics(
+    leaves: Sequence[jax.Array], max_sort: int = 16384
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(stats f32[12], per-leaf L2 norms f32[k], finite bool[], sample) over
+    a list of flattened f32 leaves, streaming — one fused reduction pass per
+    leaf, never materialising the concatenated vector.  ``sample`` is the
+    ≤max_sort order-statistics subsample, returned for reuse (e.g. the
+    intra-step cosine signal).
+
+    This is the engine's hot-path battery: the previous implementation
+    concatenated every gradient leaf (O(P) extra HBM write+read per node per
+    step, P = parameter count) before reducing; here moments combine across
+    leaves from per-leaf sums, and only the ≤max_sort order-statistics
+    subsample is ever gathered.  The finite flag derives from s1/s2 (NaN/Inf
+    anywhere propagates into both), so no separate isfinite pass."""
+    def moments(f):
+        x = f if f.dtype == jnp.float32 else None
+        # Shared x² subexpression; f32 accumulators even for bf16 inputs,
+        # with the cast fused into the reductions (no materialised copy).
+        x2 = (f * f).astype(jnp.float32) if x is None else x * x
+        xf = f.astype(jnp.float32) if x is None else f
+        return (jnp.sum(xf), jnp.sum(x2), jnp.sum(x2 * xf), jnp.sum(x2 * x2),
+                jnp.min(f).astype(jnp.float32), jnp.max(f).astype(jnp.float32),
+                jnp.sum(jnp.abs(xf)), jnp.max(jnp.abs(f)).astype(jnp.float32))
+
+    per_leaf = [moments(f) for f in leaves]
+    s1 = jnp.stack([m[0] for m in per_leaf]).sum()
+    s2_leaf = jnp.stack([m[1] for m in per_leaf])
+    s2 = s2_leaf.sum()
+    s3 = jnp.stack([m[2] for m in per_leaf]).sum()
+    s4 = jnp.stack([m[3] for m in per_leaf]).sum()
+    mn = jnp.stack([m[4] for m in per_leaf]).min()
+    mx = jnp.stack([m[5] for m in per_leaf]).max()
+    l1 = jnp.stack([m[6] for m in per_leaf]).sum()
+    linf = jnp.stack([m[7] for m in per_leaf]).max()
+    count = jnp.asarray(float(sum(int(f.shape[0]) for f in leaves)),
+                        jnp.float32)
+    sample = strided_sample_of_leaves(leaves, max_sort).astype(jnp.float32)
+    sorted_sample = jnp.sort(sample)
+    p25, median, p75 = quantiles_from_sorted(sorted_sample, (25, 50, 75))
+    stats = _stats_from_raw_moments(s1, s2, s3, s4, mn, mx, l1, linf, count,
+                                    median, p25, p75)
+    finite = jnp.isfinite(s1) & jnp.isfinite(s2)
+    return stats, jnp.sqrt(s2_leaf), finite, sample
+
+
 def chunked_cosine_mean(flat: jax.Array, chunks: int = 4) -> jax.Array:
     """Mean pairwise cosine similarity among equal chunks of one flattened
     gradient vector — the engine's O(P) stand-in for the reference's

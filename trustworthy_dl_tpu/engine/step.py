@@ -45,36 +45,37 @@ from trustworthy_dl_tpu.trust import state as ts
 Array = jax.Array
 
 
-def _flatten_grads(grads: Any) -> Tuple[Array, Array, Array]:
-    """(full_flat, leaf_norms, all_finite) for one node's gradient pytree."""
-    leaves = jax.tree_util.tree_leaves(grads)
-    flats = [g.reshape(-1).astype(jnp.float32) for g in leaves]
-    full = jnp.concatenate(flats)
-    leaf_norms = jnp.stack([jnp.sqrt(jnp.sum(f * f)) for f in flats])
-    finite = jnp.all(jnp.isfinite(full))
-    return full, leaf_norms, finite
-
-
 def _gradient_stat_vector(grads: Any, max_sort: int) -> Tuple[Array, Array, Array]:
     """17-stat battery for one node's gradients (+ leaf norms, finite flag).
-    Matches detect/stats.gradient_statistics column layout."""
-    full, leaf_norms, finite = _flatten_grads(grads)
-    base = st.tensor_statistics_sampled(full, max_sort)
+    Matches detect/stats.gradient_statistics column layout.
+
+    Streaming: per-leaf fused reductions combined via raw moments — the full
+    gradient vector is never concatenated (that cost O(P) extra HBM traffic
+    per node per step).  Order statistics and the intra-step cosine signal
+    run on the deterministic ≤max_sort subsample, keeping the rolling
+    baselines self-consistent."""
+    leaves = [g.reshape(-1).astype(jnp.float32)
+              for g in jax.tree_util.tree_leaves(grads)]
+    base, leaf_norms, finite, sample = st.leafwise_statistics(leaves, max_sort)
     extra = jnp.stack(
         [
-            jnp.asarray(float(leaf_norms.shape[0]), jnp.float32),
+            jnp.asarray(float(len(leaves)), jnp.float32),
             jnp.mean(leaf_norms),
             jnp.std(leaf_norms),
             jnp.max(leaf_norms),
-            st.chunked_cosine_mean(full),
+            st.chunked_cosine_mean(sample),
         ]
     )
     return jnp.concatenate([base, extra]), leaf_norms, finite
 
 
 def _output_stat_vector(logits: Array, max_sort: int) -> Array:
-    """17-padded output battery (12 real stats + zero padding)."""
-    base = st.tensor_statistics_sampled(logits.reshape(-1), max_sort)
+    """17-padded output battery (12 real stats + zero padding), streaming
+    (raw-moment single pass — logits can be b·T·V ≈ 10⁷ elements/node).
+    The bf16→f32 cast stays fused inside the reductions: materialising a
+    f32 copy of the logits costs more than the whole battery."""
+    flat = logits.reshape(-1)
+    base, _, _, _ = st.leafwise_statistics([flat], max_sort)
     pad = jnp.zeros((st.NUM_GRADIENT_STATS - st.NUM_TENSOR_STATS,), jnp.float32)
     return jnp.concatenate([base, pad])
 
@@ -124,7 +125,7 @@ def build_train_step(
     config: TrainingConfig,
     optimizer: optax.GradientTransformation,
     num_classes: Optional[int] = None,
-    max_sort: int = 65536,
+    max_sort: int = 16384,
 ) -> Callable[[TrainState, Dict[str, Array], AttackPlan],
               Tuple[TrainState, StepMetrics]]:
     """Build the jitted train step for ``num_nodes`` logical nodes.
@@ -143,12 +144,22 @@ def build_train_step(
         )
 
     def node_loss(params, node_batch):
-        logits = bundle.apply(params, node_batch["input"])
+        # Detector signals ride on `feats` — the node-boundary activations
+        # (what the reference's per-partition hook watched,
+        # distributed_trainer.py:160-170).  For LMs these are ~65× smaller
+        # than the logits, keeping the battery off the CE-loss fusion path.
+        if bundle.apply_monitor is not None:
+            logits, feats, mean_logits = bundle.apply_monitor(
+                params, node_batch["input"]
+            )
+        else:
+            logits = bundle.apply(params, node_batch["input"])
+            feats = logits
+            lead = tuple(range(logits.ndim - 1))
+            mean_logits = jnp.mean(logits.astype(jnp.float32), axis=lead)
         loss = L.cross_entropy_loss(logits, node_batch["target"])
-        out_stats = _output_stat_vector(logits, max_sort)
-        lead = tuple(range(logits.ndim - 1))
-        mean_logits = jnp.mean(logits.astype(jnp.float32), axis=lead)
-        aux = (out_stats, jnp.mean(logits), jnp.std(logits), mean_logits)
+        out_stats = _output_stat_vector(feats, max_sort)
+        aux = (out_stats, jnp.mean(feats), jnp.std(feats), mean_logits)
         return loss, aux
 
     grad_fn = jax.value_and_grad(node_loss, has_aux=True)

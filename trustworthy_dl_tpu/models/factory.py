@@ -36,6 +36,14 @@ class ModelBundle:
     loss: Callable[[Params, Dict[str, jax.Array]], jax.Array]
     num_blocks: int               # partitionable depth (`transformer.h` parity)
     input_spec: Dict[str, Any]    # shape/dtype template for example batches
+    # Optional hot-path variant: (params, x) -> (logits, features,
+    # mean_logits) where `features` are the boundary activations the
+    # detector monitors (cheaper than logits for LMs) and `mean_logits` the
+    # class-distribution signature for Byzantine/backdoor consensus.
+    # None -> the engine falls back to deriving all three from `apply`.
+    apply_monitor: Optional[Callable[
+        [Params, jax.Array], "tuple[jax.Array, jax.Array, jax.Array]"
+    ]] = None
 
     def example_batch(self, batch_size: int, rng: Optional[jax.Array] = None
                       ) -> Dict[str, jax.Array]:
@@ -81,6 +89,9 @@ class ModelFactory:
                 loss=lambda p, b, c=cfg: gpt2.loss_fn(p, b, c),
                 num_blocks=cfg.n_layer,
                 input_spec={"seq_len": seq_len, "vocab_size": cfg.vocab_size},
+                apply_monitor=lambda p, x, c=cfg: gpt2.forward_with_monitor(
+                    p, x, c
+                ),
             )
         if name.startswith("resnet"):
             num_classes = overrides.pop("num_classes", 10)

@@ -203,11 +203,17 @@ def embed(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     return x.astype(jnp.float32)
 
 
+def project_logits(params: Params, normed: jax.Array, cfg: GPT2Config
+                   ) -> jax.Array:
+    """Tied-embedding projection [..., D] -> [..., vocab] (shared by
+    forward and forward_with_monitor so the monitored logits can never
+    drift from the trained ones)."""
+    return (normed.astype(cfg.dtype)
+            @ params["wte"].T.astype(cfg.dtype)).astype(jnp.float32)
+
+
 def unembed(params: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
-    x = L.layernorm(params["ln_f"], x)
-    return (x.astype(cfg.dtype) @ params["wte"].T.astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    return project_logits(params, L.layernorm(params["ln_f"], x), cfg)
 
 
 def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
@@ -215,6 +221,31 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     x = embed(params, tokens, cfg)
     x = apply_blocks(params["blocks"], x, cfg)
     return unembed(params, x, cfg)
+
+
+def forward_with_monitor(params: Params, tokens: jax.Array, cfg: GPT2Config
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens [B, T] -> (logits [B,T,V], features [B,T,D], mean_logits [V]).
+
+    ``features`` are the final hidden activations *before* ln_f — the
+    node-boundary output the reference's detector actually monitored
+    (distributed_trainer.py:160-170 watches partition outputs, which are
+    hidden activations, not logits).  Pre-norm matters: LayerNorm is
+    scale/shift-invariant per position, so post-ln features would read
+    identical under an activation-scaling corruption and blind the output
+    battery.  They are vocab_size/n_embd ≈ 65× smaller than the logits, so
+    detector batteries over them are nearly free and leave the
+    cross-entropy's logits computation free to fuse.  ``mean_logits`` (for
+    Byzantine/backdoor consensus signatures) is exact: the tied projection
+    is linear, so mean over positions commutes with it —
+    mean(normed) @ W == mean(normed @ W)."""
+    x = embed(params, tokens, cfg)
+    x = apply_blocks(params["blocks"], x, cfg)
+    normed = L.layernorm(params["ln_f"], x)
+    logits = project_logits(params, normed, cfg)
+    mean_normed = jnp.mean(normed, axis=tuple(range(normed.ndim - 1)))
+    mean_logits = project_logits(params, mean_normed, cfg)
+    return logits, x, mean_logits
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: GPT2Config

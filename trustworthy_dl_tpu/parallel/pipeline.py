@@ -84,7 +84,7 @@ def build_pipeline_apply(
     mesh: Mesh,
     num_stages: int,
     num_microbatches: int,
-    max_sort: int = 65536,
+    max_sort: int = 16384,
 ) -> Callable[[Any, Array], Tuple[Array, Array, Array, Array]]:
     """Returns pipe_apply(stage_blocks, x_microbatches) ->
     (y_microbatches, stage_stats[S,17], act_mean[S], act_std[S]).
@@ -178,7 +178,7 @@ def build_pipeline_train_step(
     config: TrainingConfig,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
-    max_sort: int = 65536,
+    max_sort: int = 16384,
 ) -> Callable[[TrainState, Dict[str, Array], AttackPlan],
               Tuple[TrainState, StepMetrics]]:
     """Jitted pipeline train step.  TrainState.params must hold 'blocks'
