@@ -1,0 +1,147 @@
+"""L5 experiment layer: the runner must drive the REAL trainer (the
+reference simulated its training step, experiment_runner.py:201-216) and
+produce the full artifact contract — JSON + CSV + 4 PNGs + markdown report
+(experiment_runner.py:325-359, 521-591)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu import ExperimentConfig, ExperimentRunner
+from trustworthy_dl_tpu.experiments import PRESETS, preset_config
+
+TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
+                n_positions=32, seq_len=16)
+TINY_DATA = dict(seq_len=16, vocab_size=128, num_examples=64)
+
+
+@pytest.fixture(scope="module")
+def experiment_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("exp")
+    config = ExperimentConfig(
+        experiment_name="tiny_gpt_attack",
+        model_name="gpt2", dataset_name="openwebtext",
+        num_nodes=4, num_epochs=5, batch_size=8, learning_rate=3e-3,
+        attack_enabled=True, attack_start_epoch=2, attack_intensity=0.5,
+        target_nodes=[2], attack_types=["gradient_poisoning"],
+        steps_per_epoch=8, output_dir=str(out),
+    )
+    runner = ExperimentRunner(config, model_overrides=dict(TINY_GPT),
+                              data_overrides=dict(TINY_DATA))
+    results = runner.run_experiment()
+    return runner, results
+
+
+def test_runner_drives_real_trainer(experiment_run):
+    """Loss comes from real SGD (decreasing), not a synthetic curve, and
+    the recorded steps match epochs x batches."""
+    runner, results = experiment_run
+    records = results["epoch_records"]
+    assert len(records) == 5
+    assert records[-1]["training_loss"] < records[0]["training_loss"]
+    assert results["experiment_summary"]["total_steps"] == 5 * 8
+    # Validation ran through the real eval step.
+    assert np.isfinite(records[0]["validation_loss"])
+
+
+def test_runner_detects_injected_attack(experiment_run):
+    runner, results = experiment_run
+    quality = results["experiment_summary"]["detection_quality"]
+    assert quality["attack_enabled"]
+    assert 2 in quality["detected_nodes"], quality
+    assert quality["recall"] == 1.0
+    assert quality["false_positives"] == []
+    # Trust of the attacked node collapsed in the recorded (not simulated)
+    # trajectory.
+    final_trust = records = results["epoch_records"][-1]["trust_scores"]
+    assert final_trust[2] < 0.3
+    assert all(final_trust[i] > 0.5 for i in (0, 1, 3))
+
+
+def test_artifact_contract(experiment_run):
+    """experiment_runner.py:325-359: JSON + CSV + 4 PNGs + report."""
+    runner, _ = experiment_run
+    expected = [
+        "experiment_results.json",
+        "training_metrics.csv",
+        "training_loss.png",
+        "trust_evolution.png",
+        "attack_impact.png",
+        "system_metrics.png",
+        "experiment_report.md",
+        "intermediate_epoch_4.json",
+    ]
+    for name in expected:
+        path = runner.output_dir / name
+        assert path.exists(), f"missing artifact {name}"
+        assert path.stat().st_size > 0, f"empty artifact {name}"
+
+
+def test_results_json_round_trips(experiment_run):
+    runner, results = experiment_run
+    with open(runner.output_dir / "experiment_results.json") as f:
+        loaded = json.load(f)
+    assert loaded["experiment_config"]["experiment_name"] == "tiny_gpt_attack"
+    assert loaded["experiment_summary"]["total_attacks_detected"] >= 1
+    assert len(loaded["attack_history"]) >= 1
+
+
+def test_csv_has_per_step_trust(experiment_run):
+    import pandas as pd
+
+    runner, _ = experiment_run
+    df = pd.read_csv(runner.output_dir / "training_metrics.csv")
+    assert len(df) == 40
+    for node in range(4):
+        assert f"trust_node_{node}" in df.columns
+    # The attacked node's trust drops after the attack starts (step 16).
+    assert df["trust_node_2"].iloc[-1] < 0.3
+    assert df["trust_node_2"].iloc[0] > 0.9
+
+
+def test_report_mentions_real_quality(experiment_run):
+    runner, _ = experiment_run
+    text = (runner.output_dir / "experiment_report.md").read_text()
+    assert "detection precision" in text
+    assert "tiny_gpt_attack" in text
+
+
+def test_presets_cover_baseline_matrix():
+    """BASELINE.md's five benchmark configs exist as runnable presets."""
+    assert set(PRESETS) == {
+        "resnet32_cifar10_clean",
+        "vgg16_cifar10_poisoning",
+        "gpt2_small_pipeline_clean",
+        "gpt2_medium_reassignment",
+        "resnet101_byzantine",
+    }
+    cfg = preset_config("vgg16_cifar10_poisoning", num_epochs=1)
+    assert cfg.model_name == "vgg16"
+    assert cfg.attack_enabled
+    cfg3 = preset_config("gpt2_small_pipeline_clean")
+    assert cfg3.parallelism == "model"
+
+
+def test_public_export_works():
+    """VERDICT r1: the ExperimentRunner export raised ModuleNotFoundError."""
+    import trustworthy_dl_tpu
+
+    assert trustworthy_dl_tpu.ExperimentRunner is ExperimentRunner
+
+
+def test_cli_main_smoke(tmp_path):
+    """trustworthy-dl-experiment --model ... --attack writes a results
+    tree (VERDICT r1 'done' criterion)."""
+    from trustworthy_dl_tpu.experiments.runner import main
+
+    rc = main([
+        "--name", "cli_smoke", "--model", "resnet32", "--dataset", "cifar10",
+        "--nodes", "4", "--epochs", "1", "--batch-size", "8",
+        "--steps-per-epoch", "4", "--attack", "--output-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    out = tmp_path / "cli_smoke"
+    assert (out / "experiment_results.json").exists()
+    assert (out / "experiment_report.md").exists()

@@ -1,0 +1,98 @@
+"""Console entry point ``trustworthy-dl-train`` (setup_py.py:62-64 implies
+``trustworthy_dl.cli:main``; the module itself is absent from the reference
+snapshot — interface reconstructed from the README usage example,
+README.md:50-78, and the YAML schema at README.md:111-132).
+
+Unlike the reference, ``--config`` actually loads the file, and flag
+overrides win over file values (experiment_runner.py:605,613-623 parsed the
+flag and ignored it)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import List, Optional
+
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s %(name)s %(levelname)s %(message)s",
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trustworthy-dl-train",
+        description="Trust-gated distributed training on TPU meshes",
+    )
+    parser.add_argument("--config", type=str,
+                        help="YAML/JSON config (README.md:111-132 schema)")
+    parser.add_argument("--model", type=str, default=None,
+                        help="gpt2[-medium|-large|-xl], resnet32/50/101, "
+                             "vgg11/13/16")
+    parser.add_argument("--dataset", type=str, default=None)
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--learning-rate", type=float, default=None)
+    parser.add_argument("--parallelism", type=str, default=None,
+                        choices=["data", "model", "tensor", "sequence",
+                                 "hybrid"])
+    parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the latest checkpoint before training")
+    parser.add_argument("--no-detection", action="store_true",
+                        help="disable the in-step attack detector")
+    parser.add_argument("--steps-per-epoch", type=int, default=50,
+                        help="synthetic-data epoch length")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from trustworthy_dl_tpu.core.config import TrainingConfig, load_config
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.engine.trainer import DistributedTrainer
+
+    args = build_parser().parse_args(argv)
+    overrides = {
+        k: v for k, v in {
+            "model_name": args.model,
+            "dataset_name": args.dataset,
+            "num_nodes": args.nodes,
+            "num_epochs": args.epochs,
+            "batch_size": args.batch_size,
+            "learning_rate": args.learning_rate,
+            "parallelism": args.parallelism,
+            "checkpoint_dir": args.checkpoint_dir,
+        }.items() if v is not None
+    }
+    if args.no_detection:
+        overrides["attack_detection_enabled"] = False
+    if args.config:
+        config = load_config(args.config, **overrides)
+    else:
+        config = TrainingConfig(**overrides)
+
+    trainer = DistributedTrainer(config)
+    trainer.initialize()
+    if args.resume:
+        trainer.load_checkpoint()
+
+    num_examples = config.batch_size * args.steps_per_epoch
+    train_dl = get_dataloader(config.dataset_name, split="train",
+                              batch_size=config.batch_size,
+                              num_examples=num_examples)
+    val_dl = get_dataloader(config.dataset_name, split="validation",
+                            batch_size=config.batch_size,
+                            num_examples=max(num_examples // 10,
+                                             config.batch_size))
+    result = trainer.train(train_dl, val_dl)
+    stats = result["stats"]
+    print(f"Training completed: {stats['global_step']} steps, "
+          f"final state {stats['training_state']}")
+    trainer.save_checkpoint()
+    trainer.cleanup()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,6 +127,12 @@ class ExperimentConfig:
     parallelism: str = "data"
     steps_per_epoch: int = 50
     seed: int = 0
+    attack_types: List[str] = field(
+        default_factory=lambda: ["gradient_poisoning", "data_poisoning"]
+    )
+    # The reference hardcodes nodes [1, 3] (experiment_runner.py:93).
+    target_nodes: List[int] = field(default_factory=lambda: [1, 3])
+    num_microbatches: int = 4
 
     def to_training_config(self) -> TrainingConfig:
         """Build the trainer config the way the reference runner does
@@ -140,6 +146,7 @@ class ExperimentConfig:
             num_nodes=self.num_nodes,
             trust_threshold=self.trust_threshold,
             parallelism=self.parallelism,
+            num_microbatches=self.num_microbatches,
             seed=self.seed,
         )
 
@@ -216,13 +223,8 @@ def _config_from_mapping(raw: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def load_config(path: str, **overrides: Any) -> TrainingConfig:
-    """Load a TrainingConfig from a YAML (or JSON) file.
-
-    ``overrides`` (e.g. CLI flags) take precedence over file values — the
-    behaviour the reference documented but never implemented
-    (experiment_runner.py:605,613-623).
-    """
+def _load_mapping(path: str) -> Dict[str, Any]:
+    """Parse a YAML (or JSON) config file to a mapping."""
     import json
 
     with open(path) as f:
@@ -241,6 +243,36 @@ def load_config(path: str, **overrides: Any) -> TrainingConfig:
             ) from e
     if not isinstance(raw, dict):
         raise ValueError(f"config file {path} did not parse to a mapping")
-    kwargs = _config_from_mapping(raw)
+    return raw
+
+
+def load_config(path: str, **overrides: Any) -> TrainingConfig:
+    """Load a TrainingConfig from a YAML (or JSON) file.
+
+    ``overrides`` (e.g. CLI flags) take precedence over file values — the
+    behaviour the reference documented but never implemented
+    (experiment_runner.py:605,613-623).
+    """
+    kwargs = _config_from_mapping(_load_mapping(path))
     kwargs.update({k: v for k, v in overrides.items() if v is not None})
     return TrainingConfig(**kwargs)
+
+
+def load_experiment_config(path: str, **overrides: Any) -> ExperimentConfig:
+    """Load an ExperimentConfig from a YAML/JSON file.
+
+    Accepts both the nested README schema (README.md:111-132 — shared with
+    ``load_config``) and flat ExperimentConfig field names; unknown keys are
+    ignored rather than raising, so a single config file can feed both
+    console scripts.  Flag overrides win over file values.
+    """
+    raw = _load_mapping(path)
+    flat = _config_from_mapping(raw)
+    valid = {f.name for f in dataclasses.fields(ExperimentConfig)}
+    kwargs = {k: v for k, v in flat.items() if k in valid}
+    for key, value in raw.items():
+        if key in valid:
+            kwargs[key] = value
+    kwargs.update({k: v for k, v in overrides.items() if v is not None})
+    kwargs.setdefault("experiment_name", "experiment")
+    return ExperimentConfig(**kwargs)

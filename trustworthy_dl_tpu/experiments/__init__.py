@@ -1,0 +1,17 @@
+"""Experiment layer (L5) — real-trainer-driven runner + BASELINE presets."""
+
+from trustworthy_dl_tpu.experiments.runner import (
+    PRESETS,
+    ExperimentRunner,
+    main,
+    preset_config,
+    run_threshold_sweep,
+)
+
+__all__ = [
+    "ExperimentRunner",
+    "PRESETS",
+    "main",
+    "preset_config",
+    "run_threshold_sweep",
+]
