@@ -1,0 +1,152 @@
+"""Elastic resharding: on confirmed compromise the node's mesh coordinate
+is actually removed, state migrates to the survivors via device_put, and
+training continues — replacing the reference's no-op
+perform_task_reassignment (distributed_trainer.py:367-380; plan at SURVEY
+§7.4(1))."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.elastic.reassignment import compact_train_state
+from trustworthy_dl_tpu.trust.state import NodeStatus
+
+TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
+                n_positions=32, seq_len=16)
+
+
+def make_trainer(tmp_path, num_nodes=8, **kw):
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext",
+        batch_size=2 * num_nodes, num_nodes=num_nodes, optimizer="adamw",
+        learning_rate=3e-3, detector_warmup=4, checkpoint_interval=10_000,
+        checkpoint_dir=str(tmp_path / "ckpt"), elastic_resharding=True, **kw,
+    )
+    return DistributedTrainer(config, model_overrides=dict(TINY_GPT))
+
+
+def test_compact_train_state_slices_per_node_rows(tmp_path):
+    trainer = make_trainer(tmp_path, num_nodes=4)
+    state = trainer.initialize()
+    state = state._replace(
+        trust=state.trust._replace(
+            scores=jnp.asarray([0.9, 0.8, 0.1, 0.7], jnp.float32)
+        )
+    )
+    keep = [0, 1, 3]
+    compact = compact_train_state(state, keep)
+    np.testing.assert_allclose(np.asarray(compact.trust.scores),
+                               [0.9, 0.8, 0.7])
+    assert compact.out_baseline.ring.shape[0] == 3
+    assert compact.verifier.count.shape == (3,)
+    assert compact.monitor.grad_norm_avg.shape[0] == 3
+    assert compact.prev_suspects.shape == (3,)
+    # Shared state untouched.
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(compact.params)):
+        assert a.shape == b.shape
+
+
+@pytest.fixture(scope="module")
+def evicted_run(tmp_path_factory):
+    """8-node run; node 5 attacked at step 8, confirmed, evicted; training
+    continues on 7 nodes."""
+    tmp_path = tmp_path_factory.mktemp("elastic")
+    trainer = make_trainer(tmp_path)
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[5],
+                     intensity=0.5, start_step=8)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(3)]
+    return trainer, losses
+
+
+def test_eviction_shrinks_mesh_and_continues(evicted_run):
+    trainer, losses = evicted_run
+    assert trainer.config.num_nodes == 7
+    assert trainer.node_map == [0, 1, 2, 3, 4, 6, 7]
+    assert len(list(trainer.mesh.devices.flat)) == 7
+    assert trainer.state.trust.scores.shape == (7,)
+    # Training survived the reshard and kept improving.
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_eviction_recorded_with_measured_migration(evicted_run):
+    trainer, _ = evicted_run
+    records = [r for r in trainer.reassignment_history
+               if "evicted_nodes" in r]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["evicted_nodes"] == [5]
+    assert rec["surviving_nodes"] == [0, 1, 2, 3, 4, 6, 7]
+    assert rec["migration_time_s"] > 0
+    assert rec["bytes_moved"] > 0
+    assert rec["measured_gbps"] > 0
+    # The measured rate replaced the 1 GB/s guess for future estimates.
+    assert trainer.config.migration_gbps == pytest.approx(
+        rec["measured_gbps"], rel=1e-6
+    ) or trainer.config.migration_gbps >= 1e-3
+
+
+def test_evicted_identity_preserved_on_host(evicted_run):
+    """Host bookkeeping keys on ORIGINAL ids across the reshard."""
+    trainer, _ = evicted_run
+    assert trainer.trust_manager.get_node_status(5) == NodeStatus.COMPROMISED
+    assert trainer.trust_manager.get_trust_score(5) < 0.3
+    # Survivors keep their identities and healthy trust.
+    for node in (0, 1, 2, 3, 4, 6, 7):
+        assert trainer.trust_manager.get_trust_score(node) > 0.5
+    attacked = {r["node_id"] for r in trainer.attack_history}
+    assert attacked == {5}
+
+
+def test_post_eviction_batches_resplit(evicted_run):
+    """The 16-sample global batch now splits over 7 nodes (trimmed)."""
+    trainer, _ = evicted_run
+    batch = {"input": np.zeros((16, 16), np.int32),
+             "target": np.zeros((16, 16), np.int32)}
+    node_batch = trainer._node_batch(batch)
+    assert node_batch["input"].shape == (7, 2, 16)
+
+
+def test_second_eviction(tmp_path):
+    """Two sequential evictions: 4 -> 3 -> 2 nodes, training still sane."""
+    trainer = make_trainer(tmp_path, num_nodes=4)
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=48)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[1],
+                     intensity=0.5, start_step=6)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    trainer.train_epoch(dl, 0)
+    trainer.train_epoch(dl, 1)
+    assert trainer.config.num_nodes == 3
+    # Second attack targets what is now coordinate 1 (original node 2).
+    from trustworthy_dl_tpu.attacks.adversarial import plan_from_config
+
+    plan2 = plan_from_config(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[1],
+                     intensity=0.5, start_step=0),
+        num_nodes=3, active=True,
+    )
+    trainer.set_attack_plan(plan2)
+    loss = trainer.train_epoch(dl, 2)
+    trainer.train_epoch(dl, 3)
+    assert trainer.config.num_nodes == 2
+    assert trainer.node_map == [0, 3]
+    assert np.isfinite(loss)

@@ -86,6 +86,12 @@ class TrainingConfig:
     # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer
     # rides ICI, so measure and override (elastic/reassignment.py).
     migration_gbps: float = 1.0
+    # Real elastic eviction (elastic/reassignment.py): on a confirmed
+    # compromise, remove the node's mesh coordinate, migrate state to the
+    # surviving devices and re-jit.  Off by default: the in-step trust gate
+    # already neutralises the node immediately; eviction additionally
+    # reclaims its device at the cost of a recompile.
+    elastic_resharding: bool = False
     # Optimizer
     optimizer: str = "adamw"
     weight_decay: float = 0.0

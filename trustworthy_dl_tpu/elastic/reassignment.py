@@ -1,0 +1,207 @@
+"""Elastic reassignment: REAL mesh rebuild + state migration.
+
+The reference's novelty path ends in a no-op: ``perform_task_reassignment``
+aliases the partition object and relabels a string
+(distributed_trainer.py:367-380), and its migration-time "estimate" is a
+hardcoded 1 GB/s guess (:354-365).  Here eviction is real:
+
+1. confirmed-compromised mesh coordinates are *removed from the device set*;
+2. a fresh ``Mesh`` is built over the survivors;
+3. every per-node row of the training world-view (trust, detector
+   baselines, verifier, monitor, suspect flags) is compacted to the
+   surviving coordinates and every array is migrated onto the new mesh with
+   ``jax.device_put``;
+4. the train step is re-jitted for the reduced node count (the slow path —
+   reassignment is rare; see SURVEY §7.4(1));
+5. the migration is *timed*, and the measured GB/s replaces the config's
+   ``migration_gbps`` estimate for future planning.
+
+Trust bookkeeping keeps ORIGINAL node ids throughout: the trainer's
+``node_map[k] -> original id`` translates device coordinates, so reports
+and the host TrustManager stay stable across evictions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
+from trustworthy_dl_tpu.engine.state import MonitorState, TrainState
+
+logger = logging.getLogger(__name__)
+
+
+def _tree_bytes(tree: Any) -> int:
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
+    """Slice every per-node leading-axis array down to the surviving
+    coordinates.  Params/opt_state are node-replicated in data-parallel
+    mode and pass through untouched; scalars (threshold, step, epoch, rng)
+    likewise."""
+    idx = np.asarray(list(keep), np.int32)
+
+    def take(leaf):
+        return leaf[idx]
+
+    trust = state.trust._replace(
+        scores=take(state.trust.scores),
+        status=take(state.trust.status),
+        update_count=take(state.trust.update_count),
+        last_updated=take(state.trust.last_updated),
+        decay_rate=take(state.trust.decay_rate),
+        recovery_rate=take(state.trust.recovery_rate),
+        metrics=take(state.trust.metrics),
+        attack_count=take(state.trust.attack_count),
+    )
+    out_bl = state.out_baseline._replace(
+        ring=take(state.out_baseline.ring),
+        count=take(state.out_baseline.count),
+    )
+    grad_bl = state.grad_baseline._replace(
+        ring=take(state.grad_baseline.ring),
+        count=take(state.grad_baseline.count),
+    )
+    verifier = state.verifier._replace(
+        count=take(state.verifier.count),
+        mean=take(state.verifier.mean),
+        m2=take(state.verifier.m2),
+    )
+    monitor = MonitorState(
+        count=take(state.monitor.count),
+        out_mean_avg=take(state.monitor.out_mean_avg),
+        out_std_avg=take(state.monitor.out_std_avg),
+        grad_norm_avg=take(state.monitor.grad_norm_avg),
+    )
+    return state._replace(
+        trust=trust,
+        out_baseline=out_bl,
+        grad_baseline=grad_bl,
+        verifier=verifier,
+        monitor=monitor,
+        prev_suspects=take(state.prev_suspects),
+    )
+
+
+def surviving_devices(mesh: jax.sharding.Mesh, num_nodes: int,
+                      drop: Sequence[int]) -> List[jax.Device]:
+    """Device list after evicting node coordinates.
+
+    When the data axis maps one device per node, the evicted node's chip
+    leaves the mesh (true elasticity).  When logical nodes are vmapped
+    within fewer devices (dev mode / small hosts), the device set is
+    unchanged — eviction then only narrows the logical node axis."""
+    devices = list(mesh.devices.flat)
+    if len(devices) == num_nodes:
+        return [d for i, d in enumerate(devices) if i not in set(drop)]
+    return devices
+
+
+def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
+    """Evict mesh coordinates, migrate state, re-jit; returns the measured
+    migration record.  ``drop`` holds CURRENT coordinates (the trainer
+    translates original ids before calling)."""
+    from trustworthy_dl_tpu.engine.step import build_eval_step, \
+        build_train_step
+
+    config = trainer.config
+    if config.parallelism != "data":
+        raise NotImplementedError(
+            "elastic resharding currently supports data parallelism; a "
+            "compromised pipeline stage is frozen in-step instead "
+            "(parallel/pipeline.py trust gate)"
+        )
+    n = config.num_nodes
+    drop = sorted(set(int(d) for d in drop))
+    keep = [i for i in range(n) if i not in drop]
+    if not keep:
+        raise ValueError("cannot evict every node")
+
+    t0 = time.perf_counter()
+    new_devices = surviving_devices(trainer.mesh, n, drop)
+    new_mesh = build_mesh(len(keep), "data", devices=new_devices)
+    new_config = dataclasses.replace(config, num_nodes=len(keep))
+
+    compact = compact_train_state(trainer.state, keep)
+
+    # Migrate onto the new mesh: per-node arrays shard over the surviving
+    # data axis; everything else replicates.  This is the device_put
+    # migration the reference's no-op claimed to do.
+    mesh_axis = dict(zip(new_mesh.axis_names, new_mesh.devices.shape))
+    data_size = mesh_axis.get(DATA_AXIS, 1)
+    replicated = NamedSharding(new_mesh, P())
+
+    def shard_per_node(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] == len(keep) and \
+                data_size > 1 and len(keep) % data_size == 0:
+            spec = P(DATA_AXIS, *([None] * (leaf.ndim - 1)))
+            return jax.device_put(leaf, NamedSharding(new_mesh, spec))
+        return jax.device_put(leaf, replicated)
+
+    per_node_fields = dict(
+        trust=compact.trust, out_baseline=compact.out_baseline,
+        grad_baseline=compact.grad_baseline, verifier=compact.verifier,
+        monitor=compact.monitor, prev_suspects=compact.prev_suspects,
+    )
+    migrated_nodes = {
+        k: jax.tree_util.tree_map(shard_per_node, v)
+        for k, v in per_node_fields.items()
+    }
+    migrated_shared = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, replicated),
+        {"params": compact.params, "opt_state": compact.opt_state,
+         "step": compact.step, "epoch": compact.epoch, "rng": compact.rng},
+    )
+    new_state = compact._replace(**migrated_nodes, **migrated_shared)
+    jax.block_until_ready(new_state)
+    migration_time = time.perf_counter() - t0
+
+    bytes_moved = _tree_bytes(new_state)
+    measured_gbps = bytes_moved / max(migration_time, 1e-9) / 1024**3
+
+    # Re-jit for the reduced node count (rare path; recompilation accepted
+    # per SURVEY §7.4(1)).
+    trainer.mesh = new_mesh
+    trainer.config = new_config
+    trainer._train_step = jax.jit(
+        build_train_step(trainer.model, new_config, trainer.optimizer),
+        donate_argnums=(0,),
+    )
+    trainer._eval_step = jax.jit(build_eval_step(trainer.model))
+    trainer.state = new_state
+    trainer.attack_plan = trainer.attack_plan._replace(
+        target_mask=trainer.attack_plan.target_mask[np.asarray(keep)]
+    )
+    evicted_ids = [trainer.node_map[i] for i in drop]
+    trainer.node_map = [trainer.node_map[i] for i in keep]
+    # The measured rate replaces the 1 GB/s guess for future estimates
+    # (distributed_trainer.py:360).
+    trainer.config = dataclasses.replace(
+        new_config, migration_gbps=max(measured_gbps, 1e-3)
+    )
+
+    record = {
+        "evicted_nodes": evicted_ids,
+        "surviving_nodes": list(trainer.node_map),
+        "migration_time_s": migration_time,
+        "bytes_moved": bytes_moved,
+        "measured_gbps": measured_gbps,
+        "new_device_count": len(new_devices),
+        "timestamp": time.time(),
+    }
+    logger.warning(
+        "Elastic eviction: nodes %s removed; %d coordinates remain on %d "
+        "device(s); migrated %.1f MB in %.3fs (%.2f GB/s)",
+        evicted_ids, len(keep), len(new_devices), bytes_moved / 2**20,
+        migration_time, measured_gbps,
+    )
+    return record

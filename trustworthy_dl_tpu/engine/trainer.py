@@ -93,6 +93,11 @@ class DistributedTrainer:
 
         self.attack_history: List[Dict] = []
         self.reassignment_history: List[Dict] = []
+        # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
+        # eviction removes coordinates (elastic/reassignment.py); all host
+        # bookkeeping (trust manager, histories, reports) keys on original
+        # ids so identities survive resharding.
+        self.node_map: List[int] = list(range(config.num_nodes))
         # Nodes currently in a recorded-compromised episode: a sustained
         # attack fires the detector every batch, but we record the incident
         # and trigger reassignment only on the clean→compromised transition
@@ -280,42 +285,65 @@ class DistributedTrainer:
         attacked = np.asarray(metrics.attacked)
         verified = np.asarray(metrics.verified)
         trust = np.asarray(metrics.trust_scores)
+        id_of = self.node_map  # coordinate -> original node id
         self.metrics_collector.collect_batch_metrics(
             {
                 "loss": loss,
                 "step": self.global_step,
                 "epoch": epoch,
-                "trust_scores": {i: float(trust[i]) for i in range(len(trust))},
+                "trust_scores": {
+                    id_of[i]: float(trust[i]) for i in range(len(trust))
+                },
             }
         )
         flagged = attacked | ~verified
         # Close incidents for nodes the device-side state machine has
         # rehabilitated, so a later re-attack records a fresh incident.
+        # (Evicted nodes have no coordinate and stay closed-out forever.)
         status = np.asarray(metrics.status)
-        for node_id in list(self._open_incidents):
-            if not flagged[node_id] and status[node_id] != int(
-                NodeStatus.COMPROMISED
-            ):
-                self._open_incidents.discard(node_id)
+        coord_of = {orig: i for i, orig in enumerate(id_of)}
+        for orig in list(self._open_incidents):
+            coord = coord_of.get(orig)
+            if coord is not None and not flagged[coord] and status[
+                coord
+            ] != int(NodeStatus.COMPROMISED):
+                self._open_incidents.discard(orig)
+        evict_coords: List[int] = []
         if flagged.any():
             types = np.asarray(metrics.attack_type)
-            for node_id in np.nonzero(flagged)[0]:
-                if int(node_id) in self._open_incidents:
+            for coord in np.nonzero(flagged)[0]:
+                orig = id_of[int(coord)]
+                if orig in self._open_incidents:
                     continue
-                self._open_incidents.add(int(node_id))
+                self._open_incidents.add(orig)
                 self._handle_detected_attack(
-                    int(node_id),
-                    attack_type=AttackType(int(types[node_id])).label
-                    if attacked[node_id] else "gradient_verification_failure",
+                    orig,
+                    attack_type=AttackType(int(types[coord])).label
+                    if attacked[coord] else "gradient_verification_failure",
                     metrics=metrics,
+                    coord=int(coord),
                 )
+                evict_coords.append(int(coord))
+        if (evict_coords and self.config.elastic_resharding
+                and self.config.parallelism == "data"
+                and len(evict_coords) < self.config.num_nodes):
+            from trustworthy_dl_tpu.elastic.reassignment import (
+                evict_and_reshard,
+            )
+
+            record = evict_and_reshard(self, evict_coords)
+            record["step"] = self.global_step
+            self.reassignment_history.append(record)
 
     def _handle_detected_attack(self, node_id: int, attack_type: str,
-                                metrics: StepMetrics) -> None:
+                                metrics: StepMetrics,
+                                coord: Optional[int] = None) -> None:
         """Host-side reaction (distributed_trainer.py:273-322): record the
         incident, mirror compromise into the host TrustManager, trigger
         reassignment.  The in-step mitigation (grad gating) already happened
-        on device in the same step."""
+        on device in the same step.  ``node_id`` is the ORIGINAL id;
+        ``coord`` its current mesh coordinate (equal until eviction)."""
+        coord = node_id if coord is None else coord
         logger.error("Attack detected on node %d (%s)", node_id, attack_type)
         self.attack_history.append(
             {
@@ -324,13 +352,17 @@ class DistributedTrainer:
                 "step": self.global_step,
                 "attack_type": attack_type,
                 "output_stats": {
-                    "anomaly_score": float(np.asarray(metrics.out_score)[node_id]),
-                    "gradient_score": float(np.asarray(metrics.grad_score)[node_id]),
+                    "anomaly_score": float(np.asarray(metrics.out_score)[coord]),
+                    "gradient_score": float(np.asarray(metrics.grad_score)[coord]),
                 },
             }
         )
         self.trust_manager.mark_compromised(node_id, attack_type)
-        self.reassign_node_tasks(node_id)
+        if not (self.config.elastic_resharding
+                and self.config.parallelism == "data"):
+            # Legacy greedy handoff (relabel) — elastic mode replaces it
+            # with the real eviction in _record_batch.
+            self.reassign_node_tasks(node_id)
         self.training_state = TrainingState.UNDER_ATTACK
 
     # ------------------------------------------------------------------
@@ -428,11 +460,15 @@ class DistributedTrainer:
 
     def sync_host_state(self) -> None:
         """Epoch-cadence absorption of device state into the host reporting
-        objects (TrustManager / NodeMonitor)."""
+        objects (TrustManager / NodeMonitor).  After elastic eviction the
+        device arrays cover only surviving coordinates; ``node_map``
+        routes them to their original host ids."""
         if self.state is None:
             return
-        self.trust_manager.sync_from_device(self.state.trust)
-        self.node_monitor.sync_from_device(self.state.monitor)
+        self.trust_manager.sync_from_device(self.state.trust,
+                                            node_ids=self.node_map)
+        self.node_monitor.sync_from_device(self.state.monitor,
+                                           node_ids=self.node_map)
 
     def get_training_stats(self) -> Dict[str, Any]:
         """distributed_trainer.py:510-521."""

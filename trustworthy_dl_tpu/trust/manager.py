@@ -389,27 +389,34 @@ class TrustManager:
         )
         return state._replace(scores=scores, status=status, update_count=counts)
 
-    def sync_from_device(self, state: TrustState, wall_time: Optional[float] = None
-                         ) -> None:
+    def sync_from_device(self, state: TrustState,
+                         wall_time: Optional[float] = None,
+                         node_ids: Optional[List[int]] = None) -> None:
         """Absorb a TrustState computed inside the train step (called once
-        per epoch / reporting interval, not per batch)."""
+        per epoch / reporting interval, not per batch).  ``node_ids`` maps
+        device coordinates to original host ids — after elastic eviction
+        the device arrays cover only the surviving nodes."""
         wall_time = wall_time if wall_time is not None else time.time()
         scores = np.asarray(state.scores)
         status = np.asarray(state.status)
         counts = np.asarray(state.update_count)
         metrics = np.asarray(state.metrics)
         self.trust_threshold = float(np.asarray(state.threshold))
-        for i in range(min(self.num_nodes, scores.shape[0])):
+        if node_ids is None:
+            node_ids = list(range(min(self.num_nodes, scores.shape[0])))
+        for coord, i in enumerate(node_ids):
+            if i >= self.num_nodes or coord >= scores.shape[0]:
+                continue
             old = self.trust_scores[i]
             self.trust_scores[i] = TrustScore(
-                value=float(scores[i]),
+                value=float(scores[coord]),
                 last_updated=wall_time,
-                update_count=int(counts[i]),
+                update_count=int(counts[coord]),
                 decay_rate=old.decay_rate,
                 recovery_rate=old.recovery_rate,
             )
-            self.node_status[i] = NodeStatus(int(status[i]))
-            m = metrics[i]
+            self.node_status[i] = NodeStatus(int(status[coord]))
+            m = metrics[coord]
             self.node_metrics[i] = NodeMetrics(
                 output_deviation=float(m[0]),
                 gradient_consistency=float(m[1]),
@@ -421,7 +428,7 @@ class TrustManager:
             self.trust_history[i].append(
                 {
                     "timestamp": wall_time,
-                    "trust_score": float(scores[i]),
+                    "trust_score": float(scores[coord]),
                     "metrics": self.node_metrics[i].__dict__.copy(),
                 }
             )

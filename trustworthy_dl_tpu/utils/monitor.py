@@ -43,17 +43,21 @@ class NodeMonitor:
             w = 1.0 / max(c, 1)
             self._grad_norms_avg[node_id] = prev * (1 - w) + arr * w
 
-    def sync_from_device(self, monitor_state) -> None:
-        """Absorb an engine MonitorState pytree."""
+    def sync_from_device(self, monitor_state, node_ids=None) -> None:
+        """Absorb an engine MonitorState pytree.  ``node_ids`` maps device
+        coordinates to original node ids (post-eviction meshes cover only
+        the survivors)."""
         counts = np.asarray(monitor_state.count)
         means = np.asarray(monitor_state.out_mean_avg)
         stds = np.asarray(monitor_state.out_std_avg)
         norms = np.asarray(monitor_state.grad_norm_avg)
-        for i in range(counts.shape[0]):
-            self._count[i] = int(counts[i])
-            self._mean_avg[i] = float(means[i])
-            self._std_avg[i] = float(stds[i])
-            self._grad_norms_avg[i] = norms[i].astype(np.float64)
+        if node_ids is None:
+            node_ids = list(range(counts.shape[0]))
+        for coord, i in enumerate(node_ids):
+            self._count[i] = int(counts[coord])
+            self._mean_avg[i] = float(means[coord])
+            self._std_avg[i] = float(stds[coord])
+            self._grad_norms_avg[i] = norms[coord].astype(np.float64)
 
     # -- reference API -----------------------------------------------------
 
