@@ -1,3 +1,7 @@
-from trustworthy_dl_tpu.data.loader import ArrayDataLoader, get_dataloader
+from trustworthy_dl_tpu.data.loader import (
+    ArrayDataLoader,
+    PrefetchLoader,
+    get_dataloader,
+)
 
-__all__ = ["ArrayDataLoader", "get_dataloader"]
+__all__ = ["ArrayDataLoader", "PrefetchLoader", "get_dataloader"]

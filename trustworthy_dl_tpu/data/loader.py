@@ -18,20 +18,28 @@ This environment is zero-egress, so each dataset has two tiers:
 from __future__ import annotations
 
 import os
+import queue
+import threading
 from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
 
+from trustworthy_dl_tpu import native
+
 
 class ArrayDataLoader:
-    """Deterministic batched iterator over {'input','target'} arrays."""
+    """Deterministic batched iterator over {'input','target'} arrays.
+
+    Epoch shuffles and per-batch row gathers run on the native C++ tier
+    (trustworthy_dl_tpu/native) when the library is available, with bit-exact
+    Python fallbacks — batch contents are identical either way."""
 
     def __init__(self, inputs: np.ndarray, targets: np.ndarray,
                  batch_size: int, shuffle: bool = True, seed: int = 0,
                  drop_last: bool = True):
         assert len(inputs) == len(targets)
-        self.inputs = inputs
-        self.targets = targets
+        self.inputs = np.ascontiguousarray(inputs)
+        self.targets = np.ascontiguousarray(targets)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
@@ -45,17 +53,88 @@ class ArrayDataLoader:
         return n
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        idx = np.arange(len(self.inputs))
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self._epoch)
-            rng.shuffle(idx)
+            idx = native.permutation(self.seed + self._epoch, len(self.inputs))
+        else:
+            idx = np.arange(len(self.inputs), dtype=np.int64)
         self._epoch += 1
         for start in range(0, len(idx) - (len(idx) % self.batch_size if self.drop_last else 0),
                            self.batch_size):
             sel = idx[start:start + self.batch_size]
             if len(sel) == 0:
                 break
-            yield {"input": self.inputs[sel], "target": self.targets[sel]}
+            yield {
+                "input": native.gather_rows(self.inputs, sel),
+                "target": native.gather_rows(self.targets, sel),
+            }
+
+
+class PrefetchLoader:
+    """Background-thread prefetch over any batch iterable: batch k+1
+    assembles on the host (native gathers) while batch k trains on device —
+    double buffering for the input pipeline (depth configurable)."""
+
+    def __init__(self, loader: Any, depth: int = 2):
+        self.loader = loader
+        self.depth = max(1, depth)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        sentinel = object()
+        errbox: list = []
+
+        def produce() -> None:
+            try:
+                for batch in self.loader:
+                    # Bounded put that notices consumer cancellation — a
+                    # plain q.put would block forever if the consumer
+                    # abandoned iteration with the queue full.
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as exc:  # surface in the consumer
+                errbox.append(exc)
+            finally:
+                # The sentinel needs the same cancellation-aware bounded put
+                # as batches: with the queue still holding undelivered
+                # batches a put_nowait would drop the sentinel and leave a
+                # live consumer blocked on q.get() forever.
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+
+        worker = threading.Thread(target=produce, daemon=True)
+        worker.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            # Runs on normal exhaustion AND on early exit (break / GC of the
+            # generator): release the producer and reap the thread.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            worker.join(timeout=5)
+        if errbox:
+            raise errbox[0]
 
 
 # ---------------------------------------------------------------------------
@@ -66,16 +145,9 @@ class ArrayDataLoader:
 def _synthetic_tokens(num_tokens: int, vocab_size: int, seed: int) -> np.ndarray:
     """Affine next-token process with 10% uniform noise: t_{i+1} =
     (a*t_i + b) mod V usually — low-entropy enough that a model visibly
-    learns, noisy enough that loss stays finite and non-zero."""
-    rng = np.random.default_rng(seed)
-    a, b = 31, 7
-    toks = np.empty(num_tokens, np.int32)
-    toks[0] = rng.integers(vocab_size)
-    noise = rng.random(num_tokens) < 0.1
-    randoms = rng.integers(0, vocab_size, num_tokens)
-    for i in range(1, num_tokens):
-        toks[i] = randoms[i] if noise[i] else (a * toks[i - 1] + b) % vocab_size
-    return toks
+    learns, noisy enough that loss stays finite and non-zero.  Generated by
+    the native tier (C++ when available, bit-exact numpy otherwise)."""
+    return native.synthetic_tokens(num_tokens, vocab_size, seed)
 
 
 def _synthetic_images(num: int, num_classes: int, shape, seed: int):

@@ -1,0 +1,109 @@
+// Native data-loader core for the TPU framework's host-side input pipeline.
+//
+// The reference's data layer is an *implied* module (imported but missing
+// from the snapshot — SURVEY §2.3 utils/data_loader.py); its runtime is pure
+// Python end to end.  Here the batch-assembly hot path — synthetic token
+// synthesis, epoch permutations, and row gathers — is C++ behind ctypes,
+// with bit-exact Python fallbacks (trustworthy_dl_tpu/native/__init__.py) so
+// the framework runs identically where no compiler exists.
+//
+// Determinism contract: every routine is a pure function of (seed, n) using
+// splitmix64; the Python fallbacks implement the same arithmetic, and
+// tests/test_native.py pins C++ == Python bit-for-bit.
+//
+// Build: g++ -O3 -shared -fPIC -o libtddl_native.so dataloader.cpp -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// splitmix64 (public-domain algorithm, Steele et al.): the shared
+// deterministic generator.  state walks seed + i*GOLDEN.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Raw stream: out[i] = splitmix64(seed + i*GOLDEN) — stateless, so any
+// subrange can be regenerated independently (the Python fallback vectorises
+// exactly this).
+void tddl_splitmix_fill(uint64_t seed, int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = splitmix64(seed + (uint64_t)i * 0x9E3779B97F4A7C15ULL);
+  }
+}
+
+// Learnable synthetic LM stream (data/loader.py contract): affine
+// next-token chain t_{i+1} = (a*t_i + b) mod V with 10% uniform resets.
+// Noise decisions and reset tokens come from two independent splitmix
+// streams so the chain stays sequential but the randomness is O(1)
+// addressable.
+void tddl_synthetic_tokens(int64_t n, int32_t vocab, uint64_t seed,
+                           int32_t* out) {
+  const int32_t a = 31, b = 7;
+  const uint64_t noise_seed = splitmix64(seed ^ 0xA5A5A5A5A5A5A5A5ULL);
+  const uint64_t tok_seed = splitmix64(seed ^ 0x5A5A5A5A5A5A5A5AULL);
+  int32_t t = (int32_t)(splitmix64(seed) % (uint64_t)vocab);
+  out[0] = t;
+  for (int64_t i = 1; i < n; ++i) {
+    uint64_t u = splitmix64(noise_seed + (uint64_t)i * 0x9E3779B97F4A7C15ULL);
+    if ((u >> 48) < 6554) {  // top 16 bits < 0.1 * 65536
+      uint64_t r = splitmix64(tok_seed + (uint64_t)i * 0x9E3779B97F4A7C15ULL);
+      t = (int32_t)(r % (uint64_t)vocab);
+    } else {
+      t = (int32_t)(((int64_t)a * t + b) % vocab);
+    }
+    out[i] = t;
+  }
+}
+
+// Fisher-Yates permutation of [0, n) driven by the splitmix stream.
+// Rejection-free modulo bias is acceptable here (shuffling quality, not
+// cryptography), but the arithmetic must match the Python fallback exactly.
+void tddl_permutation(uint64_t seed, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t u = splitmix64(seed + (uint64_t)i * 0x9E3779B97F4A7C15ULL);
+    int64_t j = (int64_t)(u % (uint64_t)(i + 1));
+    int64_t tmp = out[i];
+    out[i] = out[j];
+    out[j] = tmp;
+  }
+}
+
+// Batch assembly: gather rows of a contiguous [num_rows, row_bytes] buffer
+// into out following idx.  Multi-threaded memcpy — this is the per-batch
+// hot path the Python loader paid numpy fancy-indexing overhead for.
+void tddl_gather_rows(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                      int64_t row_bytes, uint8_t* out, int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads == 1 || n_idx < 64) {
+    for (int64_t i = 0; i < n_idx; ++i) {
+      std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes,
+                  (size_t)row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t w = 0; w < n_threads; ++w) {
+    int64_t lo = (int64_t)w * chunk;
+    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
+    if (lo >= hi) break;
+    workers.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes,
+                    (size_t)row_bytes);
+      }
+    });
+  }
+  for (auto& t : workers) t.join();
+}
+
+}  // extern "C"
