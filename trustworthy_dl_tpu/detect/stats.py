@@ -209,7 +209,16 @@ def leafwise_statistics(
     leaves from per-leaf sums, and only the ≤max_sort order-statistics
     subsample is ever gathered.  The finite flag derives from s1/s2 (NaN/Inf
     anywhere propagates into both), so no separate isfinite pass."""
+    from trustworthy_dl_tpu.ops import fused_stats as fs
+
+    use_pallas = fs.pallas_enabled()
+
     def moments(f):
+        if use_pallas and f.dtype == jnp.float32 and \
+                int(f.size) >= fs.BLOCK_ROWS * fs.LANES:
+            # Native tier (SURVEY §7.1): one explicit HBM pass for all eight
+            # reductions via the Pallas kernel; XLA handles the tail.
+            return fs.fused_moments(f)
         x = f if f.dtype == jnp.float32 else None
         # Shared x² subexpression; f32 accumulators even for bf16 inputs,
         # with the cast fused into the reductions (no materialised copy).

@@ -48,7 +48,7 @@ class GPT2Config:
     n_embd: int = 768
     n_head: int = 12
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "full"   # full | ring | ulysses
+    attn_impl: str = "full"   # full | flash | ring | ulysses
     remat: bool = False
 
     @staticmethod
@@ -92,9 +92,13 @@ register_attention("full", full_attention)
 
 def get_attention(name: str) -> AttnFn:
     if name not in _ATTN_REGISTRY:
-        # Late registration: sequence-parallel impls live in parallel/.
+        # Late registration: sequence-parallel impls live in parallel/,
+        # the Pallas blockwise kernel in ops/.
         if name in ("ring", "ulysses"):
             import trustworthy_dl_tpu.parallel.sequence  # noqa: F401
+        elif name == "flash":
+            from trustworthy_dl_tpu.ops.flash_attention import flash_attention
+            register_attention("flash", flash_attention)
         if name not in _ATTN_REGISTRY:
             raise ValueError(f"unknown attention impl {name!r}")
     return _ATTN_REGISTRY[name]

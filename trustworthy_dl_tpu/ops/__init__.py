@@ -1,0 +1,27 @@
+"""Native ops tier: Pallas TPU kernels (SURVEY §7.1).
+
+The reference has no native code at all (SURVEY §0: pure Python); this
+package is where the TPU build drops below XLA when the compiler's fusion
+isn't enough.  Current kernels:
+
+* ``fused_stats`` — single-pass detector moment battery (Σx..Σx⁴, min/max,
+  L1/L∞) feeding detect/stats.leafwise_statistics.
+* ``flash_attention`` — blockwise softmax attention, fwd + bwd, O(T·D)
+  memory (``attn_impl="flash"`` in the GPT-2 registry).
+"""
+
+from trustworthy_dl_tpu.ops.flash_attention import flash_attention
+from trustworthy_dl_tpu.ops.fused_stats import (
+    BLOCK_ROWS,
+    LANES,
+    fused_moments,
+    pallas_enabled,
+)
+
+__all__ = [
+    "BLOCK_ROWS",
+    "LANES",
+    "flash_attention",
+    "fused_moments",
+    "pallas_enabled",
+]

@@ -1,0 +1,341 @@
+"""Pallas TPU flash attention: blockwise softmax attention, fwd + bwd.
+
+The reference has no attention code at all (SURVEY §5.7 — models came from
+an implied ModelFactory and only the layer list was touched); long-context
+support in this framework is first-class, and this kernel is its native
+tier (SURVEY §7.1).  ``full_attention`` (models/gpt2.py) materialises the
+[T, T] score matrix in HBM; this kernel streams K/V blocks through VMEM
+with an online-softmax accumulator, so attention costs O(T·D) memory at
+any sequence length, and the two matmuls per block land on the MXU in one
+fused pass per tile.
+
+Three kernels:
+  * forward — per Q block: stream K/V blocks, keep (m, l, acc) running
+    max / normaliser / weighted sum; emits output AND the row logsumexp
+    (the residual that makes the backward recomputation exact).
+  * dq — per Q block: re-stream K/V, rebuild P = exp(S − lse), accumulate
+    dQ = scale · (P ∘ (dO·Vᵀ − Δ)) · K.
+  * dkv — per K/V block: stream Q/dO blocks, accumulate
+    dV = Pᵀ·dO and dK = scale · (P ∘ (dO·Vᵀ − Δ))ᵀ · Q.
+
+Causal masking skips fully-masked tiles at the grid level (half the work)
+and masks the diagonal tile elementwise.  Numerics are f32 throughout the
+accumulators regardless of input dtype; outputs cast back.
+
+Registered with the GPT-2 attention registry as ``attn_impl="flash"``.
+Shapes that don't tile (T not a multiple of the block) fall back to the
+XLA path — same math, so the swap is always safe.  Off-TPU the kernel runs
+in Pallas interpret mode; tests pin fwd/bwd equality against
+``full_attention`` on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30          # finite stand-in: exp(NEG_INF - m) flushes to 0
+_LANES = 128
+
+
+def _block_for(t: int) -> int:
+    """Largest supported block size dividing T (0 = no tiling, fall back)."""
+    for b in (256, 128, 64):
+        if t % b == 0 and t >= b:
+            return b
+    return 0
+
+
+def _dot(a: jax.Array, b: jax.Array, trans_a: bool = False,
+         trans_b: bool = False) -> jax.Array:
+    """f32-accumulating matmul for the MXU."""
+    ca = 0 if trans_a else 1
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(
+        a, b, (((ca,), (cb,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _causal_mask(qi, ki, bq: int, bk: int) -> jax.Array:
+    qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return qpos >= kpos
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, bq: int, bk: int, nk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0]
+        s = _dot(q, k_ref[0], trans_b=True) * scale          # [bq, bk] f32
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk), s, NEG_INF)
+        m_prev = m_ref[:, :1]                                # [bq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)                               # masked -> 0
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[:] = acc_ref[:] * corr + _dot(
+            p.astype(v_ref.dtype), v_ref[0]
+        )
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    if causal:
+        # Tiles entirely above the diagonal contribute nothing: skip.
+        pl.when(ki * bk <= (qi + 1) * bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l)).reshape(1, bq)[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+)
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+               bq: int, bk: int, interpret: bool
+               ) -> Tuple[jax.Array, jax.Array]:
+    """[BH, T, D] x3 -> (o [BH, T, D], lse f32[BH, T])."""
+    bh, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale: float, causal: bool, bq: int, bk: int,
+               nk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0]
+        s = _dot(q, k_ref[0], trans_b=True) * scale
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk), s, NEG_INF)
+        lse = lse_ref[0].reshape(bq, 1)                       # row -> column
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dp = _dot(do_ref[0], v_ref[0], trans_b=True)          # [bq, bk] f32
+        delta = delta_ref[0].reshape(bq, 1)
+        ds = p * (dp - delta)
+        dq_acc[:] += _dot(ds.astype(k_ref.dtype), k_ref[0]) * scale
+
+    if causal:
+        pl.when(ki * bk <= (qi + 1) * bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                causal: bool, bq: int, bk: int, nq: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0]
+        s = _dot(q, k_ref[0], trans_b=True) * scale           # [bq, bk]
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, bq, bk), s, NEG_INF)
+        lse = lse_ref[0].reshape(bq, 1)
+        p = jnp.exp(s - lse)
+        do = do_ref[0]
+        dv_acc[:] += _dot(p.astype(do.dtype), do, trans_a=True)
+        dp = _dot(do, v_ref[0], trans_b=True)
+        delta = delta_ref[0].reshape(bq, 1)
+        ds = p * (dp - delta)
+        dk_acc[:] += _dot(ds.astype(q.dtype), q, trans_a=True) * scale
+
+    if causal:
+        pl.when((qi + 1) * bq - 1 >= ki * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+)
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
+               interpret: bool):
+    bh, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / math.sqrt(d)
+    # Δ_i = Σ_d dO_i·O_i — one fused XLA reduction, reused by both kernels.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public entry
+# ---------------------------------------------------------------------------
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal: bool, bq: int, bk: int):
+    o, _ = _flash_fwd(q, k, v, causal, bq, bk, _interpret())
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, bq, bk):
+    o, lse = _flash_fwd(q, k, v, causal, bq, bk, _interpret())
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, bq, bk, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, bq, bk, _interpret())
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    """[B, H, T, D] (or [BH, T, D]) blockwise flash attention.
+
+    Drop-in for ``full_attention``: same math (pinned by
+    tests/test_flash_attention.py), O(T·D) memory instead of O(T²).
+    Non-tiling sequence lengths fall back to the XLA path.
+    """
+    from trustworthy_dl_tpu.models.gpt2 import full_attention
+
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    b, h, t, d = q.shape
+    block = _block_for(t)
+    if block == 0 or d > 512:
+        out = full_attention(q, k, v, causal)
+        return out[0] if squeeze else out
+
+    merge = lambda a: a.reshape(b * h, t, d)
+    out = _flash(merge(q), merge(k), merge(v), causal, block, block)
+    out = out.reshape(b, h, t, d)
+    return out[0] if squeeze else out
+
+
+__all__ = ["flash_attention"]
