@@ -25,12 +25,14 @@ DATA_AXIS = "data"     # data parallel shards
 STAGE_AXIS = "stage"   # pipeline stages (reference's layer-split "nodes")
 MODEL_AXIS = "model"   # tensor parallel (attention heads / mlp hidden)
 SEQ_AXIS = "seq"       # sequence/context parallel
+EXPERT_AXIS = "expert"  # expert parallel (MoE expert dim)
 
 _PARALLELISM_AXIS = {
     "data": DATA_AXIS,
     "model": STAGE_AXIS,
     "tensor": MODEL_AXIS,
     "sequence": SEQ_AXIS,
+    "expert": EXPERT_AXIS,
 }
 
 

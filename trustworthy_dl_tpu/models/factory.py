@@ -71,12 +71,31 @@ class ModelFactory:
     """Creates models by name (distributed_trainer.py:116-119).
 
     Supported (README.md:85-92): gpt2[-small|-medium|-large|-xl],
-    resnet32/50/101, vgg11/13/16.  ``overrides`` reach the family config —
-    tests use tiny GPT-2s via n_layer/n_embd/vocab_size overrides.
+    resnet32/50/101, vgg11/13/16, plus gpt2[-size]-moe MoE variants
+    (beyond-reference; SURVEY §2.4 EP row).  ``overrides`` reach the family
+    config — tests use tiny GPT-2s via n_layer/n_embd/vocab_size overrides.
     """
 
     def create_model(self, model_name: str, **overrides: Any) -> ModelBundle:
         name = model_name.lower()
+        if name.startswith("gpt") and name.endswith("-moe"):
+            from trustworthy_dl_tpu.models import moe
+
+            seq_len = overrides.pop("seq_len", 128)
+            cfg = moe.MoEConfig.from_name(name, **overrides)
+            return ModelBundle(
+                name=name,
+                kind="lm",
+                config=cfg,
+                init=lambda rng, c=cfg: moe.init_params(rng, c),
+                apply=lambda p, x, c=cfg: moe.forward(p, x, c),
+                loss=lambda p, b, c=cfg: moe.loss_fn(p, b, c),
+                num_blocks=cfg.n_layer,
+                input_spec={"seq_len": seq_len, "vocab_size": cfg.vocab_size},
+                apply_monitor=lambda p, x, c=cfg: moe.forward_with_monitor(
+                    p, x, c
+                ),
+            )
         if name.startswith("gpt"):
             seq_len = overrides.pop("seq_len", 128)
             cfg = gpt2.GPT2Config.from_name(name, **overrides)

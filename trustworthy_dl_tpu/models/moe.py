@@ -1,0 +1,292 @@
+"""Mixture-of-Experts GPT-2 with expert parallelism over the 'expert' axis.
+
+Out of the reference's scope (SURVEY §2.4 lists EP/MoE as absent — "optional
+stretch"), built here because the charter makes every parallelism strategy
+first-class.  The design is the classic TPU-native dense-dispatch MoE
+(GShard/Switch): routing is expressed as two einsums against a
+[tokens, experts, capacity] dispatch/combine tensor, so the whole layer is
+MXU matmuls with static shapes — no scatters, no dynamic shapes, nothing
+XLA can't tile.  Expert weights carry a leading E axis sharded on the
+'expert' mesh axis; under a mesh context (``use_expert_mesh``) sharding
+constraints on the [E, C, d] expert blocks make GSPMD insert the canonical
+all_to_all pair around the expert FFNs.
+
+Routing: top-k (default 2) softmax gating, combine weights renormalised
+over the selected experts; per-expert capacity C = ceil(k·S/E · factor);
+overflow tokens fall through the residual stream untouched (standard drop
+behavior).  The Switch load-balance auxiliary loss
+(E · Σ_e fraction_e · mean_prob_e, =1 at perfect balance) is averaged over
+layers and added to the LM loss with weight ``aux_weight``.
+
+Everything outside the MLP is exactly models/gpt2.py (attention registry
+included), and the params keep the stacked-blocks layout, so pipeline
+slicing, checkpointing, and the detector battery all work unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.core.mesh import EXPERT_AXIS
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models import layers as L
+
+Params = Dict[str, Any]
+
+_EXPERT_MESH = None
+
+
+def set_expert_mesh(mesh) -> None:
+    global _EXPERT_MESH
+    _EXPERT_MESH = mesh
+
+
+@contextlib.contextmanager
+def use_expert_mesh(mesh):
+    """Make MoE forwards constrain expert blocks to the 'expert' mesh axis
+    (same pattern as parallel/sequence.use_sequence_mesh)."""
+    global _EXPERT_MESH
+    prev = _EXPERT_MESH
+    _EXPERT_MESH = mesh
+    try:
+        yield
+    finally:
+        _EXPERT_MESH = prev
+
+
+def _expert_sharding():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _EXPERT_MESH
+    if mesh is None or EXPERT_AXIS not in mesh.axis_names:
+        return None
+    return NamedSharding(mesh, P(EXPERT_AXIS, None, None))
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(gpt2.GPT2Config):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+
+    @staticmethod
+    def from_name(name: str, **overrides: Any) -> "MoEConfig":
+        key = name.lower().replace("-moe", "")
+        if key not in gpt2.GPT2_SIZES:
+            raise ValueError(f"unknown GPT-2 size {name!r}")
+        kwargs = dict(gpt2.GPT2_SIZES[key])
+        kwargs.update(overrides)
+        return MoEConfig(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# Parameters: gpt2 block with the dense MLP swapped for router + experts
+# --------------------------------------------------------------------------
+
+
+def init_block_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    base = gpt2.init_block_params(key, cfg)
+    k_router, k_fc, k_proj = jax.random.split(jax.random.fold_in(key, 17), 3)
+    d, e, f = cfg.n_embd, cfg.n_experts, 4 * cfg.n_embd
+    del base["mlp"]
+    base["moe"] = {
+        # Router kept f32: gating decisions are control flow, not compute.
+        "router": {"w": L.uniform_scaling_init(k_router, (d, e), 0.02)},
+        "fc": {
+            "w": L.uniform_scaling_init(k_fc, (e, d, f), 0.02),
+            "b": jnp.zeros((e, f), jnp.float32),
+        },
+        "proj": {
+            "w": L.uniform_scaling_init(
+                k_proj, (e, f, d), 0.02 / math.sqrt(2 * cfg.n_layer)
+            ),
+            "b": jnp.zeros((e, d), jnp.float32),
+        },
+    }
+    return base
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layer)
+    blocks = jax.vmap(lambda k: init_block_params(k, cfg))(block_keys)
+    return {
+        "wte": L.embedding_init(k_wte, cfg.vocab_size, cfg.n_embd),
+        "wpe": L.embedding_init(k_wpe, cfg.n_positions, cfg.n_embd),
+        "blocks": blocks,
+        "ln_f": L.layernorm_init(cfg.n_embd),
+    }
+
+
+# --------------------------------------------------------------------------
+# Routing + expert FFN
+# --------------------------------------------------------------------------
+
+
+def _capacity(num_tokens: int, cfg: MoEConfig) -> int:
+    c = math.ceil(num_tokens * cfg.top_k / cfg.n_experts
+                  * cfg.capacity_factor)
+    return max(4, min(int(c), num_tokens))
+
+
+def router_dispatch(
+    probs: jax.Array, cfg: MoEConfig, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """[S, E] gate probs -> (combine f32[S, E, C], aux f32[]).
+
+    Top-k assignment with in-order positions: rank-0 choices claim slots
+    before rank-1 (GShard's ordering), positions past capacity drop.  The
+    dispatch mask is ``combine > 0``.
+    """
+    s, e = probs.shape
+    topk_probs, topk_idx = jax.lax.top_k(probs, cfg.top_k)   # [S, k]
+    norm = jnp.sum(topk_probs, axis=-1, keepdims=True)
+    topk_probs = topk_probs / jnp.maximum(norm, 1e-9)
+
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.int32)
+    for r in range(cfg.top_k):                                # static k
+        onehot = jax.nn.one_hot(topk_idx[:, r], e, dtype=jnp.int32)  # [S,E]
+        pos = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]       # [S,E]
+        within = (pos < capacity) & (onehot > 0)
+        slot = jax.nn.one_hot(
+            jnp.where(within, pos, capacity), capacity, dtype=jnp.float32
+        )                                                     # OOB -> all-0
+        combine = combine + topk_probs[:, r, None, None] * slot * \
+            within[..., None].astype(jnp.float32)
+        counts = counts + jnp.sum(onehot, axis=0)
+
+    # Switch aux loss on rank-0 assignments: E · Σ_e f_e · P̄_e.
+    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    fraction = jnp.mean(top1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(fraction * mean_prob)
+    return combine, aux
+
+
+def moe_mlp(moe: Params, x: jax.Array, cfg: MoEConfig
+            ) -> Tuple[jax.Array, jax.Array]:
+    """[B, T, d] -> ([B, T, d], aux loss []).  Two dispatch einsums around
+    the per-expert FFN; expert blocks constrained to the 'expert' axis when
+    a mesh context is live."""
+    b, t, d = x.shape
+    s = b * t
+    xf = x.reshape(s, d)
+    capacity = _capacity(s, cfg)
+
+    gate_logits = xf.astype(jnp.float32) @ moe["router"]["w"]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    combine, aux = router_dispatch(probs, cfg, capacity)      # [S, E, C]
+    dispatch = (combine > 0).astype(cfg.dtype)
+
+    shard = _expert_sharding()
+    constrain = (
+        (lambda a: jax.lax.with_sharding_constraint(a, shard))
+        if shard is not None else (lambda a: a)
+    )
+
+    # Token -> expert slots: [E, C, d] (GSPMD: all_to_all when sharded).
+    expert_in = constrain(
+        jnp.einsum("sec,sd->ecd", dispatch, xf.astype(cfg.dtype))
+    )
+    h = jnp.einsum("ecd,edf->ecf", expert_in,
+                   moe["fc"]["w"].astype(cfg.dtype))
+    h = jax.nn.gelu(h + moe["fc"]["b"][:, None].astype(cfg.dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, moe["proj"]["w"].astype(cfg.dtype))
+    out = constrain(out + moe["proj"]["b"][:, None].astype(cfg.dtype))
+    # Expert slots -> tokens, combine-weighted (f32 for the residual add).
+    yf = jnp.einsum("sec,ecd->sd", combine, out.astype(jnp.float32))
+    return yf.reshape(b, t, d), aux
+
+
+def block_forward(block: Params, x: jax.Array, cfg: MoEConfig
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """gpt2.block_forward with the MoE MLP; returns (x, aux)."""
+    dtype = cfg.dtype
+    attn_fn = gpt2.get_attention(cfg.attn_impl)
+    b, t, d = x.shape
+    h = cfg.n_head
+
+    y = L.layernorm(block["ln_1"], x).astype(dtype)
+    qkv = L.dense(block["attn"]["qkv"], y, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    reshape = lambda a: a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3)
+    out = attn_fn(reshape(q), reshape(k), reshape(v), True)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    x = x + L.dense(block["attn"]["proj"], out, dtype).astype(x.dtype)
+
+    y = L.layernorm(block["ln_2"], x)
+    y, aux = moe_mlp(block["moe"], y, cfg)
+    return x + y.astype(x.dtype), aux
+
+
+def apply_blocks(blocks: Params, x: jax.Array, cfg: MoEConfig
+                 ) -> Tuple[jax.Array, jax.Array]:
+    body = block_forward
+    if cfg.remat:
+        body = jax.checkpoint(body, static_argnums=(2,))
+
+    def scan_fn(carry, block):
+        h, aux_sum = carry
+        h, aux = body(block, h, cfg)
+        return (h, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_fn, (x, jnp.zeros((), jnp.float32)), blocks
+    )
+    return x, aux_sum / cfg.n_layer
+
+
+def forward(params: Params, tokens: jax.Array, cfg: MoEConfig) -> jax.Array:
+    x = gpt2.embed(params, tokens, cfg)
+    x, _ = apply_blocks(params["blocks"], x, cfg)
+    return gpt2.unembed(params, x, cfg)
+
+
+def forward_with_monitor(params: Params, tokens: jax.Array, cfg: MoEConfig
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Same contract as gpt2.forward_with_monitor (pre-ln features +
+    mean-logits signature) so the in-step detector works unchanged."""
+    x = gpt2.embed(params, tokens, cfg)
+    x, _ = apply_blocks(params["blocks"], x, cfg)
+    normed = L.layernorm(params["ln_f"], x)
+    logits = gpt2.project_logits(params, normed, cfg)
+    mean_normed = jnp.mean(normed, axis=tuple(range(normed.ndim - 1)))
+    mean_logits = gpt2.project_logits(params, mean_normed, cfg)
+    return logits, x, mean_logits
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: MoEConfig
+            ) -> jax.Array:
+    x = gpt2.embed(params, batch["input"], cfg)
+    x, aux = apply_blocks(params["blocks"], x, cfg)
+    logits = gpt2.unembed(params, x, cfg)
+    lm = L.cross_entropy_loss(logits, batch["target"])
+    return lm + cfg.aux_weight * aux
+
+
+def moe_ep_specs(params: Params):
+    """PartitionSpec tree for expert parallelism: expert-dim arrays shard on
+    'expert' (leading axis after the stacked-layer axis), everything else
+    replicated.  Feed to NamedSharding/device_put like gpt2_tp_specs."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+        if "moe" in keys and "router" not in keys:
+            # [L, E, ...]: layer axis replicated, expert axis sharded.
+            return P(None, EXPERT_AXIS, *([None] * (leaf.ndim - 2)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def num_params(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
