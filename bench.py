@@ -113,6 +113,18 @@ def main() -> None:
     overhead_pct = (1.0 - ratio) * 100.0
     log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
 
+    if os.environ.get("TDDL_BENCH_FUSED") == "1":
+        # Native-tier A/B: detection ON with the Pallas fused moment battery
+        # (ops/fused_stats.py) instead of XLA's fused reductions.
+        os.environ["TDDL_FUSED_STATS"] = "1"
+        try:
+            sps_fused = bench_mode(True, model, num_nodes, per_node_batch,
+                                   seq_len, steps, warmup)
+        finally:
+            del os.environ["TDDL_FUSED_STATS"]
+        log(f"detection ON (pallas fused stats): {sps_fused:.3f} steps/s "
+            f"(vs {sps_on:.3f} XLA)")
+
     print(json.dumps({
         "metric": f"{model}_tokens_per_sec_per_chip_detection_on",
         "value": round(tps_on, 1),

@@ -171,13 +171,15 @@ def test_gradient_verifier_state_catches_inflation_and_nan():
     rng = np.random.default_rng(6)
     for _ in range(20):
         norms = jnp.asarray(rng.normal(1.0, 0.02, size=n).astype(np.float32))
-        state, valid = verify_gradients_array(state, norms, jnp.ones(n, bool))
+        state, valid, _ = verify_gradients_array(state, norms, jnp.ones(n, bool))
         assert bool(valid.all())
     # Inflated norm on node 1 (1000x) must fail; NaN on node 2 must fail.
     norms = jnp.asarray(np.array([1.0, 1000.0, 1.0, 1.0], np.float32))
     finite = jnp.asarray(np.array([True, True, False, True]))
-    state2, valid = verify_gradients_array(state, norms, finite)
+    state2, valid, suspect = verify_gradients_array(state, norms, finite)
     np.testing.assert_array_equal(np.asarray(valid), [True, False, False, True])
+    # The inflation failure is the *statistical* component (debounceable).
+    assert bool(suspect[1]) and not bool(suspect[0])
     # Failed nodes must not have polluted their baselines.
     assert int(state2.count[1]) == int(state.count[1])
 
@@ -228,3 +230,25 @@ def test_host_detector_export(tmp_path):
     data = json.loads(path.read_text())
     assert "1" in data["baselines"]["output"]
     assert data["history_lengths"]["1"] == 12
+
+
+def test_ml_detector_tier_fit_and_verdict():
+    """The epoch-cadence ML tier (attack_detector.py:381-425, never called
+    by the reference's trainer — wired in ours): fits per-node models once
+    history reaches 50 samples and separates wild outliers from inliers."""
+    det = AttackDetector()
+    rng = np.random.default_rng(0)
+    names = GRADIENT_STAT_NAMES
+    for _ in range(60):
+        vec = rng.normal(0.0, 1.0, len(names))
+        det.output_history[0].append({"stats": dict(zip(names, vec))})
+    det.output_history[1].append({"stats": dict(zip(names, np.zeros(len(names))))})
+    det.update_detection_models(fit_clustering=True)
+    assert 0 in det.anomaly_detectors and 0 in det.clustering_models
+    assert 1 not in det.anomaly_detectors  # below the 50-sample floor
+
+    outlier = dict(zip(names, np.full(len(names), 50.0)))
+    inlier = dict(zip(names, np.zeros(len(names))))
+    assert det.detect_with_ml_models(outlier, 0) is True
+    assert det.detect_with_ml_models(inlier, 0) is False
+    assert det.detect_with_ml_models(outlier, 1) is False  # no model yet

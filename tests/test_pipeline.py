@@ -17,6 +17,9 @@ from trustworthy_dl_tpu.models import create_model
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.parallel.pipeline import (
     build_pipeline_apply,
+    canary_probe,
+    init_canary_state,
+    make_canary,
     stack_stages,
     unstack_stages,
 )
@@ -173,6 +176,71 @@ def test_pipeline_nan_stage_does_not_corrupt_params(tmp_path):
     assert np.isfinite(loss)
     for leaf in jax.tree_util.tree_leaves(trainer.state.params):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_canary_probe_flags_abrupt_transform_change():
+    """Unit check of the per-stage canary (SURVEY §7.4(4)): identical
+    transforms never flag; a corrupted stage flags immediately and in
+    isolation."""
+    bundle = create_model("gpt2", **TINY)
+    cfg = bundle.config
+    params = bundle.init(jax.random.PRNGKey(0))
+    stacked = stack_stages(params["blocks"], 4)
+    canary = make_canary(cfg, canary_tokens=8)
+    state = init_canary_state(4, canary)
+
+    # Two probes of the unchanged transform: warm-up then all-clear.
+    state, byz, back = canary_probe(state, stacked, canary, cfg, warmup=2)
+    assert not np.any(np.asarray(byz))
+    state, byz, back = canary_probe(state, stacked, canary, cfg, warmup=2)
+    assert not np.any(np.asarray(byz))
+    assert not np.any(np.asarray(back))
+
+    # Corrupt only stage 2's slice.
+    corrupted = jax.tree_util.tree_map(
+        lambda leaf: leaf.at[2].add(
+            3.0 * jax.random.normal(jax.random.PRNGKey(9), leaf.shape[1:],
+                                    leaf.dtype)
+        ),
+        stacked,
+    )
+    _, byz, _ = canary_probe(state, corrupted, canary, cfg, warmup=2)
+    np.testing.assert_array_equal(np.asarray(byz), [False, False, True, False])
+
+
+def test_pipeline_byzantine_stage_caught_by_canary(tmp_path):
+    """BASELINE config 5 shape under stage parallelism: a Byzantine stage
+    (compute corruption — garbage activations, not merely bad gradients) is
+    caught by the canary probe and frozen; training continues on the rest."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_epochs=1, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=48)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["byzantine"], target_nodes=[3],
+                     intensity=0.5, start_step=4)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(2)]
+
+    assert np.isfinite(losses).all()
+    byz_records = [r for r in trainer.attack_history
+                   if r["attack_type"] == "byzantine"]
+    assert byz_records and byz_records[0]["node_id"] == 3, \
+        trainer.attack_history[:3]
+    assert {r["node_id"] for r in trainer.attack_history} == {3}
+    assert trainer.trust_manager.get_node_status(3) == NodeStatus.COMPROMISED
+    assert int(trainer.state.canary.count) > 0
+    for stage in (0, 1, 2, 4, 5, 6, 7):
+        assert trainer.trust_manager.get_trust_score(stage) > 0.5
 
 
 def test_pipeline_validate(pipeline_attack_run):

@@ -1,0 +1,52 @@
+"""Profiling + debug subsystems (SURVEY §5.1/§5.2 — absent in the
+reference)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.utils.profiling import enable_nan_debugging, trace
+
+TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+
+
+def test_profile_trace_written(tmp_path):
+    profile_dir = str(tmp_path / "traces")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        num_epochs=1, num_nodes=4, optimizer="adamw",
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        profile_dir=profile_dir,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=16)
+    result = trainer.train(dl)
+    assert np.isfinite(result["epochs"][0]["train_loss"])
+    # jax.profiler writes plugins/profile/<ts>/*.xplane.pb (+ trace.json.gz).
+    dumps = glob.glob(os.path.join(profile_dir, "**", "*"), recursive=True)
+    assert any(p.endswith((".xplane.pb", ".json.gz")) for p in dumps), dumps
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        pass  # must not create anything or require a profiler session
+
+
+def test_nan_debug_mode_traps(monkeypatch):
+    enable_nan_debugging(True)
+    try:
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jax.numpy.log(x - 1.0))(
+                jax.numpy.zeros(4)
+            ).block_until_ready()
+    finally:
+        enable_nan_debugging(False)

@@ -1,0 +1,146 @@
+"""Regression pins for review findings: f32-safe standalone decay clock,
+the guarded (truly skipped) optimizer update, and the hard cross-sectional
+verdict that catches attacks live from step 0 (baseline poisoning)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from trustworthy_dl_tpu.engine.step import (
+    HARD_CROSS_Z,
+    _hard_cross_outliers,
+    guarded_update,
+)
+from trustworthy_dl_tpu.trust import manager as manager_mod
+from trustworthy_dl_tpu.trust.manager import TrustManager
+
+
+def test_standalone_wallclock_decay_has_subsecond_resolution(monkeypatch):
+    """TrustState stores its clock in f32; at absolute epoch magnitudes
+    (~1.8e9 s) the ulp is 128 s, so two updates a minute apart would see
+    dt == 0 and decay exactly 1.0.  The manager must keep a relative clock:
+    a 60 s gap has to produce the exact exp(-decay·60) factor."""
+    t = [1.785e9]  # epoch-scale wall clock
+    monkeypatch.setattr(manager_mod.time, "time", lambda: t[0])
+    tm = TrustManager(num_nodes=2, decay_rate=0.01, alpha=0.1)
+
+    tm.update_trust_score(0, output_deviation=0.0, gradient_consistency=1.0)
+    first = tm.get_trust_score(0)
+    t[0] += 60.0
+    tm.update_trust_score(0, output_deviation=0.0, gradient_consistency=1.0)
+    second = tm.get_trust_score(0)
+
+    # final = 0.9·old·exp(-0.6) + 0.1·new_score.  Component map (higher =
+    # better, trust_manager.py:145-152): 1-dev, cons, 1-lat/10 (lat=0 → 1),
+    # util (0 → 0), 1-err, uptime.  What must hold is that the decay factor
+    # is exp(-0.6), not exp(0) or exp(-1.28·…) from a 128 s-quantised dt.
+    new_score = 0.3 * 1.0 + 0.3 * 1.0 + 0.1 * 1.0 + 0.1 * 0.0 + 0.15 * 1.0 \
+        + 0.05 * 1.0
+    expected = 0.9 * first * np.exp(-0.01 * 60.0) + 0.1 * new_score
+    assert second == pytest.approx(expected, rel=1e-4)
+    assert second != pytest.approx(first, rel=1e-4)  # decay really happened
+
+
+def test_guarded_update_freezes_params_and_opt_state():
+    """Zeroing gradients is not a skip for AdamW (momentum + decoupled
+    weight decay still move params); guarded_update must freeze both
+    params and optimizer state when the predicate is False."""
+    opt = optax.adamw(1e-2, weight_decay=0.1)
+    params = {"w": jnp.ones((4,)), "b": jnp.full((2,), 2.0)}
+    opt_state = opt.init(params)
+    # Build momentum: one real update.
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    params1, opt_state1 = guarded_update(
+        jnp.asarray(True), opt, grads, opt_state, params
+    )
+    assert not np.allclose(np.asarray(params1["w"]), 1.0)
+
+    # Skipped step with zero grads: NOTHING may move.
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    params2, opt_state2 = guarded_update(
+        jnp.asarray(False), opt, zeros, opt_state1, params1
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(params2),
+                    jax.tree_util.tree_leaves(params1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(opt_state2),
+                    jax.tree_util.tree_leaves(opt_state1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Same zero-grad step un-skipped: weight decay alone moves params —
+    # the failure mode the guard exists to prevent.
+    params3, _ = guarded_update(
+        jnp.asarray(True), opt, zeros, opt_state1, params1
+    )
+    assert not all(
+        np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(params3),
+                        jax.tree_util.tree_leaves(params1))
+    )
+
+
+def test_hard_cross_outlier_unit():
+    """Order-of-magnitude deviant node fires; honest batch noise never
+    does (relative 5% MAD floor bounds the z of small perturbations)."""
+    rng = np.random.default_rng(0)
+    honest = 1.0 + 0.05 * rng.standard_normal((8, 17))
+    stats = jnp.asarray(honest, jnp.float32)
+    assert not bool(jnp.any(_hard_cross_outliers(stats)))
+    # Node 3's battery inflated 50x (gradient-inflation signature).
+    attacked = honest.copy()
+    attacked[3] *= 50.0
+    flags = np.asarray(_hard_cross_outliers(jnp.asarray(attacked, jnp.float32)))
+    assert flags[3] and flags.sum() == 1
+
+
+def test_attack_from_step_zero_is_caught_and_gated():
+    """An attack live from the very first step gives the temporal batteries
+    no clean baseline — the hard cross-sectional verdict must still gate
+    the node's contribution immediately and confirm it via debounce."""
+    from trustworthy_dl_tpu.attacks import AdversarialAttacker, AttackConfig
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, optimizer="adamw", learning_rate=1e-3,
+        checkpoint_interval=10_000, detector_warmup=2, parallelism="data",
+    )
+    trainer = DistributedTrainer(
+        config, model_overrides=dict(n_layer=2, n_embd=32, n_head=4,
+                                     vocab_size=128, n_positions=32,
+                                     seq_len=16),
+    )
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[1],
+        intensity=0.5, start_step=0,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=16 * 4)
+    trainer.initialize()
+
+    gated_from_first_scored_step = []
+    for epoch in range(2):
+        orig = trainer._record_batch
+
+        def spy(metrics, ep, loss, _orig=orig):
+            gated_from_first_scored_step.append(
+                float(np.asarray(metrics.weights)[1])
+            )
+            return _orig(metrics, ep, loss)
+
+        trainer._record_batch = spy
+        trainer.train_epoch(dl, epoch)
+        trainer._record_batch = orig
+
+    # The poisoned gradient may land at most once (first compiled step);
+    # every subsequent step must gate node 1's contribution to zero.
+    assert all(w == 0.0 for w in gated_from_first_scored_step[1:]), \
+        gated_from_first_scored_step
+    flagged = {rec["node_id"] for rec in trainer.attack_history}
+    assert 1 in flagged, trainer.attack_history[:3]

@@ -1,0 +1,160 @@
+"""Tensor parallelism: GSPMD sharding must change layout, not numbers.
+
+The reference has no intra-layer sharding at all (SURVEY §2.4; the ResNet
+partition branch is an empty `pass`, distributed_trainer.py:137-140).  These
+tests pin down the from-scratch TP tier: Megatron-style layout really shards
+the weights, forward/backward matches the replicated baseline exactly, the
+layout validator catches structure drift, and TP composes with the vmapped
+node axis of the trusted train step on a ('data','model') mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, MODEL_AXIS, build_mesh
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models.factory import ModelFactory
+from trustworthy_dl_tpu.parallel.tensor_parallel import (
+    apply_tp_sharding,
+    gpt2_tp_specs,
+    tp_group_size,
+)
+
+TINY = dict(
+    vocab_size=128, n_positions=32, n_layer=2, n_embd=32, n_head=4,
+)
+
+
+@pytest.fixture(scope="module")
+def tp_mesh(eight_devices):
+    """2 data shards (trust nodes) x 4-way TP groups."""
+    return Mesh(np.array(eight_devices).reshape(2, 4), (DATA_AXIS, MODEL_AXIS))
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    cfg = gpt2.GPT2Config(dtype=jnp.float32, **TINY)
+    return cfg, gpt2.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_tp_params_actually_shard(tp_mesh, tiny_params):
+    cfg, params = tiny_params
+    sharded = apply_tp_sharding(params, tp_mesh)
+    assert tp_group_size(tp_mesh) == 4
+    # Column-parallel qkv: [L, D, 3D] sharded on the output dim -> each
+    # device holds a quarter of the columns.
+    qkv = sharded["blocks"]["attn"]["qkv"]["w"]
+    full = params["blocks"]["attn"]["qkv"]["w"].shape
+    assert qkv.addressable_shards[0].data.shape == (
+        full[0], full[1], full[2] // 4
+    )
+    # Row-parallel proj: [L, 3D... no, D, D] sharded on the input dim.
+    proj = sharded["blocks"]["attn"]["proj"]["w"]
+    pfull = params["blocks"]["attn"]["proj"]["w"].shape
+    assert proj.addressable_shards[0].data.shape == (
+        pfull[0], pfull[1] // 4, pfull[2]
+    )
+    # Embeddings and layernorms replicated.
+    assert sharded["wte"].addressable_shards[0].data.shape == params["wte"].shape
+    assert (
+        sharded["blocks"]["ln_1"]["scale"].addressable_shards[0].data.shape
+        == params["blocks"]["ln_1"]["scale"].shape
+    )
+
+
+def test_tp_forward_backward_matches_replicated(tp_mesh, tiny_params):
+    cfg, params = tiny_params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    batch = {"input": tokens, "target": jnp.roll(tokens, -1, axis=-1)}
+
+    loss_grad = jax.jit(
+        jax.value_and_grad(gpt2.loss_fn), static_argnums=2
+    )
+    ref_loss, ref_grads = loss_grad(params, batch, cfg)
+
+    sharded = apply_tp_sharding(params, tp_mesh)
+    tp_loss, tp_grads = loss_grad(sharded, batch, cfg)
+
+    assert float(tp_loss) == pytest.approx(float(ref_loss), rel=1e-5)
+    for got, ref in zip(
+        jax.tree_util.tree_leaves(tp_grads), jax.tree_util.tree_leaves(ref_grads)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_tp_grads_keep_param_sharding(tp_mesh, tiny_params):
+    """Gradients must come back in the params' TP layout (no implicit
+    all-gather of the weight grads)."""
+    cfg, params = tiny_params
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0,
+                                cfg.vocab_size)
+    batch = {"input": tokens, "target": jnp.roll(tokens, -1, axis=-1)}
+    sharded = apply_tp_sharding(params, tp_mesh)
+    grads = jax.jit(jax.grad(gpt2.loss_fn), static_argnums=2)(
+        sharded, batch, cfg
+    )
+    qkv_spec = NamedSharding(tp_mesh, P(None, None, MODEL_AXIS))
+    assert grads["blocks"]["attn"]["qkv"]["w"].sharding.is_equivalent_to(
+        qkv_spec, 3
+    )
+
+
+def test_tp_layout_mismatch_raises(tp_mesh, tiny_params):
+    _, params = tiny_params
+    broken = jax.tree_util.tree_map(lambda x: x, params)  # deep-ish copy
+    broken["blocks"] = dict(broken["blocks"])
+    broken["blocks"]["rogue"] = jnp.zeros((1,))
+    with pytest.raises(ValueError, match="rogue"):
+        apply_tp_sharding(broken, tp_mesh)
+
+
+def test_tp_vision_models_replicate(tp_mesh):
+    model = ModelFactory().create_model(
+        "resnet32", num_classes=10, dtype=jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    sharded = apply_tp_sharding(params, tp_mesh)
+    leaf = jax.tree_util.tree_leaves(sharded)[0]
+    assert leaf.sharding.is_equivalent_to(
+        NamedSharding(tp_mesh, P()), leaf.ndim
+    )
+
+
+def test_tp_composes_with_trusted_step(tmp_path, tp_mesh):
+    """'tensor' mode end-to-end: 2 trust nodes x 4-way TP — the vmapped node
+    axis rides the data axis while each node's matmuls shard over its TP
+    group; training must progress with full trust and no false positives."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=4,
+        num_epochs=1, num_nodes=2, parallelism="tensor", optimizer="adamw",
+        learning_rate=3e-3, detector_warmup=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    trainer = DistributedTrainer(
+        config,
+        model_overrides=dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
+                             n_positions=32, seq_len=16),
+        mesh=tp_mesh,
+    )
+    trainer.initialize()
+    # Params must be TP-sharded by initialize(); check one weight.
+    qkv = trainer.state.params["blocks"]["attn"]["qkv"]["w"]
+    assert qkv.addressable_shards[0].data.shape[-1] == qkv.shape[-1] // 4
+
+    dl = get_dataloader("openwebtext", batch_size=4, seq_len=16,
+                        vocab_size=128, num_examples=48)
+    losses = [trainer.train_epoch(dl, epoch) for epoch in range(3)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert len(trainer.attack_history) == 0
+    assert all(trainer.trust_manager.get_trust_score(i) > 0.6 for i in range(2))

@@ -152,6 +152,31 @@ def poison_gradients(plan: AttackPlan, grads: Any, step: jax.Array,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def corrupt_stage_compute(plan: AttackPlan, blocks: Any, step: jax.Array,
+                          rng: jax.Array) -> Any:
+    """Byzantine *compute* corruption for stage-parallel execution: the
+    attacked stage's transform is perturbed (its block params get rms-scaled
+    noise for this step's forward) — modelling a node that computes garbage
+    activations — while the stored parameters stay clean.  This is the
+    failure mode the pipeline canary probe exists to catch: unlike gradient
+    attacks, it corrupts everything downstream of the stage
+    (SURVEY §7.4(4))."""
+    live = plan.is_live(step) & plan.byzantine
+    leaves, treedef = jax.tree_util.tree_flatten(blocks)
+    keys = jax.random.split(rng, len(leaves))
+    out = []
+    for leaf, key in zip(leaves, keys):
+        mask = (plan.target_mask & live).reshape(
+            (leaf.shape[0],) + (1,) * (leaf.ndim - 1)
+        )
+        rms = jnp.sqrt(jnp.mean(leaf.astype(jnp.float32) ** 2)) + 1e-8
+        noise = jax.random.normal(key, leaf.shape, leaf.dtype) * (
+            rms * (1.0 + 10.0 * plan.intensity)
+        ).astype(leaf.dtype)
+        out.append(jnp.where(mask, leaf + noise, leaf))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 # ---------------------------------------------------------------------------
 # Host API (reference parity)
 # ---------------------------------------------------------------------------

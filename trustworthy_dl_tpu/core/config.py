@@ -80,7 +80,24 @@ class TrainingConfig:
     # trade fidelity for speed — see SURVEY §7.4(2).
     exact_order_stats: bool = True
     detector_history: int = 1000       # rolling window (attack_detector.py:44)
+    # Input-pipeline double buffering: batch k+1 assembles on the host
+    # (native gathers) while batch k trains on device.  0 disables.
+    prefetch_depth: int = 2
     detector_warmup: int = 10          # min history before verdicts (:91,:126)
+    # Epoch-cadence host intelligence — the reference defined these but never
+    # called them (SURVEY §7.5: trust_manager.py:333; attack_detector.py:381).
+    adaptive_thresholds: bool = True   # trust_manager.adaptive_threshold_adjustment
+    ml_detectors: bool = True          # attack_detector.update_detection_models
+    # Pipeline-mode canary probe length (per-stage Byzantine/backdoor
+    # reference signal, SURVEY §7.4(4)).
+    canary_tokens: int = 8
+    # Profiling/debug subsystems (SURVEY §5.1, §5.2 — absent in the
+    # reference).  profile_dir: jax.profiler traces of training (viewable in
+    # TensorBoard/Perfetto) with per-step annotations.  debug_nans: trap the
+    # first NaN-producing primitive (developer mode; adversarial NaNs are
+    # normally gated in-step by the verifier instead).
+    profile_dir: Optional[str] = None
+    debug_nans: bool = False
     checkpoint_dir: str = "checkpoints"
     # Migration-time model rate for reassignment estimates.  The reference
     # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer

@@ -45,6 +45,57 @@ def init_verifier_state(num_nodes: int) -> VerifierState:
     )
 
 
+def _log_norm(grad_norms: jax.Array) -> jax.Array:
+    return jnp.log(jnp.maximum(grad_norms, 1e-30))
+
+
+def norm_suspicions(
+    state: VerifierState,
+    grad_norms: jax.Array,
+    norm_z_threshold: float = DEFAULT_NORM_Z,
+    warmup: int = DEFAULT_WARMUP,
+) -> jax.Array:
+    """bool[n] raw statistical verdict — pure read, NO state change.
+
+    Norms are compared in log-space so the z-score is scale-free.  Small-
+    sample confidence widening (same rationale as the detector's
+    SMALL_SAMPLE_WIDEN): z against a young Welford baseline is heavy-
+    tailed; inflation attacks score z in the tens to hundreds, so widening
+    only suppresses early-training flares.
+
+    Split from absorption (``absorb_norms``) deliberately: the verdict the
+    engine finally acts on is gated further (cross-sectional outlier check,
+    canary suppression, detector candidates), and the baseline must absorb
+    according to that FINAL judgement — verdict-then-absorb as one fused
+    call either poisons the baseline with samples later deemed suspect, or
+    starves it of samples later deemed legitimate (e.g. a shared norm
+    shift every node exhibits at once), freezing the z forever.
+    """
+    log_norm = _log_norm(grad_norms)
+    cnt = state.count.astype(jnp.float32)
+    std = jnp.sqrt(state.m2 / jnp.maximum(cnt, 1.0))
+    z = jnp.where(std > 0, jnp.abs(log_norm - state.mean) / std, 0.0)
+    warm = state.count >= warmup
+    thr_eff = norm_z_threshold * (1.0 + 8.0 / jnp.maximum(cnt, 1.0))
+    return warm & (z >= thr_eff)
+
+
+def absorb_norms(state: VerifierState, grad_norms: jax.Array,
+                 mask: jax.Array) -> VerifierState:
+    """Welford-absorb this step's log-norms where ``mask`` holds (the
+    caller's final 'clean this step' judgement)."""
+    log_norm = _log_norm(grad_norms)
+    new_count = state.count + mask.astype(jnp.int32)
+    delta = log_norm - state.mean
+    new_mean = jnp.where(
+        mask,
+        state.mean + delta / jnp.maximum(new_count.astype(jnp.float32), 1.0),
+        state.mean,
+    )
+    new_m2 = jnp.where(mask, state.m2 + delta * (log_norm - new_mean), state.m2)
+    return VerifierState(count=new_count, mean=new_mean, m2=new_m2)
+
+
 def verify_gradients_array(
     state: VerifierState,
     grad_norms: jax.Array,
@@ -52,34 +103,21 @@ def verify_gradients_array(
     norm_z_threshold: float = DEFAULT_NORM_Z,
     warmup: int = DEFAULT_WARMUP,
     update_mask: Optional[jax.Array] = None,
-) -> Tuple[VerifierState, jax.Array]:
-    """Verify per-node gradients inside the step.
+) -> Tuple[VerifierState, jax.Array, jax.Array]:
+    """One-shot verify-and-absorb composition (host API / standalone use).
 
     ``grad_norms``: f32[n] global L2 norm of each node's gradients.
     ``all_finite``: bool[n] no NaN/Inf anywhere in the node's gradients.
-    Returns (new_state, valid bool[n]).  Norms are compared in log-space so
-    the z-score is scale-free; the baseline only absorbs samples that passed
-    verification (a poisoned norm must not poison its own baseline).
+    Returns (new_state, valid bool[n], norm_suspect bool[n]); the baseline
+    absorbs exactly the valid samples (a poisoned norm must not poison its
+    own baseline).  The engine uses the split norm_suspicions/absorb_norms
+    pair instead so external gates can refine the verdict first.
     """
     if update_mask is None:
         update_mask = jnp.ones_like(all_finite, dtype=bool)
-    log_norm = jnp.log(jnp.maximum(grad_norms, 1e-30))
-    cnt = state.count.astype(jnp.float32)
-    std = jnp.sqrt(state.m2 / jnp.maximum(cnt, 1.0))
-    z = jnp.where(std > 0, jnp.abs(log_norm - state.mean) / std, 0.0)
-    warm = state.count >= warmup
-    norm_ok = jnp.where(warm, z < norm_z_threshold, True)
-    valid = all_finite.astype(bool) & norm_ok & update_mask
-
-    # Welford update, gated on validity.
-    new_count = state.count + valid.astype(jnp.int32)
-    delta = log_norm - state.mean
-    new_mean = jnp.where(
-        valid, state.mean + delta / jnp.maximum(new_count.astype(jnp.float32), 1.0),
-        state.mean,
-    )
-    new_m2 = jnp.where(valid, state.m2 + delta * (log_norm - new_mean), state.m2)
-    return VerifierState(count=new_count, mean=new_mean, m2=new_m2), valid
+    suspect = norm_suspicions(state, grad_norms, norm_z_threshold, warmup)
+    valid = all_finite.astype(bool) & ~suspect & update_mask
+    return absorb_norms(state, grad_norms, valid), valid, suspect
 
 
 class GradientVerifier:
@@ -103,7 +141,7 @@ class GradientVerifier:
         norms = jnp.zeros((self._max_nodes,), jnp.float32).at[node_id].set(norm)
         finite = jnp.zeros((self._max_nodes,), bool).at[node_id].set(all_finite)
         mask = jnp.zeros((self._max_nodes,), bool).at[node_id].set(True)
-        self._state, valid = verify_gradients_array(
+        self._state, valid, _ = verify_gradients_array(
             self._state, norms, finite, self.norm_z_threshold, self.warmup, mask
         )
         ok = bool(valid[node_id])

@@ -83,6 +83,9 @@ class TrainState(NamedTuple):
     step: jax.Array          # i32[]
     epoch: jax.Array         # i32[]
     rng: jax.Array
+    # Pipeline-mode canary probe state (parallel/pipeline.py:CanaryState);
+    # None in data-parallel mode, where cross-node checks need no probe.
+    canary: Any = None
 
 
 def init_train_state(
@@ -96,6 +99,7 @@ def init_train_state(
     recovery_rate: float = 0.005,
     detector_window: int = 1000,
     num_monitor_leaves: Optional[int] = None,
+    canary: Any = None,
 ) -> TrainState:
     """``num_monitor_leaves`` overrides the per-node gradient-norm vector
     width (pipeline mode monitors only each stage's block-slice leaves,
@@ -121,4 +125,5 @@ def init_train_state(
         step=jnp.zeros((), jnp.int32),
         epoch=jnp.zeros((), jnp.int32),
         rng=rng,
+        canary=canary,
     )

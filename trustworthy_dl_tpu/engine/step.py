@@ -35,7 +35,7 @@ from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.detect import stats as st
 from trustworthy_dl_tpu.detect.detector import Verdicts, anomaly_verdicts
-from trustworthy_dl_tpu.detect.verifier import verify_gradients_array
+from trustworthy_dl_tpu.detect.verifier import absorb_norms, norm_suspicions
 from trustworthy_dl_tpu.engine.state import MonitorState, TrainState, \
     update_monitor
 from trustworthy_dl_tpu.models import layers as L
@@ -80,6 +80,34 @@ def _output_stat_vector(logits: Array, max_sort: int) -> Array:
     return jnp.concatenate([base, pad])
 
 
+def guarded_update(do_update: Array, optimizer: optax.GradientTransformation,
+                   grads: Any, opt_state: Any, params: Any
+                   ) -> Tuple[Any, Any]:
+    """Apply the optimizer only when ``do_update`` (traced bool[]) holds;
+    otherwise params AND opt_state pass through unchanged.  Merely zeroing
+    the gradients is not a skip for stateful optimizers: AdamW would still
+    move every parameter from stale momentum plus decoupled weight decay —
+    an update with no trusted gradient behind it."""
+    updates, opt_new = optimizer.update(grads, opt_state, params)
+    params_new = optax.apply_updates(params, updates)
+    sel = lambda new, old: jnp.where(do_update, new, old)
+    return (jax.tree_util.tree_map(sel, params_new, params),
+            jax.tree_util.tree_map(sel, opt_new, opt_state))
+
+
+def _median_mad(values: Array) -> Tuple[Array, Array, Array]:
+    """[n, d] -> (median [1, d], |dev| [n, d], σ-consistent MAD [1, d]).
+
+    The single cross-node robust-location/scale statistic behind all three
+    cross-sectional checks (score gate, hard verdict, log-norm gate) —
+    they differ only in the floor applied to the MAD and the aggregation.
+    MAD is scaled by 1.4826 to be σ-consistent under normality."""
+    med = jnp.median(values, axis=0, keepdims=True)
+    abs_dev = jnp.abs(values - med)
+    mad = jnp.median(abs_dev, axis=0, keepdims=True) * 1.4826
+    return med, abs_dev, mad
+
+
 def _cross_sectional_score(stats: Array) -> Array:
     """f32[n]: mean robust z of each node's stat vector against the
     *current-step* cross-node distribution (median/MAD).
@@ -89,12 +117,9 @@ def _cross_sectional_score(stats: Array) -> Array:
     node's statistics together — temporal z-scores alone read that drift as
     an anomaly.  An actual attack perturbs one node *relative to its peers*,
     which this measure isolates; it assumes a majority of honest nodes
-    (standard Byzantine setting).  MAD is scaled by 1.4826 to be σ-consistent
-    under normality.
+    (standard Byzantine setting).
     """
-    med = jnp.median(stats, axis=0, keepdims=True)
-    abs_dev = jnp.abs(stats - med)
-    mad = jnp.median(abs_dev, axis=0, keepdims=True) * 1.4826
+    _, abs_dev, mad = _median_mad(stats)
     usable = mad[0] > 1e-12
     z = jnp.where(usable[None, :], abs_dev / jnp.maximum(mad, 1e-12), 0.0)
     return jnp.sum(z, axis=1) / jnp.maximum(jnp.sum(usable), 1)
@@ -102,14 +127,53 @@ def _cross_sectional_score(stats: Array) -> Array:
 
 CROSS_SECTIONAL_THRESHOLD = 3.0
 
+# Hard cross-sectional verdict threshold (see _hard_cross_outliers).
+HARD_CROSS_Z = 25.0
+
+# Log-norm cross-sectional gate: MAD floor 0.1 in log-space ≈ 10 % norm
+# spread (honest per-node batch variation); outlier beyond 3 robust σ.
+NORM_CROSS_Z = 3.0
+NORM_MAD_FLOOR = 0.1
+
+
+def _hard_cross_outliers(stats: Array) -> Array:
+    """bool[n]: nodes whose battery is an *astronomical* outlier vs their
+    peers this step — median/MAD with a floor RELATIVE to the median (5 %),
+    so only order-of-magnitude deviations fire, never honest batch noise.
+
+    This is the baseline-poisoning-proof detection path: temporal z-scores
+    are blind to an attack live from step 0 (the rolling baseline never
+    sees clean data to deviate from), but in SPMD all nodes share params,
+    so a node whose gradient/output statistics sit 25+ robust σ from the
+    cross-node median is compromised regardless of history.  Assumes a
+    majority of honest nodes (standard Byzantine setting); requires ≥4
+    nodes like the cross-sectional gate."""
+    med, abs_dev, mad = _median_mad(stats)
+    floor = jnp.maximum(0.05 * jnp.abs(med), 1e-6)
+    z = abs_dev / jnp.maximum(mad, floor)
+    return jnp.mean(z, axis=1) > HARD_CROSS_Z
+
+
+def _norm_cross_outliers(global_norms: Array) -> Array:
+    """bool[n]: cross-sectional outlier gate on the per-node log gradient
+    norm.  In SPMD all nodes share params, so legitimate norm drift
+    (early-training decay, loss-plateau shifts) moves every node's temporal
+    z together; a real inflation attack makes the node an outlier vs its
+    peers *this step*."""
+    log_norm = jnp.log(jnp.maximum(global_norms, 1e-30))
+    _, abs_dev, mad = _median_mad(log_norm[:, None])
+    z = abs_dev / jnp.maximum(mad, NORM_MAD_FLOOR)
+    return z[:, 0] > NORM_CROSS_Z
+
 
 class StepMetrics(NamedTuple):
     loss: Array               # f32[] aggregate (trust-weighted)
     per_node_loss: Array      # f32[n]
     trust_scores: Array       # f32[n]
     status: Array             # i32[n]
-    attacked: Array           # bool[n] detector verdicts this step
+    attacked: Array           # bool[n] confirmed (debounced) verdicts this step
     verified: Array           # bool[n] gradient verification passed
+    finite: Array             # bool[n] gradients free of NaN/Inf
     weights: Array            # f32[n] contribution gate actually used
     system_trust: Array       # f32[]
     grad_norm: Array          # f32[]  aggregated gradient norm
@@ -118,6 +182,8 @@ class StepMetrics(NamedTuple):
     attack_type: Array        # i32[n] classifier output (valid iff attacked)
     byzantine: Array          # bool[n]
     backdoor: Array           # bool[n]
+    out_stats: Array          # f32[n, 17] output stat battery (ML-tier feed)
+    grad_stats: Array         # f32[n, 17] gradient stat battery
 
 
 def build_train_step(
@@ -201,7 +267,31 @@ def build_train_step(
             jnp.sum(leaf_norms * leaf_norms, axis=1)
         )  # f32[n]
 
-        # 4. Detector verdicts (attack_detector.py:71-141), plus the
+        # 4. Gradient verification verdict (distributed_trainer.py:199-205).
+        # Pure read — the Welford baseline absorbs AFTER the detector block
+        # below, according to the FINAL clean-this-step judgement: a node
+        # excluded for a suspect norm must not push its stats into any
+        # rolling window (attack drags its own baseline), while a shared
+        # legitimate norm shift every node exhibits at once must still be
+        # absorbed (else z never recovers and training freezes).
+        finite_b = finite.astype(bool)
+        if verification:
+            norm_suspect = norm_suspicions(state.verifier, global_norms)
+            if n_nodes >= 4:
+                # Cross-sectional gate (see _norm_cross_outliers): only a
+                # node that is also an outlier vs its peers this step stays
+                # suspect — shared drift is legitimate.
+                norm_suspect = norm_suspect & _norm_cross_outliers(
+                    global_norms
+                )
+        else:
+            norm_suspect = jnp.zeros_like(finite_b)
+        # The acted-on verdict: finite AND not (gated) norm-suspect.  Uses
+        # the post-gate suspicion so a fleet-wide legitimate shift can
+        # never zero every node's weight and stall training.
+        verified = finite_b & ~norm_suspect
+
+        # 5. Detector verdicts (attack_detector.py:71-141), plus the
         # Byzantine cross-node check (:143-162) and consensus-KL backdoor
         # check (:164-183) the reference defined but never wired in.
         if detection:
@@ -253,13 +343,21 @@ def build_train_step(
             )(mean_logits)
             backdoor = (kl > 2.0) & warm_nodes
             candidates = out_v.is_attack | grad_v.is_attack | byz | backdoor
+            if n_nodes >= 4:
+                # Hard cross-sectional verdict: catches attacks live from
+                # step 0, which the temporal batteries cannot (their
+                # baselines never saw clean data) — see _hard_cross_outliers.
+                candidates = candidates | _hard_cross_outliers(out_stats) \
+                    | _hard_cross_outliers(grad_stats)
             # Absorb this step's stats into the rolling baselines only for
-            # nodes with NO candidate verdict of any kind (incl. byzantine/
-            # backdoor) — an attacker must not drag its own baseline.
+            # nodes with NO suspicion of any kind this step — battery,
+            # byzantine/backdoor, verifier norm_suspect, or non-finite
+            # gradients — an attacker must not drag its own baseline.
+            clean_now = ~(candidates | norm_suspect | ~finite_b)
             out_bl = bl.push_stats(state.out_baseline, out_stats,
-                                   mask=~candidates)
+                                   mask=clean_now)
             grad_bl = bl.push_stats(state.grad_baseline, grad_stats,
-                                    mask=~candidates)
+                                    mask=clean_now)
             # Debounce: a candidate node is excluded from this step's
             # aggregation immediately (no poisoned gradient ever lands), but
             # is only *confirmed* compromised — trust nuked, incident
@@ -277,18 +375,29 @@ def build_train_step(
             candidates = byz = backdoor = attacked
             out_score = grad_score = jnp.zeros((n_nodes,), jnp.float32)
             attack_type = jnp.zeros((n_nodes,), jnp.int32)
+            clean_now = verified
 
-        # 5. Gradient verification (distributed_trainer.py:199-205).
+        # Statistical norm suspicion joins the debounced candidate set: the
+        # node is excluded from THIS step's aggregate (weights gate below)
+        # but is only confirmed-compromised on the second consecutive hit —
+        # a one-step z blip on a legitimate node must not nuke its trust.
+        candidates = candidates | norm_suspect
+        attacked = attacked | (norm_suspect & state.prev_suspects)
+
+        # 5b. Verifier baseline absorption — the same clean-this-step rule
+        # as the stat baselines (no candidate of any kind): a stats-visible
+        # attacker must not drag the norm baseline either, while shared
+        # legitimate norm shifts (cross-gate cleared) are absorbed so the
+        # temporal z can recover.
         if verification:
-            verifier, verified = verify_gradients_array(
-                state.verifier, global_norms, finite
-            )
+            verifier = absorb_norms(state.verifier, global_norms, clean_now)
         else:
             verifier = state.verifier
-            verified = finite.astype(bool)  # NaN/Inf always invalidates
 
         # 6. Compromise marking (:273-299,:301-322 → trust_manager.py:183).
-        newly_compromised = attacked | ~verified
+        # Immediate only for unambiguous evidence: confirmed (debounced)
+        # verdicts and non-finite gradients.
+        newly_compromised = attacked | ~finite_b
         trust = ts.mark_compromised(state.trust, newly_compromised)
 
         # 7. Trust-signal computation against the monitor's expected
@@ -331,9 +440,13 @@ def build_train_step(
 
         agg = jax.tree_util.tree_map(_gate, grads)
 
-        # 9. Optimizer + monitor absorption (clean samples only).
-        updates, opt_state = optimizer.update(agg, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        # 9. Optimizer + monitor absorption (clean samples only).  All
+        # nodes gated -> full skip: params and optimizer state both freeze
+        # (zeroed grads alone would still let AdamW's momentum/weight-decay
+        # move the params).
+        params, opt_state = guarded_update(
+            denom > 0, optimizer, agg, state.opt_state, state.params
+        )
         absorb = verified & ~candidates
         monitor = update_monitor(state.monitor, out_mean, out_std, leaf_norms,
                                  absorb)
@@ -363,6 +476,7 @@ def build_train_step(
             status=trust.status,
             attacked=attacked,
             verified=verified,
+            finite=finite_b,
             weights=weights,
             system_trust=ts.system_trust(trust),
             grad_norm=agg_norm,
@@ -371,6 +485,8 @@ def build_train_step(
             attack_type=attack_type,
             byzantine=byz,
             backdoor=backdoor,
+            out_stats=out_stats,
+            grad_stats=grad_stats,
         )
         return new_state, metrics
 

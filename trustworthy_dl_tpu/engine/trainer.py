@@ -29,7 +29,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, null_plan
 from trustworthy_dl_tpu.core.config import NodeConfig, TrainingConfig
 from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
+from trustworthy_dl_tpu.data.loader import PrefetchLoader
 from trustworthy_dl_tpu.detect.detector import AttackDetector, AttackType
+from trustworthy_dl_tpu.detect.stats import (
+    GRADIENT_STAT_NAMES,
+    NUM_TENSOR_STATS,
+    TENSOR_STAT_NAMES,
+)
 from trustworthy_dl_tpu.detect.verifier import GradientVerifier
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
@@ -41,8 +47,18 @@ from trustworthy_dl_tpu.trust.manager import TrustManager
 from trustworthy_dl_tpu.trust.state import NodeStatus
 from trustworthy_dl_tpu.utils.metrics import MetricsCollector
 from trustworthy_dl_tpu.utils.monitor import NodeMonitor
+from trustworthy_dl_tpu.utils.profiling import enable_nan_debugging, \
+    step_annotation, trace
 
 logger = logging.getLogger(__name__)
+
+
+def _sklearn_available() -> bool:
+    try:
+        import sklearn  # noqa: F401
+        return True
+    except ImportError:
+        return False
 
 
 class TrainingState(enum.Enum):
@@ -66,6 +82,8 @@ class DistributedTrainer:
         self.training_state = TrainingState.INITIALIZING
         self.current_epoch = 0
         self.global_step = 0
+        if config.debug_nans:
+            enable_nan_debugging()
 
         # Host-facing components (reference: distributed_trainer.py:74-84).
         self.trust_manager = TrustManager(
@@ -93,6 +111,13 @@ class DistributedTrainer:
 
         self.attack_history: List[Dict] = []
         self.reassignment_history: List[Dict] = []
+        # Epoch-cadence ML-tier verdicts (original node id -> bool).  The
+        # tier is gated once here on sklearn availability: without it the
+        # refit is a permanent no-op, so the per-step battery feed
+        # (device->host transfers + dict building on the hot path) would be
+        # pure waste.
+        self.ml_flags: Dict[int, bool] = {}
+        self._ml_enabled = config.ml_detectors and _sklearn_available()
         # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
         # eviction removes coordinates (elastic/reassignment.py); all host
         # bookkeeping (trust manager, histories, reports) keys on original
@@ -187,6 +212,17 @@ class DistributedTrainer:
 
             params = apply_tp_sharding(params, self.mesh)
         opt_state = self.optimizer.init(params)
+        canary = None
+        if self.config.parallelism == "model":
+            from trustworthy_dl_tpu.parallel.pipeline import (
+                init_canary_state,
+                make_canary,
+            )
+
+            canary = init_canary_state(
+                self.config.num_nodes,
+                make_canary(self.model.config, self.config.canary_tokens),
+            )
         self.state = init_train_state(
             k_state, params, opt_state,
             num_nodes=self.config.num_nodes,
@@ -196,6 +232,7 @@ class DistributedTrainer:
             recovery_rate=self.config.trust_recovery_rate,
             detector_window=self.config.detector_history,
             num_monitor_leaves=num_monitor_leaves,
+            canary=canary,
         )
         self.training_state = TrainingState.TRAINING
         return self.state
@@ -256,12 +293,21 @@ class DistributedTrainer:
         self.current_epoch = epoch
         epoch_loss, num_batches = 0.0, 0
 
+        if self.config.prefetch_depth > 0 and not isinstance(
+            dataloader, PrefetchLoader
+        ):
+            # Host/device overlap: the next batch's host-side assembly
+            # (native row gathers) runs while the current step trains.
+            dataloader = PrefetchLoader(dataloader,
+                                        depth=self.config.prefetch_depth)
+
         for batch_idx, batch in enumerate(dataloader):
             self.global_step += 1
             node_batch = self._node_batch(batch)
-            self.state, metrics = self._train_step(
-                self.state, node_batch, self.attack_plan
-            )
+            with step_annotation(self.global_step):
+                self.state, metrics = self._train_step(
+                    self.state, node_batch, self.attack_plan
+                )
             self.metrics_collector.tick()
             loss = float(metrics.loss)
             self._record_batch(metrics, epoch, loss)
@@ -276,14 +322,43 @@ class DistributedTrainer:
 
         # Epoch-cadence host sync: reporting objects absorb device state.
         self.sync_host_state()
+        self._epoch_intelligence()
         avg = epoch_loss / max(num_batches, 1)
         logger.info("Epoch %d completed. Average loss: %.4f", epoch, avg)
         return avg
 
+    def _epoch_intelligence(self) -> None:
+        """Epoch-cadence host intelligence the reference defined but never
+        called (SURVEY §7.5): adaptive trust thresholds
+        (trust_manager.py:333-348) pushed back into the device state, and
+        ML-detector refit + secondary verdicts (attack_detector.py:381-425)."""
+        if self.config.adaptive_thresholds:
+            self.trust_manager.adaptive_threshold_adjustment()
+            self.state = self.state._replace(
+                trust=self.state.trust._replace(
+                    threshold=jnp.asarray(
+                        self.trust_manager.trust_threshold, jnp.float32
+                    )
+                )
+            )
+        if self._ml_enabled:
+            self.attack_detector.update_detection_models()
+            self.ml_flags = {}
+            for orig in self.node_map:
+                features = self.attack_detector.latest_features(orig)
+                if features:
+                    self.ml_flags[orig] = self.attack_detector.detect_with_ml_models(
+                        features, orig
+                    )
+            if any(self.ml_flags.values()):
+                logger.warning(
+                    "ML detectors flagged nodes: %s",
+                    [n for n, v in self.ml_flags.items() if v],
+                )
+
     def _record_batch(self, metrics: StepMetrics, epoch: int, loss: float
                       ) -> None:
         attacked = np.asarray(metrics.attacked)
-        verified = np.asarray(metrics.verified)
         trust = np.asarray(metrics.trust_scores)
         id_of = self.node_map  # coordinate -> original node id
         self.metrics_collector.collect_batch_metrics(
@@ -296,7 +371,34 @@ class DistributedTrainer:
                 },
             }
         )
-        flagged = attacked | ~verified
+        # Feed the stat batteries into the host detector's history — the
+        # training corpus for the epoch-cadence ML tier
+        # (attack_detector.py:381-425, which the reference never called).
+        if self._ml_enabled:
+            out_stats = np.asarray(metrics.out_stats)
+            grad_stats = np.asarray(metrics.grad_stats)
+            for coord, orig in enumerate(id_of):
+                # Output batteries carry 12 real stats + 5 zero pads
+                # (shape-matched to the 17-stat gradient battery inside the
+                # step); label only the real columns so the key set agrees
+                # with the host detector's own output-history entries.
+                self.attack_detector.output_history[orig].append(
+                    {"stats": dict(zip(
+                        TENSOR_STAT_NAMES,
+                        out_stats[coord][:NUM_TENSOR_STATS],
+                    ))}
+                )
+                self.attack_detector.gradient_history[orig].append(
+                    {"stats": dict(zip(GRADIENT_STAT_NAMES, grad_stats[coord]))}
+                )
+
+        # Host incidents fire only on confirmed evidence: debounced verdicts
+        # (metrics.attacked already folds in sustained norm-verification
+        # failures) or non-finite gradients.  A single-step statistical blip
+        # is excluded from that step's aggregate in-step but is NOT an
+        # incident.
+        finite = np.asarray(metrics.finite)
+        flagged = attacked | ~finite
         # Close incidents for nodes the device-side state machine has
         # rehabilitated, so a later re-attack records a fresh incident.
         # (Evicted nodes have no coordinate and stay closed-out forever.)
@@ -311,6 +413,11 @@ class DistributedTrainer:
         evict_coords: List[int] = []
         if flagged.any():
             types = np.asarray(metrics.attack_type)
+            # All nodes flagged THIS step are unfit reassignment targets,
+            # even before their own incident is processed (nodes 1 and 3
+            # confirmed in the same step must not be handed each other's
+            # shards).
+            flagged_ids = {id_of[int(c)] for c in np.nonzero(flagged)[0]}
             for coord in np.nonzero(flagged)[0]:
                 orig = id_of[int(coord)]
                 if orig in self._open_incidents:
@@ -322,6 +429,7 @@ class DistributedTrainer:
                     if attacked[coord] else "gradient_verification_failure",
                     metrics=metrics,
                     coord=int(coord),
+                    exclude=flagged_ids,
                 )
                 evict_coords.append(int(coord))
         if (evict_coords and self.config.elastic_resharding
@@ -337,7 +445,8 @@ class DistributedTrainer:
 
     def _handle_detected_attack(self, node_id: int, attack_type: str,
                                 metrics: StepMetrics,
-                                coord: Optional[int] = None) -> None:
+                                coord: Optional[int] = None,
+                                exclude: Optional[set] = None) -> None:
         """Host-side reaction (distributed_trainer.py:273-322): record the
         incident, mirror compromise into the host TrustManager, trigger
         reassignment.  The in-step mitigation (grad gating) already happened
@@ -362,16 +471,18 @@ class DistributedTrainer:
                 and self.config.parallelism == "data"):
             # Legacy greedy handoff (relabel) — elastic mode replaces it
             # with the real eviction in _record_batch.
-            self.reassign_node_tasks(node_id)
+            self.reassign_node_tasks(node_id, exclude=exclude)
         self.training_state = TrainingState.UNDER_ATTACK
 
     # ------------------------------------------------------------------
     # Reassignment (distributed_trainer.py:324-380)
     # ------------------------------------------------------------------
 
-    def reassign_node_tasks(self, compromised_node_id: int) -> None:
+    def reassign_node_tasks(self, compromised_node_id: int,
+                            exclude: Optional[set] = None) -> None:
+        unfit = set(exclude or ()) | {compromised_node_id}
         trusted = self.trust_manager.get_trusted_nodes()
-        trusted = [n for n in trusted if n != compromised_node_id]
+        trusted = [n for n in trusted if n not in unfit]
         if not trusted:
             logger.error("No trusted nodes available for reassignment")
             return
@@ -429,19 +540,20 @@ class DistributedTrainer:
             self.initialize()
         self.training_state = TrainingState.TRAINING
         history = []
-        for epoch in range(num_epochs):
-            avg_loss = self.train_epoch(train_dataloader, epoch)
-            record = {"epoch": epoch, "train_loss": avg_loss}
-            if val_dataloader is not None:
-                val = self.validate(val_dataloader)
-                record.update(val_loss=val)
-                logger.info("Validation loss: %.4f", val)
-            if self.training_state == TrainingState.UNDER_ATTACK:
-                logger.info(
-                    "Training under attack - implementing recovery measures"
-                )
-                self.training_state = TrainingState.RECOVERING
-            history.append(record)
+        with trace(self.config.profile_dir):
+            for epoch in range(num_epochs):
+                avg_loss = self.train_epoch(train_dataloader, epoch)
+                record = {"epoch": epoch, "train_loss": avg_loss}
+                if val_dataloader is not None:
+                    val = self.validate(val_dataloader)
+                    record.update(val_loss=val)
+                    logger.info("Validation loss: %.4f", val)
+                if self.training_state == TrainingState.UNDER_ATTACK:
+                    logger.info(
+                        "Training under attack - implementing recovery measures"
+                    )
+                    self.training_state = TrainingState.RECOVERING
+                history.append(record)
         self.training_state = TrainingState.COMPLETED
         logger.info("Training completed successfully")
         return {"epochs": history, "stats": self.get_training_stats()}
@@ -483,6 +595,12 @@ class DistributedTrainer:
             "attack_count": len(self.attack_history),
             "reassignment_count": len(self.reassignment_history),
             "metrics": self.metrics_collector.get_summary(),
+            "trust_threshold": self.trust_manager.trust_threshold,
+            "ml_flags": dict(self.ml_flags),
+            "predicted_reliability": {
+                i: self.trust_manager.predict_node_reliability(i)
+                for i in range(self.config.num_nodes)
+            },
         }
 
     # ------------------------------------------------------------------

@@ -37,15 +37,20 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, poison_gradients
+from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, \
+    corrupt_stage_compute, poison_gradients
 from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.core.mesh import STAGE_AXIS
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.detect import stats as st
-from trustworthy_dl_tpu.detect.detector import anomaly_verdicts
-from trustworthy_dl_tpu.detect.verifier import verify_gradients_array
+from trustworthy_dl_tpu.detect.detector import AttackType, anomaly_verdicts
+from trustworthy_dl_tpu.detect.verifier import absorb_norms, norm_suspicions
 from trustworthy_dl_tpu.engine.state import TrainState, update_monitor
-from trustworthy_dl_tpu.engine.step import StepMetrics, _gradient_stat_vector
+from trustworthy_dl_tpu.engine.step import (
+    StepMetrics,
+    _gradient_stat_vector,
+    guarded_update,
+)
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.models import layers as L
 from trustworthy_dl_tpu.trust import state as ts
@@ -173,6 +178,96 @@ def build_pipeline_apply(
     return pipe
 
 
+class CanaryState(NamedTuple):
+    """Per-stage reference signal for Byzantine/backdoor detection under
+    pipeline parallelism (SURVEY §7.4(4)).
+
+    Cross-stage comparison is meaningless (stages compute different layers)
+    and a poisoned stage corrupts all downstream activations, so each stage
+    is probed *in isolation*: every step it applies its layer slice to the
+    same fixed replicated canary activations.  Honest stages change their
+    transform only by one optimizer step (tiny relative delta); a Byzantine
+    stage that corrupts its compute moves abruptly (``prev`` check), and a
+    slow persistent repurposing of the transform drifts away from the
+    long-horizon EMA signature (``sig_ema`` KL check)."""
+
+    prev: Array     # f32[S, cb, tc, d] last step's canary outputs
+    sig_ema: Array  # f32[S, d] EMA softmax signature of canary outputs
+    count: Array    # i32[] probes absorbed
+
+
+def init_canary_state(num_stages: int, canary: Array) -> CanaryState:
+    cb, tc, d = canary.shape
+    return CanaryState(
+        prev=jnp.zeros((num_stages, cb, tc, d), jnp.float32),
+        sig_ema=jnp.full((num_stages, d), 1.0 / d, jnp.float32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_canary(cfg: gpt2.GPT2Config, canary_tokens: int = 8,
+                canary_batch: int = 1) -> Array:
+    """The fixed probe input: deterministic unit-Gaussian activations at the
+    block interface (constant across the run — the whole point)."""
+    return jax.random.normal(
+        jax.random.PRNGKey(0xCA9A12),
+        (canary_batch, canary_tokens, cfg.n_embd),
+        jnp.float32,
+    )
+
+
+CANARY_BYZ_REL_CHANGE = 0.25   # honest per-step transform drift is ~lr-sized
+CANARY_BACKDOOR_KL = 2.0       # same bar as the reference's backdoor check
+                               # (attack_detector.py:164-183)
+
+
+def canary_probe(
+    canary_state: CanaryState,
+    blocks: Any,
+    canary: Array,
+    cfg: gpt2.GPT2Config,
+    warmup: int,
+) -> Tuple[CanaryState, Array, Array]:
+    """Probe every stage's transform; returns (new_state, byz[S], backdoor[S]).
+
+    ``blocks`` leaves are [S, L/S, ...]; the vmap over the stage axis rides
+    the 'stage' sharding, so each stage probes on its own device with the
+    replicated canary — one tiny forward per stage, no extra collectives."""
+
+    def one_stage(stage_blocks):
+        def body(h, block):
+            return gpt2.block_forward(block, h, cfg), None
+        y, _ = jax.lax.scan(body, canary, stage_blocks)
+        return y.astype(jnp.float32)
+
+    y = jax.vmap(one_stage)(blocks)                      # [S, cb, tc, d]
+    s_axes = tuple(range(1, y.ndim))
+
+    # Abrupt-change (Byzantine) check vs the previous step's probe.
+    delta = jnp.sqrt(jnp.sum((y - canary_state.prev) ** 2, axis=s_axes))
+    ref = jnp.sqrt(jnp.sum(canary_state.prev ** 2, axis=s_axes)) + 1e-8
+    byz = (delta / ref > CANARY_BYZ_REL_CHANGE) & (canary_state.count >= 1)
+
+    # Slow-drift (backdoor) check: softmax signature vs long-horizon EMA.
+    sig = jax.nn.softmax(jnp.mean(y, axis=(1, 2)), axis=-1)      # [S, d]
+    ema = canary_state.sig_ema
+    kl = jnp.sum(sig * (jnp.log(sig + 1e-12) - jnp.log(ema + 1e-12)), axis=-1)
+    backdoor = (kl > CANARY_BACKDOOR_KL) & (canary_state.count >= warmup)
+
+    flagged = byz | backdoor
+    new_ema = jnp.where(flagged[:, None], ema, 0.9 * ema + 0.1 * sig)
+    # Freeze BOTH references on flagged stages: absorbing a corrupted probe
+    # into prev would make the first *clean* step after the attack ends read
+    # as another abrupt change and re-flag an honest stage.
+    new_prev = jnp.where(
+        flagged.reshape((-1,) + (1,) * (y.ndim - 1)), canary_state.prev, y
+    )
+    new_state = CanaryState(
+        prev=new_prev, sig_ema=new_ema, count=canary_state.count + 1
+    )
+    return new_state, byz, backdoor
+
+
 def build_pipeline_train_step(
     bundle,
     config: TrainingConfig,
@@ -199,6 +294,7 @@ def build_pipeline_train_step(
     detection = config.attack_detection_enabled
     verification = config.gradient_verification_enabled
     pipe_apply = build_pipeline_apply(cfg, mesh, S, M, max_sort)
+    canary_const = make_canary(cfg, config.canary_tokens)
 
     def forward(params, tokens):
         x = gpt2.embed(params, tokens, cfg)
@@ -218,10 +314,21 @@ def build_pipeline_train_step(
 
     def train_step(state: TrainState, batch: Dict[str, Array],
                    plan: AttackPlan) -> Tuple[TrainState, StepMetrics]:
-        rng, k_grad = jax.random.split(state.rng)
+        rng, k_grad, k_byz = jax.random.split(state.rng, 3)
         now = state.step.astype(jnp.float32) * config.time_per_step
 
-        (loss, aux), grads = grad_fn(state.params, batch)
+        # Byzantine *compute* corruption: the attacked stage's transform is
+        # garbage for this step (forward AND the canary probe below ride the
+        # same corrupted blocks), while stored params stay clean.
+        fwd_params = dict(state.params)
+        fwd_params["blocks"] = jax.lax.cond(
+            plan.is_live(state.step) & plan.byzantine,
+            lambda b: corrupt_stage_compute(plan, b, state.step, k_byz),
+            lambda b: b,
+            state.params["blocks"],
+        )
+
+        (loss, aux), grads = grad_fn(fwd_params, batch)
         stage_stats_out, act_mean, act_std = aux
 
         # Attack injection: a compromised stage emits poisoned block
@@ -240,40 +347,100 @@ def build_pipeline_train_step(
         )(grads["blocks"])
         global_norms = jnp.sqrt(jnp.sum(leaf_norms**2, axis=1))
 
+        # Gradient verification verdict (pure read) BEFORE the detector so
+        # the raw norm suspicion can mask this step's baseline absorption
+        # (a stage excluded for a suspect norm must not push that step's
+        # stats into the rolling windows).  The Welford baseline absorbs
+        # after the probe below, under the same clean-this-step rule as
+        # every other baseline — in particular NOT during a live
+        # canary-Byzantine verdict, when every stage's gradients flow
+        # through a corrupted pipeline.
+        finite_b = finite.astype(bool)
+        if verification:
+            norm_suspect = norm_suspicions(state.verifier, global_norms)
+        else:
+            norm_suspect = jnp.zeros_like(finite_b)
+
         if detection:
             out_v = anomaly_verdicts(stage_stats_out, state.out_baseline,
                                      warmup=config.detector_warmup)
             grad_v = anomaly_verdicts(grad_stats, state.grad_baseline,
                                       warmup=config.detector_warmup)
-            # Compromise verdicts come from the gradient battery (and the
-            # verifier below): stage activation distributions drift
-            # legitimately as the model trains and, unlike DP, there is no
-            # cross-node population to separate drift from attack — so the
-            # output battery feeds the output_deviation *trust signal* and
-            # the reported score, not the hard verdict.
-            candidates = grad_v.is_attack
-            out_bl = bl.push_stats(state.out_baseline, stage_stats_out)
+            # Per-stage canary probe (SURVEY §7.4(4)): the Byzantine/backdoor
+            # checks cross-node comparison can't provide under pipelining.
+            canary_state, byz, backdoor = canary_probe(
+                state.canary, fwd_params["blocks"], canary_const, cfg,
+                config.detector_warmup,
+            )
+            # Stages are serially dependent: a Byzantine stage corrupts every
+            # downstream activation AND the whole backward pass, so while a
+            # canary-Byzantine verdict is live (byz_any) only the canary can
+            # localise the culprit — the statistical batteries would
+            # false-flag honest stages on the contaminated gradients.  They
+            # are suppressed, the rolling baselines freeze (no contaminated
+            # absorption), and the optimizer update is skipped entirely
+            # below.  Otherwise, compromise verdicts come from the gradient
+            # battery, the canary, and the verifier: stage activation
+            # distributions drift legitimately as the model trains and,
+            # unlike DP, there is no cross-node population to separate drift
+            # from attack — so the output battery feeds the output_deviation
+            # *trust signal* and the reported score, not the hard verdict.
+            byz_any = jnp.any(byz)
+            stat_cand = grad_v.is_attack & ~byz_any
+            candidates = stat_cand | byz | backdoor
+            # Absorb only stages with NO suspicion of any kind this step —
+            # battery/canary verdicts, verifier norm-suspect, or non-finite
+            # gradients — and never while a Byzantine verdict is live (the
+            # whole pipeline's stats are contaminated then).
+            clean_now = ~(candidates | norm_suspect | ~finite_b) & ~byz_any
+            out_bl = bl.push_stats(state.out_baseline, stage_stats_out,
+                                   mask=clean_now)
             grad_bl = bl.push_stats(state.grad_baseline, grad_stats,
-                                    mask=~candidates)
-            attacked = candidates & state.prev_suspects
+                                    mask=clean_now)
+            # Canary verdicts are unambiguous (fixed probe, no statistical
+            # drift), so they confirm immediately — only the statistical
+            # battery needs the two-consecutive-steps debounce.
+            attacked = (stat_cand & state.prev_suspects) | byz | backdoor
             out_score, grad_score = out_v.score, grad_v.score
-            attack_type = jnp.where(grad_v.is_attack, grad_v.attack_type,
-                                    out_v.attack_type)
-        else:
-            out_bl, grad_bl = state.out_baseline, state.grad_baseline
-            candidates = attacked = jnp.zeros((S,), bool)
-            out_score = grad_score = jnp.zeros((S,), jnp.float32)
-            attack_type = jnp.zeros((S,), jnp.int32)
-
-        if verification:
-            verifier, verified = verify_gradients_array(
-                state.verifier, global_norms, finite
+            attack_type = jnp.select(
+                [byz, backdoor, stat_cand],
+                [jnp.full((S,), int(AttackType.BYZANTINE), jnp.int32),
+                 jnp.full((S,), int(AttackType.BACKDOOR), jnp.int32),
+                 grad_v.attack_type],
+                default=out_v.attack_type,
             )
         else:
-            verifier = state.verifier
-            verified = finite.astype(bool)
+            out_bl, grad_bl = state.out_baseline, state.grad_baseline
+            canary_state = state.canary
+            candidates = attacked = byz = backdoor = jnp.zeros((S,), bool)
+            byz_any = jnp.zeros((), bool)
+            out_score = grad_score = jnp.zeros((S,), jnp.float32)
+            attack_type = jnp.zeros((S,), jnp.int32)
+            clean_now = finite_b & ~norm_suspect
 
-        trust = ts.mark_compromised(state.trust, attacked | ~verified)
+        # No cross-stage gate on norm suspicion (stages differ
+        # legitimately), but a live canary verdict contaminates every
+        # stage's gradients, so it is suppressed like the statistical
+        # battery.
+        norm_suspect = norm_suspect & ~byz_any
+        verified = finite_b & ~norm_suspect
+
+        # Verifier baseline absorption under the same clean-this-step rule
+        # as the stat baselines (incl. the ~byz_any freeze carried by
+        # clean_now): corrupted-pipeline norms must never form the Welford
+        # baseline honest stages are later z-scored against.
+        if verification:
+            verifier = absorb_norms(state.verifier, global_norms, clean_now)
+        else:
+            verifier = state.verifier
+
+        # Statistical norm suspicion debounces like the battery verdicts:
+        # excluded from this step's update immediately (weights gate), but
+        # confirmed-compromised only on the second consecutive hit.
+        candidates = candidates | norm_suspect
+        attacked = attacked | (norm_suspect & state.prev_suspects)
+
+        trust = ts.mark_compromised(state.trust, attacked | ~finite_b)
 
         # Trust signals per stage (distributed_trainer.py:228-271 analogue).
         warm = state.monitor.warm
@@ -298,8 +465,13 @@ def build_pipeline_train_step(
             / jnp.maximum(jnp.sum(usable, axis=1), 1),
             1.0,
         )
+        # While a Byzantine stage is live the deviation/consistency signals
+        # of every stage are computed through corrupted activations —
+        # freeze the trust EMA rather than punish honest stages with
+        # garbage metrics.
         trust = ts.update_trust(trust, deviation, consistency, now,
-                                alpha=config.trust_alpha)
+                                alpha=config.trust_alpha,
+                                update_mask=jnp.broadcast_to(~byz_any, (S,)))
 
         # Gate: a flagged stage's parameters freeze (update zeroed) — the
         # model topology is preserved, unlike the reference's layer-drop.
@@ -307,11 +479,16 @@ def build_pipeline_train_step(
         # stage emitting non-finite gradients would otherwise still poison
         # its own (and via the optimizer, the shared) parameter updates.
         weights = ts.contribution_weights(trust, verified & ~candidates)
+        # Global skip under a live canary-Byzantine verdict: the step's loss
+        # was computed through a corrupted pipeline, so NO stage's gradient
+        # is trustworthy (serial dependence) — zero the whole update.
+        step_scale = jnp.where(byz_any, 0.0, 1.0)
 
         def _gate_stage(g):
             shape = (S,) + (1,) * (g.ndim - 1)
             mask = (weights > 0).reshape(shape)
-            return jnp.where(mask, g * weights.reshape(shape).astype(g.dtype), 0)
+            gated = jnp.where(mask, g * weights.reshape(shape).astype(g.dtype), 0)
+            return gated * step_scale.astype(g.dtype)
 
         blocks = jax.tree_util.tree_map(_gate_stage, grads["blocks"])
         # Shared leaves (embed/unembed) are not per-stage gated; zero any
@@ -320,14 +497,20 @@ def build_pipeline_train_step(
         # stage always fails the finite check and carries weight 0.)
         grads = {
             k: (blocks if k == "blocks" else jax.tree_util.tree_map(
-                lambda g: jnp.where(jnp.all(jnp.isfinite(g)), g, 0), v))
+                lambda g: jnp.where(jnp.all(jnp.isfinite(g)), g, 0)
+                * step_scale.astype(g.dtype), v))
             for k, v in grads.items()
         }
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = optax.apply_updates(state.params, updates)
+        # True skip on the "zero the whole update" paths: a live canary-
+        # Byzantine verdict, or every stage gated out — params and optimizer
+        # state freeze together (zeroed grads alone would still let AdamW's
+        # momentum/weight-decay move every parameter).
+        params, opt_state = guarded_update(
+            ~byz_any & (jnp.sum(weights) > 0), optimizer, grads,
+            state.opt_state, state.params,
+        )
 
-        absorb = verified & ~candidates
+        absorb = verified & ~candidates & ~byz_any
         monitor = update_monitor(state.monitor, act_mean, act_std, leaf_norms,
                                  absorb)
         new_state = TrainState(
@@ -342,6 +525,7 @@ def build_pipeline_train_step(
             step=state.step + 1,
             epoch=state.epoch,
             rng=rng,
+            canary=canary_state,
         )
         metrics = StepMetrics(
             loss=loss,
@@ -350,14 +534,17 @@ def build_pipeline_train_step(
             status=trust.status,
             attacked=attacked,
             verified=verified,
+            finite=finite_b,
             weights=weights,
             system_trust=ts.system_trust(trust),
             grad_norm=optax.global_norm(grads),
             out_score=out_score,
             grad_score=grad_score,
             attack_type=attack_type,
-            byzantine=jnp.zeros((S,), bool),
-            backdoor=jnp.zeros((S,), bool),
+            byzantine=byz,
+            backdoor=backdoor,
+            out_stats=stage_stats_out,
+            grad_stats=grad_stats,
         )
         return new_state, metrics
 

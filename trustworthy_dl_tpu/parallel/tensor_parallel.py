@@ -62,21 +62,32 @@ def gpt2_tp_specs(params: Params) -> Params:
 
 def _spec_tree_for(params: Params) -> Params:
     """Match a spec tree to the params structure; anything unspecified is
-    replicated."""
-    if "blocks" in params and "wte" in params:
-        specs = gpt2_tp_specs(params)
-    else:
+    replicated.  A layout/params structure mismatch raises immediately with
+    the offending paths — a silent mismatch would otherwise surface later as
+    an opaque tree_map error inside apply_tp_sharding."""
+    if not ("blocks" in params and "wte" in params):
         # Vision models: no TP layout defined — replicate everything (TP is
         # a transformer play; convs scale via data/spatial sharding).
-        specs = jax.tree_util.tree_map(lambda _: P(), params)
-        return specs
-    # ln_1 scale under blocks has leading layer axis handled above; ensure
-    # structural match by mapping any missing leaves to replicated.
-    flat_p = jax.tree_util.tree_structure(params)
-    try:
-        jax.tree_util.tree_structure(specs) == flat_p
-    except Exception:
-        pass
+        return jax.tree_util.tree_map(lambda _: P(), params)
+    specs = gpt2_tp_specs(params)
+    is_spec = lambda x: isinstance(x, P)
+    p_paths = {
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    s_paths = {
+        jax.tree_util.keystr(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=is_spec
+        )[0]
+    }
+    if p_paths != s_paths:
+        missing = sorted(p_paths - s_paths)
+        extra = sorted(s_paths - p_paths)
+        raise ValueError(
+            "TP layout does not match the parameter tree; "
+            f"params-only paths: {missing[:8]}, layout-only paths: {extra[:8]}"
+        )
     return specs
 
 

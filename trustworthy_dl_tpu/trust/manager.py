@@ -1,12 +1,20 @@
-"""Host-side TrustManager — full API parity with the reference
-(trust_manager.py:44-398), backed by the pure-JAX TrustState.
+"""Host-side trust reporting view over the pure-JAX :mod:`trust.state`.
 
-This class is the *reporting and control* surface: the per-batch trust math
-runs inside the compiled train step on TrustState (trust/state.py); the
-manager absorbs device state once per epoch (``sync_from_device``) and keeps
-the reference's history/export/recommendation features on the host where they
-belong.  It can also be driven standalone (update_trust_score per call) with
-wall-clock decay exactly like the reference.
+Single-source-of-truth design: the *only* implementation of the trust math
+(weighted 6-component score, EMA/decay blend, status machine — reference
+trust_manager.py:92-181) lives in ``trust/state.py``.  This class holds one
+:class:`TrustState` pytree as its world-view and forwards every mutation to
+the pure functions, adding only what genuinely belongs on the host:
+
+  * wall-clock time as the decay clock for standalone (non-jitted) use,
+  * per-node history/attack logs (unbounded python deques),
+  * JSON export, statistics aggregation, and operator recommendations,
+  * the ``sync_from_device`` / ``to_device_state`` bridge that lets the
+    compiled train step own the state between reporting intervals.
+
+API names match the reference surface (trust_manager.py:44-398) so callers
+of the original can switch without edits, but there is no second copy of
+any formula here.
 """
 
 from __future__ import annotations
@@ -15,20 +23,21 @@ import json
 import logging
 import time
 from collections import defaultdict, deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from trustworthy_dl_tpu.trust import state as ts
-from trustworthy_dl_tpu.trust.state import NodeStatus, TrustState
+from trustworthy_dl_tpu.trust.state import METRIC_NAMES, NodeStatus, TrustState
 
 logger = logging.getLogger(__name__)
 
 
-@dataclass
+@dataclass(frozen=True)
 class TrustScore:
-    """Trust score with metadata (trust_manager.py:25-32)."""
+    """Read-only snapshot of one node's score row (view over TrustState)."""
 
     value: float
     last_updated: float
@@ -37,9 +46,9 @@ class TrustScore:
     recovery_rate: float = 0.005
 
 
-@dataclass
+@dataclass(frozen=True)
 class NodeMetrics:
-    """Node metrics for trust calculation (trust_manager.py:34-42)."""
+    """Read-only snapshot of one node's metrics row (view over TrustState)."""
 
     output_deviation: float = 0.0
     gradient_consistency: float = 1.0
@@ -50,7 +59,7 @@ class NodeMetrics:
 
 
 class TrustManager:
-    """Manages trust scores and node status for distributed training."""
+    """Trust bookkeeping facade; math delegated to ``trust/state.py``."""
 
     def __init__(
         self,
@@ -63,54 +72,114 @@ class TrustManager:
         alpha: float = 0.1,
     ):
         self.num_nodes = num_nodes
-        self.trust_threshold = trust_threshold
         self.initial_trust = initial_trust
         self.max_history = max_history
         self.default_decay_rate = decay_rate
         self.default_recovery_rate = recovery_rate
         self.alpha = alpha
 
-        self.trust_scores: Dict[int, TrustScore] = {}
-        self.node_status: Dict[int, NodeStatus] = {}
-        self.node_metrics: Dict[int, NodeMetrics] = {}
+        # The world-view.  Clock unit for standalone use = wall seconds
+        # RELATIVE to construction: TrustState stores the clock in f32,
+        # whose ulp at absolute epoch magnitudes is 128 s (two updates a
+        # minute apart would read dt == 0, or 128 when straddling a grid
+        # line).  Relative seconds keep sub-ms resolution for months;
+        # export re-bases to absolute via _epoch0.
+        self._epoch0 = time.time()
+        self._state: TrustState = ts.init_trust_state(
+            num_nodes,
+            trust_threshold=trust_threshold,
+            initial_trust=initial_trust,
+            decay_rate=decay_rate,
+            recovery_rate=recovery_rate,
+            now=0.0,
+        )
 
+        # Host-only logs.
         self.trust_history: Dict[int, deque] = defaultdict(
             lambda: deque(maxlen=max_history)
         )
         self.attack_history: Dict[int, List] = defaultdict(list)
-        self.performance_history: Dict[int, deque] = defaultdict(
-            lambda: deque(maxlen=max_history)
-        )
+        logger.info("TrustManager tracking %d nodes", num_nodes)
 
-        # Weighted-sum weights (trust_manager.py:67-74); kept as a dict for
-        # API parity, the device path uses trust/state.py:TRUST_WEIGHTS.
-        self.trust_weights = {
-            "output_deviation": 0.3,
-            "gradient_consistency": 0.3,
-            "communication_latency": 0.1,
-            "resource_utilization": 0.1,
-            "error_rate": 0.15,
-            "uptime": 0.05,
-        }
+    # -- state access -----------------------------------------------------
 
-        for node_id in range(num_nodes):
-            self.initialize_node(node_id)
-        logger.info("TrustManager initialized for %d nodes", num_nodes)
+    @property
+    def state(self) -> TrustState:
+        return self._state
 
-    # ------------------------------------------------------------------
-    # Core update path (trust_manager.py:82-206)
-    # ------------------------------------------------------------------
+    @property
+    def trust_threshold(self) -> float:
+        return float(np.asarray(self._state.threshold))
 
-    def initialize_node(self, node_id: int) -> None:
-        self.trust_scores[node_id] = TrustScore(
-            value=self.initial_trust,
-            last_updated=time.time(),
-            update_count=0,
+    @trust_threshold.setter
+    def trust_threshold(self, value: float) -> None:
+        self._state = self._state._replace(threshold=jnp.asarray(value, jnp.float32))
+
+    def _now(self) -> float:
+        """Wall seconds since construction — the f32-safe decay clock."""
+        return time.time() - self._epoch0
+
+    def _one_hot(self, node_id: int) -> jnp.ndarray:
+        mask = np.zeros(self.num_nodes, dtype=bool)
+        mask[node_id] = True
+        return jnp.asarray(mask)
+
+    def _snapshot_metrics(self, node_id: int) -> NodeMetrics:
+        row = np.asarray(self._state.metrics[node_id])
+        return NodeMetrics(**dict(zip(METRIC_NAMES, map(float, row))))
+
+    # -- mutations (all delegate to trust/state.py) -----------------------
+
+    def _grow_to(self, num_nodes: int) -> None:
+        """Expand the state arrays for dynamically added node ids (the
+        reference auto-initialises unknown ids on first update,
+        trust_manager.py:96-97)."""
+        old = self._state
+        n_old = self.num_nodes
+        fresh = ts.init_trust_state(
+            num_nodes,
+            trust_threshold=self.trust_threshold,
+            initial_trust=self.initial_trust,
             decay_rate=self.default_decay_rate,
             recovery_rate=self.default_recovery_rate,
+            now=self._now(),
         )
-        self.node_status[node_id] = NodeStatus.TRUSTED
-        self.node_metrics[node_id] = NodeMetrics()
+        self._state = fresh._replace(
+            scores=fresh.scores.at[:n_old].set(old.scores),
+            status=fresh.status.at[:n_old].set(old.status),
+            update_count=fresh.update_count.at[:n_old].set(old.update_count),
+            last_updated=fresh.last_updated.at[:n_old].set(old.last_updated),
+            decay_rate=fresh.decay_rate.at[:n_old].set(old.decay_rate),
+            recovery_rate=fresh.recovery_rate.at[:n_old].set(old.recovery_rate),
+            metrics=fresh.metrics.at[:n_old].set(old.metrics),
+            attack_count=fresh.attack_count.at[:n_old].set(old.attack_count),
+        )
+        self.num_nodes = num_nodes
+
+    def initialize_node(self, node_id: int) -> None:
+        """(Re)set one node to the initial trust/status/metrics; grows the
+        state for ids beyond the current node count."""
+        if node_id >= self.num_nodes:
+            self._grow_to(node_id + 1)
+            return
+        fresh = ts.init_trust_state(
+            1,
+            trust_threshold=self.trust_threshold,
+            initial_trust=self.initial_trust,
+            decay_rate=self.default_decay_rate,
+            recovery_rate=self.default_recovery_rate,
+            now=self._now(),
+        )
+        s, f = self._state, fresh
+        self._state = s._replace(
+            scores=s.scores.at[node_id].set(f.scores[0]),
+            status=s.status.at[node_id].set(f.status[0]),
+            update_count=s.update_count.at[node_id].set(0),
+            last_updated=s.last_updated.at[node_id].set(f.last_updated[0]),
+            decay_rate=s.decay_rate.at[node_id].set(f.decay_rate[0]),
+            recovery_rate=s.recovery_rate.at[node_id].set(f.recovery_rate[0]),
+            metrics=s.metrics.at[node_id].set(f.metrics[0]),
+        )
 
     def update_trust_score(
         self,
@@ -119,316 +188,243 @@ class TrustManager:
         gradient_consistency: float,
         **kwargs: float,
     ) -> None:
-        """Single-node host update, wall-clock decay
-        (trust_manager.py:92-140)."""
-        if node_id not in self.trust_scores:
+        """Standalone per-node update with wall-clock decay.  The formula is
+        ``ts.update_trust`` — no math here, only routing one node's metrics
+        into the vectorised call via a one-hot mask."""
+        if node_id >= self.num_nodes:
             self.initialize_node(node_id)
-        metrics = self.node_metrics[node_id]
-        metrics.output_deviation = output_deviation
-        metrics.gradient_consistency = gradient_consistency
+        st = self._state
+        # Columns 2..5 (latency/util/error/uptime): start from the node's
+        # previous values and overlay any keyword metrics supplied.
+        extra = np.asarray(st.metrics[:, 2:6]).copy()
         for key, value in kwargs.items():
-            if hasattr(metrics, key):
-                setattr(metrics, key, value)
-
-        new_trust = self._calculate_trust_score(node_id, metrics)
-        old = self.trust_scores[node_id]
-        dt = time.time() - old.last_updated
-        decay = float(np.exp(-old.decay_rate * dt))
-        final = float(
-            np.clip((1 - self.alpha) * old.value * decay + self.alpha * new_trust, 0.0, 1.0)
+            if key in METRIC_NAMES:
+                col = METRIC_NAMES.index(key)
+                if col >= 2:
+                    extra[node_id, col - 2] = value
+        dev = jnp.asarray(st.metrics[:, 0]).at[node_id].set(output_deviation)
+        cons = jnp.asarray(st.metrics[:, 1]).at[node_id].set(gradient_consistency)
+        self._state = ts.update_trust(
+            st,
+            dev,
+            cons,
+            now=self._now(),
+            extra_metrics=jnp.asarray(extra),
+            update_mask=self._one_hot(node_id),
+            alpha=self.alpha,
         )
-        self.trust_scores[node_id] = TrustScore(
-            value=final,
-            last_updated=time.time(),
-            update_count=old.update_count + 1,
-            decay_rate=old.decay_rate,
-            recovery_rate=old.recovery_rate,
+        self._record_history(node_id)
+        logger.debug(
+            "trust[%d] <- %.3f", node_id, float(self._state.scores[node_id])
         )
-        self._update_node_status(node_id, final)
-        self.trust_history[node_id].append(
-            {
-                "timestamp": time.time(),
-                "trust_score": final,
-                "metrics": metrics.__dict__.copy(),
-            }
-        )
-        logger.debug("Node %d trust updated: %.3f", node_id, final)
-
-    def _calculate_trust_score(self, node_id: int, metrics: NodeMetrics) -> float:
-        components = {
-            "output_deviation": 1.0 - min(1.0, metrics.output_deviation),
-            "gradient_consistency": metrics.gradient_consistency,
-            "communication_latency": 1.0
-            - min(1.0, metrics.communication_latency / 10.0),
-            "resource_utilization": min(1.0, metrics.resource_utilization),
-            "error_rate": 1.0 - min(1.0, metrics.error_rate),
-            "uptime": metrics.uptime,
-        }
-        score = sum(self.trust_weights[k] * v for k, v in components.items())
-        return float(np.clip(score, 0.0, 1.0))
-
-    def _update_node_status(self, node_id: int, trust_score: float) -> None:
-        current = self.node_status[node_id]
-        if trust_score < 0.3:
-            new = NodeStatus.COMPROMISED
-        elif trust_score < self.trust_threshold:
-            new = NodeStatus.SUSPICIOUS
-        elif current == NodeStatus.COMPROMISED and trust_score > 0.8:
-            new = NodeStatus.RECOVERING
-        elif current == NodeStatus.RECOVERING and trust_score > 0.9:
-            new = NodeStatus.TRUSTED
-        elif trust_score >= self.trust_threshold:
-            new = NodeStatus.TRUSTED
-        else:
-            new = current
-        if new != current:
-            logger.info(
-                "Node %d status changed: %s -> %s", node_id, current.label, new.label
-            )
-            self.node_status[node_id] = new
 
     def mark_compromised(self, node_id: int, attack_type: str = "unknown") -> None:
-        """Severe trust penalty (trust_manager.py:183-196).  Unlike the
-        reference, ``previous_trust`` records the value *before* the
-        overwrite (SURVEY §7.5 fix)."""
-        previous = self.trust_scores[node_id].value
-        self.node_status[node_id] = NodeStatus.COMPROMISED
-        self.trust_scores[node_id].value = 0.1
+        """Penalty via ``ts.mark_compromised``; the attack log records the
+        trust value *prior* to the overwrite (SURVEY §7.5 fix)."""
+        prior = float(self._state.scores[node_id])
+        self._state = ts.mark_compromised(self._state, self._one_hot(node_id))
         self.attack_history[node_id].append(
             {
                 "timestamp": time.time(),
                 "attack_type": attack_type,
-                "previous_trust": previous,
+                "previous_trust": prior,
             }
         )
-        logger.warning("Node %d marked as compromised: %s", node_id, attack_type)
+        logger.warning("trust: node %d compromised (%s)", node_id, attack_type)
 
     def initiate_recovery(self, node_id: int) -> None:
-        if self.node_status[node_id] == NodeStatus.COMPROMISED:
-            self.node_status[node_id] = NodeStatus.RECOVERING
-            self.trust_scores[node_id].recovery_rate = 0.02
-            logger.info("Recovery initiated for node %d", node_id)
+        self._state = ts.initiate_recovery(self._state, self._one_hot(node_id))
 
-    # ------------------------------------------------------------------
-    # Queries (trust_manager.py:208-257)
-    # ------------------------------------------------------------------
+    def reset_node_trust(self, node_id: int) -> None:
+        self.initialize_node(node_id)
+        logger.info("trust: node %d reset", node_id)
+
+    def adaptive_threshold_adjustment(self) -> None:
+        self._state = ts.adaptive_threshold(self._state)
+        logger.debug("trust threshold -> %.3f", self.trust_threshold)
+
+    def cleanup(self) -> None:
+        logger.info("trust: manager released")
+
+    # -- queries ----------------------------------------------------------
 
     def get_trust_score(self, node_id: int) -> float:
-        if node_id not in self.trust_scores:
+        if not 0 <= node_id < self.num_nodes:
             return 0.0
-        return self.trust_scores[node_id].value
+        return float(self._state.scores[node_id])
+
+    def get_score_record(self, node_id: int) -> Optional[TrustScore]:
+        """One node's score row as a TrustScore snapshot (the reference's
+        per-node record type, trust_manager.py:25-32); None out of range.
+        ``last_updated`` is re-based to absolute wall-clock."""
+        if not 0 <= node_id < self.num_nodes:
+            return None
+        s = self._state
+        return TrustScore(
+            value=float(s.scores[node_id]),
+            last_updated=float(s.last_updated[node_id]) + self._epoch0,
+            update_count=int(s.update_count[node_id]),
+            decay_rate=float(s.decay_rate[node_id]),
+            recovery_rate=float(s.recovery_rate[node_id]),
+        )
 
     def get_node_status(self, node_id: int) -> NodeStatus:
-        return self.node_status.get(node_id, NodeStatus.OFFLINE)
+        if not 0 <= node_id < self.num_nodes:
+            return NodeStatus.OFFLINE
+        return NodeStatus(int(self._state.status[node_id]))
+
+    def _nodes_with_status(self, status: NodeStatus) -> List[int]:
+        return np.flatnonzero(
+            np.asarray(self._state.status) == int(status)
+        ).tolist()
 
     def get_trusted_nodes(self) -> List[int]:
-        return [
-            i for i in range(self.num_nodes)
-            if self.node_status[i] == NodeStatus.TRUSTED
-        ]
+        return self._nodes_with_status(NodeStatus.TRUSTED)
 
     def get_suspicious_nodes(self) -> List[int]:
-        return [
-            i for i in range(self.num_nodes)
-            if self.node_status[i] == NodeStatus.SUSPICIOUS
-        ]
+        return self._nodes_with_status(NodeStatus.SUSPICIOUS)
 
     def get_compromised_nodes(self) -> List[int]:
-        return [
-            i for i in range(self.num_nodes)
-            if self.node_status[i] == NodeStatus.COMPROMISED
-        ]
+        return self._nodes_with_status(NodeStatus.COMPROMISED)
 
     def can_assign_task(self, node_id: int) -> bool:
-        status = self.node_status.get(node_id, NodeStatus.OFFLINE)
-        return status in (NodeStatus.TRUSTED, NodeStatus.RECOVERING)
+        if not 0 <= node_id < self.num_nodes:
+            return False
+        return bool(ts.can_assign_task(self._state)[node_id])
 
     def select_best_nodes(self, num_nodes: int) -> List[int]:
-        available = [
-            (i, self.get_trust_score(i))
-            for i in range(self.num_nodes)
-            if self.can_assign_task(i)
-        ]
-        available.sort(key=lambda x: x[1], reverse=True)
-        return [i for i, _ in available[:num_nodes]]
-
-    # ------------------------------------------------------------------
-    # Aggregates / reporting (trust_manager.py:259-331)
-    # ------------------------------------------------------------------
+        # Clamp like the reference's available[:k] slice — asking for more
+        # nodes than exist returns everyone assignable, not an error.
+        k = min(num_nodes, self.num_nodes)
+        idx = np.asarray(ts.select_best_nodes(self._state, k))
+        return [int(i) for i in idx if i >= 0]
 
     def calculate_system_trust(self) -> float:
-        if not self.trust_scores:
-            return 0.0
-        values = [s.value for s in self.trust_scores.values()]
-        weights = np.array(values)
-        if weights.sum() <= 0:
-            return 0.0
-        return float(np.average(values, weights=weights))
+        return float(ts.system_trust(self._state))
+
+    def predict_node_reliability(self, node_id: int, horizon: int = 10) -> float:
+        """Trend extrapolation via ``ts.predict_reliability`` over the host
+        history log (reference window: last 10 samples, min 5)."""
+        entries = [e["trust_score"] for e in self.trust_history.get(node_id, ())][-10:]
+        window = 10
+        hist = np.zeros((1, window), np.float32)
+        if entries:
+            hist[0, -len(entries):] = entries
+        else:
+            hist[0, -1] = self.get_trust_score(node_id)
+        count = jnp.asarray([max(len(entries), 1)])
+        return float(
+            ts.predict_reliability(jnp.asarray(hist), count, horizon=horizon)[0]
+        )
+
+    # -- aggregates / reporting ------------------------------------------
 
     def get_trust_statistics(self) -> Dict:
-        values = [s.value for s in self.trust_scores.values()]
-        if not values:
+        scores = np.asarray(self._state.scores)
+        if scores.size == 0:
             return {}
         return {
-            "mean_trust": float(np.mean(values)),
-            "std_trust": float(np.std(values)),
-            "min_trust": float(np.min(values)),
-            "max_trust": float(np.max(values)),
+            "mean_trust": float(scores.mean()),
+            "std_trust": float(scores.std()),
+            "min_trust": float(scores.min()),
+            "max_trust": float(scores.max()),
             "system_trust": self.calculate_system_trust(),
             "node_status_counts": {
-                status.label: sum(1 for s in self.node_status.values() if s == status)
+                status.label: len(self._nodes_with_status(status))
                 for status in NodeStatus
             },
             "total_attacks": sum(len(a) for a in self.attack_history.values()),
         }
 
     def get_node_history(self, node_id: int, limit: int = 100) -> List[Dict]:
-        if node_id not in self.trust_history:
-            return []
-        history = list(self.trust_history[node_id])
+        history = list(self.trust_history.get(node_id, ()))
         return history[-limit:] if limit else history
 
+    def get_recommendations(self) -> List[str]:
+        """Operator hints derived from the current aggregate picture."""
+        out: List[str] = []
+        stats = self.get_trust_statistics()
+        if stats.get("mean_trust", 1.0) < 0.6:
+            out.append("mean trust below 0.6: audit the flagged nodes before continuing")
+        if len(self.get_compromised_nodes()) > self.num_nodes * 0.3:
+            out.append(">30% of nodes compromised: treat as coordinated attack, rotate keys/hosts")
+        if stats.get("total_attacks", 0) > 10:
+            out.append("attack log is long: tighten detector thresholds or enable ML detectors")
+        suspicious = self.get_suspicious_nodes()
+        if suspicious:
+            out.append(f"keep suspicious nodes {suspicious} under per-batch observation")
+        return out
+
     def export_trust_data(self, filepath: str) -> None:
-        export_data = {
-            "trust_scores": {
-                str(i): {
-                    "value": s.value,
-                    "last_updated": s.last_updated,
-                    "update_count": s.update_count,
-                }
-                for i, s in self.trust_scores.items()
-            },
+        records = {
+            str(i): self.get_score_record(i).__dict__.copy()
+            for i in range(self.num_nodes)
+        }
+        payload = {
+            "trust_scores": records,
             "node_status": {
-                str(i): status.label for i, status in self.node_status.items()
+                str(i): self.get_node_status(i).label for i in range(self.num_nodes)
             },
-            "trust_history": {
-                str(i): list(h) for i, h in self.trust_history.items()
-            },
-            "attack_history": {
-                str(i): a for i, a in self.attack_history.items()
-            },
+            "trust_history": {str(i): list(h) for i, h in self.trust_history.items()},
+            "attack_history": {str(i): a for i, a in self.attack_history.items()},
             "statistics": self.get_trust_statistics(),
         }
         with open(filepath, "w") as f:
-            json.dump(export_data, f, indent=2)
-        logger.info("Trust data exported to %s", filepath)
+            json.dump(payload, f, indent=2)
+        logger.info("trust: exported world-view to %s", filepath)
 
-    # ------------------------------------------------------------------
-    # Adaptation / prediction (trust_manager.py:333-394)
-    # ------------------------------------------------------------------
+    # -- device bridge ----------------------------------------------------
 
-    def adaptive_threshold_adjustment(self) -> None:
-        stats = self.get_trust_statistics()
-        mean_trust = stats.get("mean_trust", 0.7)
-        if mean_trust < 0.5:
-            self.trust_threshold = max(0.3, mean_trust - 0.1)
-        elif mean_trust > 0.9:
-            self.trust_threshold = min(0.8, mean_trust - 0.1)
-        else:
-            self.trust_threshold += 0.01 * (0.7 - self.trust_threshold)
-        logger.debug("Trust threshold adjusted to %.3f", self.trust_threshold)
-
-    def predict_node_reliability(self, node_id: int, horizon: int = 10) -> float:
-        if node_id not in self.trust_history or len(self.trust_history[node_id]) < 5:
-            return self.get_trust_score(node_id)
-        recent = [e["trust_score"] for e in list(self.trust_history[node_id])[-10:]]
-        x = np.arange(len(recent))
-        coeffs = np.polyfit(x, recent, 1)
-        future = coeffs[0] * (len(recent) + horizon) + coeffs[1]
-        return float(np.clip(future, 0.0, 1.0))
-
-    def get_recommendations(self) -> List[str]:
-        recommendations = []
-        stats = self.get_trust_statistics()
-        if stats.get("mean_trust", 1.0) < 0.6:
-            recommendations.append(
-                "System trust is low - consider investigating compromised nodes"
-            )
-        compromised = self.get_compromised_nodes()
-        if len(compromised) > self.num_nodes * 0.3:
-            recommendations.append(
-                "High number of compromised nodes - check security measures"
-            )
-        if stats.get("total_attacks", 0) > 10:
-            recommendations.append(
-                "Frequent attacks detected - strengthen attack detection"
-            )
-        suspicious = self.get_suspicious_nodes()
-        if suspicious:
-            recommendations.append(f"Monitor suspicious nodes: {suspicious}")
-        return recommendations
-
-    def reset_node_trust(self, node_id: int) -> None:
-        self.initialize_node(node_id)
-        logger.info("Trust reset for node %d", node_id)
-
-    def cleanup(self) -> None:
-        logger.info("TrustManager cleanup completed")
-
-    # ------------------------------------------------------------------
-    # Device-state bridge (TPU-native; no reference equivalent)
-    # ------------------------------------------------------------------
+    def _record_history(self, node_id: int, wall_time: Optional[float] = None) -> None:
+        self.trust_history[node_id].append(
+            {
+                "timestamp": wall_time if wall_time is not None else time.time(),
+                "trust_score": self.get_trust_score(node_id),
+                "metrics": self._snapshot_metrics(node_id).__dict__.copy(),
+            }
+        )
 
     def to_device_state(self, now: float = 0.0) -> TrustState:
-        """Materialise the current host view as a TrustState pytree."""
-        import jax.numpy as jnp
-
-        n = self.num_nodes
-        state = ts.init_trust_state(
-            n,
-            trust_threshold=self.trust_threshold,
-            initial_trust=self.initial_trust,
-            decay_rate=self.default_decay_rate,
-            recovery_rate=self.default_recovery_rate,
-            now=now,
+        """Current world-view re-clocked for the jitted step (whose decay
+        clock is step count, not wall seconds)."""
+        return self._state._replace(
+            last_updated=jnp.full((self.num_nodes,), now, jnp.float32)
         )
-        scores = jnp.array([self.get_trust_score(i) for i in range(n)], jnp.float32)
-        status = jnp.array([int(self.get_node_status(i)) for i in range(n)], jnp.int32)
-        counts = jnp.array(
-            [self.trust_scores[i].update_count for i in range(n)], jnp.int32
-        )
-        return state._replace(scores=scores, status=status, update_count=counts)
 
-    def sync_from_device(self, state: TrustState,
-                         wall_time: Optional[float] = None,
-                         node_ids: Optional[List[int]] = None) -> None:
-        """Absorb a TrustState computed inside the train step (called once
-        per epoch / reporting interval, not per batch).  ``node_ids`` maps
-        device coordinates to original host ids — after elastic eviction
-        the device arrays cover only the surviving nodes."""
+    def sync_from_device(
+        self,
+        state: TrustState,
+        wall_time: Optional[float] = None,
+        node_ids: Optional[List[int]] = None,
+    ) -> None:
+        """Absorb a TrustState computed inside the train step (epoch cadence,
+        not per batch).  ``node_ids`` maps device coordinates to host node
+        ids — after elastic eviction the device arrays cover only the
+        surviving nodes, so absorption is a scatter, not a swap."""
         wall_time = wall_time if wall_time is not None else time.time()
-        scores = np.asarray(state.scores)
-        status = np.asarray(state.status)
-        counts = np.asarray(state.update_count)
-        metrics = np.asarray(state.metrics)
-        self.trust_threshold = float(np.asarray(state.threshold))
+        coords = np.arange(min(self.num_nodes, state.scores.shape[0]))
         if node_ids is None:
-            node_ids = list(range(min(self.num_nodes, scores.shape[0])))
-        for coord, i in enumerate(node_ids):
-            if i >= self.num_nodes or coord >= scores.shape[0]:
-                continue
-            old = self.trust_scores[i]
-            self.trust_scores[i] = TrustScore(
-                value=float(scores[coord]),
-                last_updated=wall_time,
-                update_count=int(counts[coord]),
-                decay_rate=old.decay_rate,
-                recovery_rate=old.recovery_rate,
-            )
-            self.node_status[i] = NodeStatus(int(status[coord]))
-            m = metrics[coord]
-            self.node_metrics[i] = NodeMetrics(
-                output_deviation=float(m[0]),
-                gradient_consistency=float(m[1]),
-                communication_latency=float(m[2]),
-                resource_utilization=float(m[3]),
-                error_rate=float(m[4]),
-                uptime=float(m[5]),
-            )
-            self.trust_history[i].append(
-                {
-                    "timestamp": wall_time,
-                    "trust_score": float(scores[coord]),
-                    "metrics": self.node_metrics[i].__dict__.copy(),
-                }
-            )
+            node_ids = coords.tolist()
+        pairs = [
+            (c, i)
+            for c, i in zip(range(state.scores.shape[0]), node_ids)
+            if 0 <= i < self.num_nodes
+        ]
+        if not pairs:
+            return
+        cs = np.asarray([c for c, _ in pairs])
+        ids = np.asarray([i for _, i in pairs])
+        idx = jnp.asarray(ids)
+        s = self._state
+        self._state = s._replace(
+            scores=s.scores.at[idx].set(jnp.asarray(np.asarray(state.scores)[cs])),
+            status=s.status.at[idx].set(jnp.asarray(np.asarray(state.status)[cs])),
+            update_count=s.update_count.at[idx].set(
+                jnp.asarray(np.asarray(state.update_count)[cs])
+            ),
+            metrics=s.metrics.at[idx].set(jnp.asarray(np.asarray(state.metrics)[cs])),
+            last_updated=s.last_updated.at[idx].set(wall_time - self._epoch0),
+            threshold=jnp.asarray(state.threshold),
+        )
+        for i in ids:
+            self._record_history(int(i), wall_time)

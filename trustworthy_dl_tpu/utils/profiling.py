@@ -1,0 +1,66 @@
+"""Tracing / profiling + debug subsystem (SURVEY §5.1, §5.2).
+
+The reference has neither: only wall-clock epoch timers
+(experiment_runner.py:154,170-172) and tensorboard/wandb pinned in
+requirements but never imported (requirements.txt:44-45).  Race detection
+(§5.2) does not apply to the SPMD design — there is no shared mutable state
+inside the compiled step — so the debug story here is numerical: XLA-level
+NaN trapping plus the step-time histogram in utils/metrics.py.
+
+* ``trace(log_dir)`` — context manager around ``jax.profiler.trace``;
+  produces TensorBoard/Perfetto-loadable device+host traces of everything
+  dispatched inside.
+* ``step_annotation(step)`` — ``StepTraceAnnotation`` so per-step slices are
+  attributed in the trace timeline.
+* ``enable_nan_debugging()`` — flips ``jax_debug_nans``: any NaN produced by
+  a jitted computation re-runs un-jitted and raises FloatingPointError at
+  the exact primitive.  Training-time detection of *adversarial* non-finite
+  gradients does NOT rely on this (the verifier's finite flag handles that
+  in-step); this is a developer mode for debugging the framework itself.
+
+Wired into DistributedTrainer via TrainingConfig.profile_dir /
+TrainingConfig.debug_nans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Profile everything dispatched inside the context into ``log_dir``
+    (no-op when log_dir is falsy, so call sites need no branching)."""
+    if not log_dir:
+        yield
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    logger.info("profiler: tracing to %s", log_dir)
+    with jax.profiler.trace(log_dir):
+        yield
+    logger.info("profiler: trace written to %s", log_dir)
+
+
+def step_annotation(step: int):
+    """Label one train step in the trace timeline."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+def enable_nan_debugging(enabled: bool = True) -> None:
+    """jax_debug_nans: jitted NaN producers re-run op-by-op and raise at the
+    exact primitive (SURVEY §5.2 plan)."""
+    jax.config.update("jax_debug_nans", enabled)
+    if enabled:
+        logger.warning(
+            "NaN debugging enabled: NaN-producing steps re-execute un-jitted "
+            "and raise FloatingPointError (debug builds only — this also "
+            "fires on adversarial NaN injections the engine would otherwise "
+            "gate out in-step)"
+        )
