@@ -252,3 +252,44 @@ def test_ml_detector_tier_fit_and_verdict():
     assert det.detect_with_ml_models(outlier, 0) is True
     assert det.detect_with_ml_models(inlier, 0) is False
     assert det.detect_with_ml_models(outlier, 1) is False  # no model yet
+
+
+def test_host_byzantine_ragged_outputs():
+    """Ragged node outputs: the shared-prefix dot is normalised by both
+    FULL norms, so unverifiable tail mass counts against its owner.  A
+    mildly longer honest output stays clear; an attacker cannot hide a
+    payload behind an honest prefix (suffix-append), control everyone's
+    comparison support (tiny output), or evade with an empty one."""
+    from trustworthy_dl_tpu.detect.detector import AttackDetector
+
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal(256).astype(np.float32)
+    honest = {
+        i: base + 0.01 * rng.standard_normal(256).astype(np.float32)
+        for i in range(3)
+    }
+    det = AttackDetector()
+
+    # Mildly verbose honest node (1/8 extra mass): clear.
+    verbose = np.concatenate(
+        [base, 0.3 * rng.standard_normal(32).astype(np.float32)]
+    )
+    assert det.detect_byzantine_behavior({**honest, 3: verbose}, 0) == []
+
+    # Uncorrelated garbage, same length: flagged.
+    garbage = rng.standard_normal(256).astype(np.float32)
+    assert det.detect_byzantine_behavior({**honest, 3: garbage}, 0) == [3]
+
+    # Suffix-append attack: honest prefix + large adversarial payload —
+    # the payload's norm dilutes every similarity, so the node is flagged.
+    payload = np.concatenate(
+        [base, 10.0 * rng.standard_normal(768).astype(np.float32)]
+    )
+    assert det.detect_byzantine_behavior({**honest, 3: payload}, 0) == [3]
+
+    # Tiny prefix-echo and empty outputs: flagged, and honest nodes stay
+    # clear (the attacker cannot shrink their comparison support).
+    assert det.detect_byzantine_behavior({**honest, 3: base[:2].copy()},
+                                         0) == [3]
+    assert det.detect_byzantine_behavior(
+        {**honest, 3: np.zeros(0, np.float32)}, 0) == [3]

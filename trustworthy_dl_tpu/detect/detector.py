@@ -273,13 +273,37 @@ class AttackDetector:
             return []
         ids = sorted(node_outputs)
         flat = [np.asarray(node_outputs[i], np.float32).reshape(-1) for i in ids]
-        width = max(f.shape[0] for f in flat)
-        padded = np.stack(
-            [np.pad(f, (0, width - f.shape[0])) for f in flat]
-        )
-        verdicts = np.asarray(
-            st.byzantine_verdicts(jnp.asarray(padded), BYZANTINE_SIMILARITY)
-        )
+        lengths = {f.shape[0] for f in flat}
+        if len(lengths) == 1 and 0 not in lengths:
+            # Equal shapes — the reference's only case (attack_detector.py:
+            # 365-379) and the common one: single vectorized device call.
+            verdicts = np.asarray(
+                st.byzantine_verdicts(jnp.asarray(np.stack(flat)),
+                                      BYZANTINE_SIMILARITY)
+            )
+        else:
+            # Ragged outputs (this build's extension): each pair's dot runs
+            # over its common prefix but is normalised by both FULL norms —
+            # mass outside the shared support cannot be cross-checked, so
+            # it counts AGAINST its owner.  This is the only assignment of
+            # the unverifiable tail that is Byzantine-safe: a global
+            # truncation width hands the shortest node control of every
+            # pair's support, a plain per-pair prefix cosine lets an
+            # attacker echo an honest prefix and hide a payload in the
+            # suffix, and a near-empty output scores ~0 here rather than
+            # shrinking anyone else's comparison.
+            n = len(flat)
+            norms = np.array([np.linalg.norm(f) for f in flat])
+            sims = np.zeros((n, n), np.float64)
+            for a in range(n):
+                for c in range(a + 1, n):
+                    w = min(flat[a].shape[0], flat[c].shape[0])
+                    denom = norms[a] * norms[c]
+                    s = float(flat[a][:w] @ flat[c][:w] / denom) \
+                        if w and denom > 0 else 0.0
+                    sims[a, c] = sims[c, a] = s
+            mean_sim = sims.sum(axis=1) / (n - 1)
+            verdicts = mean_sim < BYZANTINE_SIMILARITY
         byzantine = [i for i, flag in zip(ids, verdicts) if flag]
         for node_id in byzantine:
             logger.warning("Byzantine behavior detected on node %d", node_id)
