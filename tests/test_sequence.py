@@ -120,3 +120,39 @@ def test_gpt2_seq_parallel_end_to_end(mesh, impl):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
         )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_chunk_path(mesh, causal):
+    """With Tl >= 64 the ring body routes each rotation through the Pallas
+    flash kernel (O(Tl*D) memory instead of [Tl, Tl] scores) and merges
+    chunks by logsumexp — fwd AND grads must still match full attention,
+    including the lse-cotangent term the combine weights introduce."""
+    from trustworthy_dl_tpu.parallel.sequence import _use_flash_chunks
+
+    t = 8 * 64  # Tl = 64 per device: kernel path engages
+    assert _use_flash_chunks(64, 16)
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, t, 16), jnp.float32) for kk in ks)
+
+    ref = full_attention(q, k, v, causal)
+    with use_sequence_mesh(mesh):
+        got = jax.jit(ring_attention, static_argnums=3)(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-4, atol=3e-5
+    )
+
+    weight = jnp.arange(t, dtype=jnp.float32)[None, None, :, None] / t
+
+    def scalar(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal) * weight)
+
+    ref_g = jax.grad(scalar(full_attention), argnums=(0, 1, 2))(q, k, v)
+    with use_sequence_mesh(mesh):
+        got_g = jax.jit(jax.grad(scalar(ring_attention), argnums=(0, 1, 2)))(
+            q, k, v
+        )
+    for g, r in zip(got_g, ref_g):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=1e-3, atol=1e-4
+        )

@@ -52,6 +52,17 @@ def _block_for(t: int) -> int:
     return 0
 
 
+MAX_HEAD_DIM = 512
+
+
+def supports_flash(t: int, d: int) -> bool:
+    """THE kernel-eligibility predicate — every dispatch site (the public
+    flash_attention wrapper, ring attention's chunk path) must use this so
+    the fallback condition can never drift from the kernel's real
+    constraints."""
+    return _block_for(t) != 0 and d <= MAX_HEAD_DIM
+
+
 def _dot(a: jax.Array, b: jax.Array, trans_a: bool = False,
          trans_b: bool = False) -> jax.Array:
     """f32-accumulating matmul for the MXU."""
@@ -112,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :1] + jnp.log(l)).reshape(1, bq)[0]
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)          # [bq, 1] column
 
 
 @functools.partial(
@@ -128,7 +139,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
     )
-    return pl.pallas_call(
+    o, lse_col = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -138,11 +149,14 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # lse rides as a [BH, T, 1] column: a (1, bq) row block would
+            # violate Mosaic's (8, 128) tiling rule (sublane dim 1), while
+            # (1, bq, 1) is legal because the lane dim equals the array's.
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -151,6 +165,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v)
+    return o, lse_col[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -173,11 +188,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _dot(q, k_ref[0], trans_b=True) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, ki, bq, bk), s, NEG_INF)
-        lse = lse_ref[0].reshape(bq, 1)                       # row -> column
+        lse = lse_ref[0]                                      # [bq, 1]
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dp = _dot(do_ref[0], v_ref[0], trans_b=True)          # [bq, bk] f32
-        delta = delta_ref[0].reshape(bq, 1)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta_ref[0])
         dq_acc[:] += _dot(ds.astype(k_ref.dtype), k_ref[0]) * scale
 
     if causal:
@@ -206,13 +220,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _dot(q, k_ref[0], trans_b=True) * scale           # [bq, bk]
         if causal:
             s = jnp.where(_causal_mask(qi, ki, bq, bk), s, NEG_INF)
-        lse = lse_ref[0].reshape(bq, 1)
+        lse = lse_ref[0]                                      # [bq, 1]
         p = jnp.exp(s - lse)
         do = do_ref[0]
         dv_acc[:] += _dot(p.astype(do.dtype), do, trans_a=True)
         dp = _dot(do, v_ref[0], trans_b=True)
-        delta = delta_ref[0].reshape(bq, 1)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta_ref[0])
         dk_acc[:] += _dot(ds.astype(q.dtype), q, trans_a=True) * scale
 
     if causal:
@@ -230,12 +243,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
 )
 def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
-               interpret: bool):
+               interpret: bool, dlse=None):
     bh, t, d = q.shape
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(d)
     # Δ_i = Σ_d dO_i·O_i — one fused XLA reduction, reused by both kernels.
+    # A logsumexp cotangent (ring-attention chunk merging differentiates
+    # through the lse-dependent combine weights) enters the shared
+    # dS = P ∘ (dP − Δ) term with opposite sign: dS += P ∘ dlse, i.e.
+    # Δ_eff = Δ − dlse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    # Column layout for the same Mosaic tiling reason as the forward's lse.
+    lse_col = lse[..., None]
+    delta_col = delta[..., None]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -246,14 +268,14 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_col, delta_col)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -264,8 +286,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -280,7 +302,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_col, delta_col)
     return dq, dk, dv
 
 
@@ -313,6 +335,30 @@ def _flash_vjp_bwd(causal, bq, bk, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_chunk(q, k, v, causal: bool, bq: int, bk: int):
+    """[BH, T, D] -> (o, lse f32[BH, T]) with full AD support INCLUDING the
+    lse output — the building block for ring attention's per-rotation
+    chunk, whose cross-chunk combine weights depend on lse."""
+    return _flash_fwd(q, k, v, causal, bq, bk, _interpret())
+
+
+def _flash_chunk_vjp_fwd(q, k, v, causal, bq, bk):
+    o, lse = _flash_fwd(q, k, v, causal, bq, bk, _interpret())
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_chunk_vjp_bwd(causal, bq, bk, res, cot):
+    q, k, v, o, lse = res
+    do, dlse = cot
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, bq, bk,
+                            _interpret(), dlse=dlse)
+    return dq, dk, dv
+
+
+flash_chunk.defvjp(_flash_chunk_vjp_fwd, _flash_chunk_vjp_bwd)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True) -> jax.Array:
     """[B, H, T, D] (or [BH, T, D]) blockwise flash attention.
@@ -327,10 +373,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if squeeze:
         q, k, v = q[None], k[None], v[None]
     b, h, t, d = q.shape
-    block = _block_for(t)
-    if block == 0 or d > 512:
+    if not supports_flash(t, d):
         out = full_attention(q, k, v, causal)
         return out[0] if squeeze else out
+    block = _block_for(t)
 
     merge = lambda a: a.reshape(b * h, t, d)
     out = _flash(merge(q), merge(k), merge(v), causal, block, block)
@@ -338,4 +384,4 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out[0] if squeeze else out
 
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_chunk", "supports_flash"]

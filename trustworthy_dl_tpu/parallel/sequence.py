@@ -93,22 +93,100 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def _use_flash_chunks(tl: int, d: int) -> bool:
+    """The Pallas flash kernel handles the per-rotation chunk attention
+    when the chunk shape is kernel-eligible (ops/flash_attention.
+    supports_flash — the single predicate shared with the public wrapper);
+    otherwise the einsum body below runs.  For long-context runs (the
+    reason ring attention exists) the kernel path is what makes the memory
+    story real: the einsum body materialises [B, H, Tl, Tl] scores per
+    rotation — at Tl = 8k that is gigabytes — while the kernel streams
+    K/V blocks through VMEM at O(Tl·D)."""
+    from trustworthy_dl_tpu.ops.flash_attention import supports_flash
+
+    return supports_flash(tl, d)
+
+
+def _merge_chunk(lse_run, out_run, lse_i, o_i):
+    """Combine a normalized chunk result (o_i, lse_i) into the running
+    (lse, out) accumulator — the cross-chunk half of online softmax.
+
+    The "no contribution" sentinel is the finite NEG_INF (-1e30), not
+    -inf (which would NaN the logaddexp/exp gradients), so the guards
+    test against the sentinel explicitly rather than isfinite."""
+    new_lse = jnp.logaddexp(lse_run, lse_i)
+    w_run = jnp.where(lse_run > NEG_INF / 2, jnp.exp(lse_run - new_lse), 0.0)
+    w_i = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - new_lse), 0.0)
+    out = out_run * w_run[..., None] + o_i.astype(jnp.float32) * w_i[..., None]
+    return new_lse, out
+
+
 def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool, ring_size: int) -> jax.Array:
     """Per-device body under shard_map: q/k/v are this device's sequence
-    chunk [B, H, Tl, D].  K/V rotate ``ring_size`` times; a flash-style
-    (m, l, acc) accumulator keeps softmax exact across chunks."""
+    chunk [B, H, Tl, D].  K/V rotate ``ring_size`` times; online-softmax
+    accumulation keeps the result exact across chunks.  Per-rotation chunk
+    attention runs through the Pallas flash kernel when the chunk tiles
+    (see _use_flash_chunks), else through a fused einsum."""
     stage = jax.lax.axis_index(SEQ_AXIS)
     b, h, tl, d = q.shape
     scale = 1.0 / math.sqrt(d)
     q_pos = stage * tl + jnp.arange(tl)
 
-    m0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tl), jnp.float32)
-    acc0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    if _use_flash_chunks(tl, d):
+        from trustworthy_dl_tpu.ops.flash_attention import (
+            _block_for,
+            flash_chunk,
+        )
 
-    def body(carry, i):
-        k_cur, v_cur, m, l, acc = carry
+        block = _block_for(tl)
+        merge = lambda a: a.reshape(b * h, tl, d)
+
+        def chunk(k_cur, v_cur, chunk_causal: bool):
+            o, lse = flash_chunk(merge(q), merge(k_cur), merge(v_cur),
+                                 chunk_causal, block, block)
+            return (o.reshape(b, h, tl, d),
+                    lse.reshape(b, h, tl))
+
+        def attend(k_cur, v_cur, i):
+            src = (stage - i) % ring_size
+            if not causal:
+                return chunk(k_cur, v_cur, False)
+            # src > stage: chunk entirely in the future — skip.
+            # src == stage: the diagonal chunk — causal kernel.
+            # src < stage: entirely visible — non-causal kernel.
+            return jax.lax.switch(
+                jnp.clip(jnp.sign(src - stage) + 1, 0, 2).astype(jnp.int32),
+                [
+                    lambda: chunk(k_cur, v_cur, False),
+                    lambda: chunk(k_cur, v_cur, True),
+                    lambda: (jnp.zeros((b, h, tl, d), q.dtype),
+                             jnp.full((b, h, tl), NEG_INF, jnp.float32)),
+                ],
+            )
+
+        def body(carry, i):
+            # Rotate FIRST, then attend: the i=0 chunk is consumed outside
+            # the scan, so only ring_size-1 rotations happen and no K/V
+            # ppermute pair is ever computed just to be discarded.
+            k_cur, v_cur, lse, out = carry
+            perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+            k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
+            v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+            o_i, lse_i = attend(k_cur, v_cur, i)
+            lse, out = _merge_chunk(lse, out, lse_i, o_i)
+            return (k_cur, v_cur, lse, out), None
+
+        out0 = jnp.zeros((b, h, tl, d), jnp.float32)
+        lse0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
+        o_0, lse_0 = attend(k, v, jnp.zeros((), jnp.int32))
+        lse0, out0 = _merge_chunk(lse0, out0, lse_0, o_0)
+        (_, _, _, out), _ = jax.lax.scan(
+            body, (k, v, lse0, out0), jnp.arange(1, ring_size)
+        )
+        return out.astype(q.dtype)
+
+    def accumulate(m, l, acc, k_cur, v_cur, i):
         # After i rotations this device holds the chunk originating at
         # stage - i (mod ring).
         src = (stage - i) % ring_size
@@ -130,13 +208,23 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         acc = acc * correction[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
         )
-        perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
-        k_next = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
-        v_next = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
-        return (k_next, v_next, m_new, l, acc), None
+        return m_new, l, acc
 
+    def body(carry, i):
+        # Rotate first (see the flash body): ring_size-1 rotations total.
+        k_cur, v_cur, m, l, acc = carry
+        perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+        k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
+        v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        m, l, acc = accumulate(m, l, acc, k_cur, v_cur, i)
+        return (k_cur, v_cur, m, l, acc), None
+
+    m0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    acc0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    m0, l0, acc0 = accumulate(m0, l0, acc0, k, v, jnp.zeros((), jnp.int32))
     (_, _, m, l, acc), _ = jax.lax.scan(
-        body, (k, v, m0, l0, acc0), jnp.arange(ring_size)
+        body, (k, v, m0, l0, acc0), jnp.arange(1, ring_size)
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
