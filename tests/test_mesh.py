@@ -1,0 +1,59 @@
+"""Mesh construction: axis conventions, hybrid (multi-slice) layouts, and
+the degenerate paths dev boxes hit."""
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.core.mesh import (
+    AXIS_ORDER,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    STAGE_AXIS,
+    build_hybrid_mesh,
+    build_mesh,
+)
+
+
+def test_axis_order_outermost_first():
+    """DCN-tolerant axes (data, stage) must precede bandwidth-hungry ones
+    (model/seq/expert) so multi-slice layouts put the right collectives on
+    the right fabric."""
+    assert AXIS_ORDER.index(DATA_AXIS) < AXIS_ORDER.index(MODEL_AXIS)
+    assert AXIS_ORDER.index(STAGE_AXIS) < AXIS_ORDER.index(SEQ_AXIS)
+    assert AXIS_ORDER[-1] == EXPERT_AXIS
+
+
+def test_hybrid_single_slice_reshape(eight_devices):
+    mesh = build_hybrid_mesh({DATA_AXIS: 2, MODEL_AXIS: 4},
+                             devices=eight_devices)
+    assert mesh.axis_names == (DATA_AXIS, MODEL_AXIS)
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_hybrid_mesh_via_build_mesh(eight_devices):
+    mesh = build_mesh(2, "hybrid", {DATA_AXIS: 2, SEQ_AXIS: 2, EXPERT_AXIS: 2},
+                      devices=eight_devices)
+    assert mesh.axis_names == (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS)
+    assert mesh.devices.shape == (2, 2, 2)
+
+
+def test_hybrid_rejects_unknown_axis(eight_devices):
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        build_hybrid_mesh({"bogus": 2}, devices=eight_devices)
+
+
+def test_hybrid_rejects_oversubscription(eight_devices):
+    with pytest.raises(ValueError, match="needs"):
+        build_hybrid_mesh({DATA_AXIS: 4, MODEL_AXIS: 4},
+                          devices=eight_devices)
+
+
+def test_hybrid_dcn_extent_counts_against_devices(eight_devices):
+    """A DCN extent multiplies the device requirement even though the CPU
+    test mesh has no slice structure (the error fires before any
+    slice-index lookup)."""
+    with pytest.raises(ValueError, match="needs"):
+        build_hybrid_mesh({DATA_AXIS: 4}, {DATA_AXIS: 4},
+                          devices=eight_devices)

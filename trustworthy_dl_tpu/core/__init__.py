@@ -10,6 +10,7 @@ from trustworthy_dl_tpu.core.mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
     STAGE_AXIS,
+    build_hybrid_mesh,
     build_mesh,
     node_axis_for,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "SEQ_AXIS",
     "STAGE_AXIS",
     "TrainingConfig",
+    "build_hybrid_mesh",
     "build_mesh",
     "load_config",
     "node_axis_for",

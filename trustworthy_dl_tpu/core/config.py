@@ -21,8 +21,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 #                strategy, distributed_trainer.py:124-135)
 #  - "tensor":   intra-layer sharding over a 'model' mesh axis (GSPMD)
 #  - "sequence": sequence-dim sharding (Ulysses all_to_all / ring attention)
+#  - "expert":   MoE expert-dim sharding over an 'expert' mesh axis
 #  - "hybrid":   explicit mesh_shape dict combining several axes
-PARALLELISM_MODES = ("data", "model", "tensor", "sequence", "hybrid")
+PARALLELISM_MODES = ("data", "model", "tensor", "sequence", "expert", "hybrid")
 
 
 @dataclass
@@ -66,7 +67,10 @@ class TrainingConfig:
 
     # ---- TPU-native execution knobs (no reference equivalent) ----
     parallelism: str = "data"          # one of PARALLELISM_MODES
-    mesh_shape: Optional[Dict[str, int]] = None  # for "hybrid"
+    mesh_shape: Optional[Dict[str, int]] = None  # for "hybrid" (within-slice)
+    # Across-slice (DCN) extents for multi-slice pods: {axis: n_slices}.
+    # Axes listed here parallelise over DCN; all others stay on ICI.
+    dcn_mesh_shape: Optional[Dict[str, int]] = None
     num_microbatches: int = 4          # pipeline schedule depth
     dtype: str = "bfloat16"            # compute dtype (params stay f32)
     seed: int = 0
@@ -224,6 +228,8 @@ def _config_from_mapping(raw: Dict[str, Any]) -> Dict[str, Any]:
             out["parallelism"] = distributed["parallelism"]
         if "mesh_shape" in distributed:
             out["mesh_shape"] = dict(distributed["mesh_shape"])
+        if "dcn_mesh_shape" in distributed:
+            out["dcn_mesh_shape"] = dict(distributed["dcn_mesh_shape"])
         if "num_microbatches" in distributed:
             out["num_microbatches"] = distributed["num_microbatches"]
     security = raw.get("security", {})

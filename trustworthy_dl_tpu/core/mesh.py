@@ -44,19 +44,68 @@ def node_axis_for(parallelism: str) -> str:
         raise ValueError(f"no canonical node axis for parallelism={parallelism!r}")
 
 
+# Canonical outermost-first axis order: DCN-adjacent axes (data, stage —
+# the ones whose collectives tolerate lower bandwidth) come first, per the
+# scaling-book recipe; bandwidth-hungry axes (model/seq/expert) innermost
+# so their collectives ride ICI.
+AXIS_ORDER = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+
+def build_hybrid_mesh(
+    ici_mesh_shape: Dict[str, int],
+    dcn_mesh_shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: per-axis ICI size within a slice and DCN size
+    across slices.  Axes with a DCN extent >1 replicate/parallelise across
+    slices (typically 'data' and/or 'stage'); everything else stays inside
+    one slice so its collectives never touch DCN.
+
+    On real multi-slice TPU hardware the device grid comes from
+    ``mesh_utils.create_hybrid_device_mesh`` (which groups by slice
+    index); when every DCN extent is 1 — single slice, CPU test meshes —
+    the layout degenerates to a plain reshape in AXIS_ORDER.
+    """
+    dcn_mesh_shape = dcn_mesh_shape or {}
+    extra = (set(ici_mesh_shape) | set(dcn_mesh_shape)) - set(AXIS_ORDER)
+    if extra:
+        raise ValueError(f"unknown mesh axes {extra}")
+    order = [a for a in AXIS_ORDER
+             if a in ici_mesh_shape or a in dcn_mesh_shape]
+    ici = [int(ici_mesh_shape.get(a, 1)) for a in order]
+    dcn = [int(dcn_mesh_shape.get(a, 1)) for a in order]
+    devices = list(devices if devices is not None else jax.devices())
+    total = int(np.prod(ici)) * int(np.prod(dcn))
+    if total > len(devices):
+        raise ValueError(
+            f"hybrid mesh ici={ici_mesh_shape} dcn={dcn_mesh_shape} needs "
+            f"{total} devices, have {len(devices)}"
+        )
+    if any(d > 1 for d in dcn):
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices[:total]
+        )
+        return Mesh(arr, tuple(order))
+    arr = np.array(devices[:total]).reshape(ici)
+    return Mesh(arr, tuple(order))
+
+
 def build_mesh(
     num_nodes: int,
     parallelism: str = "data",
     mesh_shape: Optional[Dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    dcn_mesh_shape: Optional[Dict[str, int]] = None,
 ) -> Mesh:
     """Build the mesh for a training run.
 
     For single-axis strategies the node axis gets ``num_nodes`` entries; any
     leftover devices fold into a leading data axis so all chips stay busy.
-    For "hybrid", ``mesh_shape`` gives {axis: size} explicitly (axis order is
-    data, stage, model, seq — outermost first so DCN-adjacent axes come
-    first, per the scaling-book recipe).
+    For "hybrid", ``mesh_shape`` gives the within-slice {axis: size}
+    explicitly and ``dcn_mesh_shape`` the optional across-slice extents
+    (see build_hybrid_mesh).
     """
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
@@ -64,16 +113,16 @@ def build_mesh(
     if parallelism == "hybrid":
         if not mesh_shape:
             raise ValueError("hybrid parallelism requires mesh_shape")
-        order = [a for a in (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, SEQ_AXIS) if a in mesh_shape]
-        extra = set(mesh_shape) - set(order)
-        if extra:
-            raise ValueError(f"unknown mesh axes {extra}")
-        sizes = [mesh_shape[a] for a in order]
-        total = int(np.prod(sizes))
-        if total > n_dev:
-            raise ValueError(f"mesh_shape {mesh_shape} needs {total} devices, have {n_dev}")
-        arr = np.array(devices[:total]).reshape(sizes)
-        return Mesh(arr, tuple(order))
+        return build_hybrid_mesh(mesh_shape, dcn_mesh_shape, devices)
+    if dcn_mesh_shape:
+        # Silently dropping the DCN extents would lay collectives across
+        # slices with no slice-aware grouping — the failure hybrid meshes
+        # exist to prevent.
+        raise ValueError(
+            "dcn_mesh_shape requires parallelism='hybrid' (got "
+            f"{parallelism!r}); express the within-slice layout in "
+            "mesh_shape and the across-slice extents in dcn_mesh_shape"
+        )
 
     axis = node_axis_for(parallelism)
     if num_nodes > n_dev:

@@ -141,12 +141,23 @@ class DistributedTrainer:
         )
         self.optimizer = build_optimizer(config)
         self.mesh = mesh if mesh is not None else build_mesh(
-            config.num_nodes, config.parallelism, config.mesh_shape
+            config.num_nodes, config.parallelism, config.mesh_shape,
+            dcn_mesh_shape=config.dcn_mesh_shape,
         )
         if config.parallelism == "sequence":
             from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
 
             set_sequence_mesh(self.mesh)
+        if config.parallelism == "expert":
+            from trustworthy_dl_tpu.models.moe import set_expert_mesh
+
+            set_expert_mesh(self.mesh)
+            if "-moe" not in self.config.model_name:
+                logger.warning(
+                    "parallelism='expert' with non-MoE model %r: the "
+                    "'expert' mesh axis will carry no sharded computation",
+                    self.config.model_name,
+                )
         if config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
