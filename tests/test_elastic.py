@@ -150,3 +150,39 @@ def test_second_eviction(tmp_path):
     assert trainer.config.num_nodes == 2
     assert trainer.node_map == [0, 3]
     assert np.isfinite(loss)
+
+
+def test_checkpoint_resume_after_eviction(evicted_run, tmp_path):
+    """SURVEY §5.4: a checkpoint written AFTER eviction (7 live nodes) must
+    restore into a fresh trainer constructed with the original 8-node
+    config — the saved topology is adopted, identities survive, and
+    training continues with finite losses."""
+    trainer, _ = evicted_run
+    trainer.save_checkpoint()
+
+    fresh = DistributedTrainer(
+        TrainingConfig(
+            model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+            num_nodes=8, optimizer="adamw", learning_rate=3e-3,
+            detector_warmup=4, checkpoint_interval=10_000,
+            checkpoint_dir=trainer.config.checkpoint_dir,
+            elastic_resharding=True,
+        ),
+        model_overrides=dict(TINY_GPT),
+    )
+    fresh.load_checkpoint()
+
+    assert fresh.config.num_nodes == 7
+    assert fresh.node_map == trainer.node_map
+    assert fresh.global_step == trainer.global_step
+    np.testing.assert_allclose(
+        np.asarray(fresh.state.trust.scores),
+        np.asarray(trainer.state.trust.scores), rtol=1e-6,
+    )
+    # The evicted identity's compromised record survives on the host.
+    assert fresh.trust_manager.get_node_status(5) == NodeStatus.COMPROMISED
+
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=32, seed=7)
+    avg = fresh.train_epoch(dl, epoch=3)
+    assert np.isfinite(avg)

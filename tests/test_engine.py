@@ -190,6 +190,11 @@ def test_checkpoint_round_trip_restores_trust_world(tmp_path):
         np.asarray(trainer2.state.grad_baseline.count),
         np.asarray(trainer.state.grad_baseline.count),
     )
+    # Resume must be CONTINUABLE, not just inspectable: restored arrays
+    # come back committed to devices, and a template without explicit mesh
+    # placement would fail the next jitted step against sharded batches.
+    avg = trainer2.train_epoch(dl, epoch=2)
+    assert np.isfinite(avg)
 
 
 def test_nan_gradient_node_does_not_corrupt_training(tmp_path):

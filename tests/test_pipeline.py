@@ -248,3 +248,29 @@ def test_pipeline_validate(pipeline_attack_run):
     val = get_dataloader("openwebtext", split="validation", batch_size=8,
                          seq_len=16, vocab_size=128, num_examples=16)
     assert np.isfinite(trainer.validate(val))
+
+
+def test_pipeline_checkpoint_resume_is_continuable(tmp_path):
+    """Restore under stage parallelism must come back on the mesh (stage
+    rows re-placed, stacked blocks keeping their stage sharding) so
+    training continues — not committed to device 0."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=10,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=32)
+    trainer.initialize()
+    trainer.train_epoch(dl, 0)
+    trainer.save_checkpoint()
+
+    fresh = DistributedTrainer(config, model_overrides=dict(TINY))
+    fresh.initialize()
+    fresh.load_checkpoint()
+    assert fresh.global_step == trainer.global_step
+    avg = fresh.train_epoch(dl, epoch=1)
+    assert np.isfinite(avg)

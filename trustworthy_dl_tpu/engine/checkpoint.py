@@ -54,6 +54,30 @@ class CheckpointManager:
     def path_for(self, step: int) -> str:
         return os.path.join(self.directory, f"checkpoint_step_{step}")
 
+    # -- topology sidecar -------------------------------------------------
+    # After an elastic eviction the live node count differs from the
+    # config's; a resume must rebuild THAT topology before Orbax can place
+    # leaves (SURVEY §5.4: "restore must tolerate a different live-device
+    # set than at save time").  The sidecar records it.
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"topology_{step}.json")
+
+    def save_metadata(self, step: int, meta: dict) -> None:
+        import json
+
+        with open(self._meta_path(step), "w") as f:
+            json.dump(meta, f)
+
+    def load_metadata(self, step: int) -> Optional[dict]:
+        import json
+
+        path = self._meta_path(step)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
     def save(self, state: Any, step: int, force: bool = False) -> str:
         path = self.path_for(step)
         if os.path.exists(path):

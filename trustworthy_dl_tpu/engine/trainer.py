@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, null_plan
 from trustworthy_dl_tpu.core.config import NodeConfig, TrainingConfig
-from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, build_mesh
 from trustworthy_dl_tpu.data.loader import PrefetchLoader
 from trustworthy_dl_tpu.detect.detector import AttackDetector, AttackType
 from trustworthy_dl_tpu.detect.stats import (
@@ -234,7 +234,7 @@ class DistributedTrainer:
                 self.config.num_nodes,
                 make_canary(self.model.config, self.config.canary_tokens),
             )
-        self.state = init_train_state(
+        self.state = self._place_on_mesh(init_train_state(
             k_state, params, opt_state,
             num_nodes=self.config.num_nodes,
             trust_threshold=self.config.trust_threshold,
@@ -244,9 +244,64 @@ class DistributedTrainer:
             detector_window=self.config.detector_history,
             num_monitor_leaves=num_monitor_leaves,
             canary=canary,
-        )
+        ))
         self.training_state = TrainingState.TRAINING
         return self.state
+
+    def _place_on_mesh(self, state: TrainState) -> TrainState:
+        """Explicit mesh placement of the whole TrainState: per-node rows
+        shard over the node axis ('stage' under pipelining, 'data'
+        otherwise), leaves already laid out on this mesh (stage-stacked
+        blocks, TP params and their optimizer mirrors) keep their
+        shardings, and everything else replicates.
+
+        Freshly-initialised arrays would otherwise sit uncommitted on
+        device 0 — fine for the first jitted step (GSPMD replicates them),
+        but a checkpoint restored into that template comes back COMMITTED
+        to device 0 and the next step fails mixing it with mesh-sharded
+        arrays.  Explicit placement makes init and resume identical."""
+        mesh = self.mesh
+        if len(list(mesh.devices.flat)) <= 1:
+            return state
+        node_axis = STAGE_AXIS if self.config.parallelism == "model" else \
+            DATA_AXIS
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        axis_size = sizes.get(node_axis, 1)
+        n = self.config.num_nodes
+        repl = NamedSharding(mesh, P())
+
+        def keep_or_repl(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+                return leaf  # already mesh-placed (stage/TP layouts)
+            return jax.device_put(leaf, repl)
+
+        def place_row(leaf):
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n \
+                    and axis_size > 1 and n % axis_size == 0:
+                spec = P(node_axis, *([None] * (leaf.ndim - 1)))
+                return jax.device_put(leaf, NamedSharding(mesh, spec))
+            return jax.device_put(leaf, repl)
+
+        per_node = dict(
+            trust=state.trust, out_baseline=state.out_baseline,
+            grad_baseline=state.grad_baseline, verifier=state.verifier,
+            monitor=state.monitor, prev_suspects=state.prev_suspects,
+        )
+        if state.canary is not None:
+            per_node["canary"] = state.canary
+        placed = {k: jax.tree_util.tree_map(place_row, v)
+                  for k, v in per_node.items()}
+        shared = {
+            "params": jax.tree_util.tree_map(keep_or_repl, state.params),
+            "opt_state": jax.tree_util.tree_map(keep_or_repl,
+                                                state.opt_state),
+        }
+        scalars = jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, repl),
+            {"step": state.step, "epoch": state.epoch, "rng": state.rng},
+        )
+        return state._replace(**placed, **shared, **scalars)
 
     def set_attack_plan(self, plan: AttackPlan) -> None:
         """Install the experiment's fault-injection schedule."""
@@ -621,14 +676,107 @@ class DistributedTrainer:
     def save_checkpoint(self) -> Optional[str]:
         if self.state is None:
             return None
-        return self.checkpointer.save(self.state, self.global_step)
+        import os
+
+        # Sidecar and payload must stay in sync: CheckpointManager.save
+        # skips an existing step directory, so a pre-existing payload (a
+        # reused checkpoint_dir) must not get its topology overwritten.
+        already = os.path.exists(self.checkpointer.path_for(self.global_step))
+        path = self.checkpointer.save(self.state, self.global_step)
+        if already:
+            logger.warning(
+                "Checkpoint step %d already existed; keeping its sidecar "
+                "(payload was not rewritten)", self.global_step,
+            )
+            return path
+        # Topology sidecar: after elastic eviction the live node count and
+        # coordinate->identity map differ from the constructor config; a
+        # resume needs them BEFORE it can shape the restore template.
+        self.checkpointer.save_metadata(self.global_step, {
+            "num_nodes": self.config.num_nodes,
+            "node_map": list(self.node_map),
+            "parallelism": self.config.parallelism,
+            # Evicted identities have no device row anymore; their
+            # compromised standing must survive the resume on the host.
+            "compromised_nodes": sorted(
+                int(i) for i in self.trust_manager.get_compromised_nodes()
+            ),
+        })
+        return path
+
+    def _adopt_topology(self, meta: Dict[str, Any]) -> None:
+        """Rebuild mesh/step/template for a checkpoint saved on a different
+        (post-eviction) node count — SURVEY §5.4's resume requirement."""
+        import dataclasses
+
+        if self.config.parallelism != "data":
+            raise NotImplementedError(
+                "post-eviction resume onto a different node count is only "
+                "defined for data parallelism (eviction itself is, "
+                "elastic/reassignment.py)"
+            )
+        n = int(meta["num_nodes"])
+        logger.info(
+            "Checkpoint topology has %d node(s) (config says %d): adopting "
+            "the saved topology for resume", n, self.config.num_nodes,
+        )
+        self.config = dataclasses.replace(self.config, num_nodes=n)
+        self.mesh = build_mesh(n, self.config.parallelism,
+                               self.config.mesh_shape)
+        self._train_step = jax.jit(
+            build_train_step(self.model, self.config, self.optimizer),
+            donate_argnums=(0,),
+        )
+        self._eval_step = jax.jit(build_eval_step(self.model))
+        self.node_map = [int(i) for i in meta["node_map"]]
+        # Any attack plan was shaped for the constructor's node count;
+        # injection targets are per-run anyway — reset, caller re-plans.
+        self.attack_plan = null_plan(n)
+        self.state = None  # template must be rebuilt with the new shapes
 
     def load_checkpoint(self, step: Optional[int] = None) -> TrainState:
         """Restore the full world-view — weights AND trust state — then
-        mirror into the host objects."""
+        mirror into the host objects.  A checkpoint written after elastic
+        eviction (fewer live nodes than the constructor config) restores
+        onto the saved topology."""
+        if step is None:
+            step = self.checkpointer.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.config.checkpoint_dir}"
+                )
+        meta = self.checkpointer.load_metadata(step)
+        if meta and int(meta["num_nodes"]) != self.config.num_nodes:
+            self._adopt_topology(meta)
         if self.state is None:
             self.initialize()
         self.state = self.checkpointer.restore(self.state, step)
+        if meta:
+            self.node_map = [int(i) for i in meta["node_map"]]
+            # Original ids can exceed the constructor's node count (e.g. a
+            # fresh trainer built with the post-eviction live count): grow
+            # the host bookkeeping so no live identity is silently dropped
+            # by the sync scatter's bounds filter.
+            max_id = max(
+                self.node_map + [int(i) for i in
+                                 meta.get("compromised_nodes", [])],
+                default=-1,
+            )
+            if max_id >= self.trust_manager.num_nodes:
+                self.trust_manager.initialize_node(max_id)
+            live = set(self.node_map)
+            for node_id in meta.get("compromised_nodes", []):
+                node_id = int(node_id)
+                if node_id not in live and (
+                    self.trust_manager.get_node_status(node_id)
+                    != NodeStatus.COMPROMISED
+                ):
+                    # Evicted before the save: no device row to sync from,
+                    # so restore the host-side standing directly (once —
+                    # repeated restores must not duplicate attack records).
+                    self.trust_manager.mark_compromised(
+                        node_id, attack_type="restored_from_checkpoint"
+                    )
         self.global_step = int(self.state.step)
         self.sync_host_state()
         return self.state
