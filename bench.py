@@ -82,6 +82,49 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
     return steps / elapsed
 
 
+def bench_longctx() -> None:
+    """Optional long-context A/B (TDDL_BENCH_LONGCTX=1): flash-kernel vs
+    XLA full attention, fwd+bwd, at sequence lengths where the [T, T]
+    score matrix starts to dominate HBM.  Iterations chain (q feeds back)
+    inside one jitted fori_loop so remote-execution caching or dispatch
+    overhead cannot fake the timing.  Diagnostics only — stderr."""
+    import jax
+    import jax.numpy as jnp
+
+    from trustworthy_dl_tpu.models.gpt2 import full_attention
+    from trustworthy_dl_tpu.ops.flash_attention import flash_attention
+
+    b, h, d = 1, 12, 64
+    iters = int(os.environ.get("TDDL_BENCH_LONGCTX_ITERS", "10"))
+    for t in (4096, 8192, 16384):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+                   for kk in ks)
+
+        def run(attn, q):
+            def loss(q):
+                return jnp.sum(attn(q, k, v, True).astype(jnp.float32) ** 2)
+
+            def body(_, q):
+                return q + 1e-3 * jax.grad(loss)(q)
+
+            return jax.lax.fori_loop(0, iters, body, q)
+
+        for name, attn in (("flash", flash_attention),
+                           ("full", full_attention)):
+            try:
+                fn = jax.jit(lambda q, _attn=attn: run(_attn, q))
+                fn(q).block_until_ready()  # compile
+                t0 = time.perf_counter()
+                fn(q).block_until_ready()
+                ms = (time.perf_counter() - t0) / iters * 1e3
+                log(f"longctx T={t:5d} {name:5s} fwd+bwd "
+                    f"{ms:8.2f} ms/iter ({b * t / ms * 1e3:,.0f} tok/s)")
+            except Exception as exc:  # OOM on the full path is the point
+                log(f"longctx T={t:5d} {name:5s} failed: "
+                    f"{type(exc).__name__}: {str(exc)[:120]}")
+
+
 def main() -> None:
     model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
     num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
@@ -124,6 +167,9 @@ def main() -> None:
             del os.environ["TDDL_FUSED_STATS"]
         log(f"detection ON (pallas fused stats): {sps_fused:.3f} steps/s "
             f"(vs {sps_on:.3f} XLA)")
+
+    if os.environ.get("TDDL_BENCH_LONGCTX") == "1":
+        bench_longctx()
 
     print(json.dumps({
         "metric": f"{model}_tokens_per_sec_per_chip_detection_on",
