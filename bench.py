@@ -54,8 +54,15 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
         attack_detection_enabled=detection,
         gradient_verification_enabled=detection,
         parallelism="data",
+        lm_head_chunk=int(os.environ.get("TDDL_BENCH_CHUNK", "0")),
     )
-    trainer = DistributedTrainer(config, model_overrides={"seq_len": seq_len})
+    overrides: dict = {"seq_len": seq_len}
+    attn = os.environ.get("TDDL_BENCH_ATTN")
+    if attn:
+        overrides["attn_impl"] = attn
+    if os.environ.get("TDDL_BENCH_REMAT") == "1":
+        overrides["remat"] = True
+    trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
 
     rng = np.random.default_rng(0)

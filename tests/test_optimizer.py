@@ -1,0 +1,69 @@
+"""LR schedule tests — the scheduler the reference stepped but never built
+(distributed_trainer.py:478-489)."""
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.engine.optimizer import build_optimizer, build_schedule
+
+
+def test_constant_schedule_default():
+    cfg = TrainingConfig(learning_rate=1e-3)
+    sched = build_schedule(cfg)
+    assert np.isclose(float(sched(0)), 1e-3)
+    assert np.isclose(float(sched(10_000)), 1e-3)
+
+
+def test_warmup_then_cosine():
+    cfg = TrainingConfig(
+        learning_rate=1e-3, lr_schedule="cosine", warmup_steps=10,
+        lr_decay_steps=100, min_lr_ratio=0.1,
+    )
+    sched = build_schedule(cfg)
+    assert float(sched(0)) == 0.0
+    assert np.isclose(float(sched(5)), 5e-4)          # mid-warmup
+    assert np.isclose(float(sched(10)), 1e-3)         # peak
+    assert np.isclose(float(sched(110)), 1e-4, rtol=1e-3)  # floor
+    # monotone decay after warmup
+    vals = [float(sched(s)) for s in range(10, 111, 10)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+
+def test_linear_decay():
+    cfg = TrainingConfig(
+        learning_rate=2e-3, lr_schedule="linear", lr_decay_steps=50,
+    )
+    sched = build_schedule(cfg)
+    assert np.isclose(float(sched(0)), 2e-3)
+    assert np.isclose(float(sched(25)), 1e-3)
+    assert float(sched(50)) == 0.0
+
+
+def test_unknown_schedule_raises():
+    cfg = TrainingConfig(lr_schedule="exponential", lr_decay_steps=10)
+    with pytest.raises(ValueError):
+        build_schedule(cfg)
+
+
+def test_scheduled_optimizer_updates_shrink():
+    """SGD step size tracks the schedule inside the compiled update."""
+    import jax.numpy as jnp
+
+    cfg = TrainingConfig(
+        optimizer="sgd", learning_rate=1.0, lr_schedule="linear",
+        lr_decay_steps=2,
+    )
+    opt = build_optimizer(cfg)
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.ones((3,))}
+    state = opt.init(params)
+    u0, state = opt.update(grads, state, params)
+    u1, state = opt.update(grads, state, params)
+    u2, state = opt.update(grads, state, params)
+    # momentum-free first step: |u| equals the lr at that step
+    s0 = float(jnp.abs(u0["w"][0]))
+    assert np.isclose(s0, 1.0)
+    # decayed lr -> strictly smaller update magnitude by the horizon
+    s2 = float(jnp.abs(u2["w"][0]))
+    assert s2 < s0

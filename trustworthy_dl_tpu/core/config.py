@@ -102,6 +102,11 @@ class TrainingConfig:
     # normally gated in-step by the verifier instead).
     profile_dir: Optional[str] = None
     debug_nans: bool = False
+    # Vocab-chunked fused lm-head+cross-entropy (ops/fused_ce.py): the LM
+    # loss never materialises the [B, T, V] logits — removes the dominant
+    # HBM tensor of the loss step and unlocks larger per-chip batches.
+    # 0 disables; typical value 8192 (multiple of 128 for MXU tiling).
+    lm_head_chunk: int = 0
     checkpoint_dir: str = "checkpoints"
     # Migration-time model rate for reassignment estimates.  The reference
     # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer
@@ -117,6 +122,17 @@ class TrainingConfig:
     optimizer: str = "adamw"
     weight_decay: float = 0.0
     grad_clip_norm: float = 0.0        # 0 disables
+    # LR schedule — the reference steps a torch scheduler once per epoch
+    # (distributed_trainer.py:478-489) but never constructs one; here the
+    # schedule is a real optax schedule evaluated per step inside the
+    # compiled update.  "constant" | "cosine" | "linear"; warmup_steps
+    # prepends a linear ramp from 0.  lr_decay_steps sets the decay
+    # horizon (0 → num_epochs is unknown at build time, stay constant
+    # after warmup).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    lr_decay_steps: int = 0
+    min_lr_ratio: float = 0.0          # floor as a fraction of peak LR
     # Trust dynamics (trust_manager.py:31-32,49-54; README.md:72-74 uses
     # 0.1/0.05 — we expose both, defaulting to the code's values per SURVEY
     # §7.5).
@@ -217,7 +233,10 @@ def _config_from_mapping(raw: Dict[str, Any]) -> Dict[str, Any]:
             out["model_name"] = f"{name}{suffix}" if name.startswith("gpt") else name
     training = raw.get("training", {})
     if isinstance(training, dict):
-        for key in ("batch_size", "learning_rate", "num_epochs"):
+        for key in ("batch_size", "learning_rate", "num_epochs",
+                    "lr_schedule", "warmup_steps", "lr_decay_steps",
+                    "min_lr_ratio", "optimizer", "weight_decay",
+                    "grad_clip_norm"):
             if key in training:
                 out[key] = training[key]
     distributed = raw.get("distributed", {})

@@ -4,7 +4,11 @@ torch.optim dict (distributed_trainer.py:90-91,441-446).
 One optimizer over the replicated params: gradients are already the
 trust-gated aggregate by the time they reach the update, which fixes the
 reference bug where ``optimizer_step`` ignored the verified gradients
-entirely (SURVEY §7.5)."""
+entirely (SURVEY §7.5).
+
+The LR schedule is a real optax schedule traced into the compiled update
+— the reference's ``scheduler.step()`` (distributed_trainer.py:478-489)
+was called on a scheduler that was never constructed."""
 
 from __future__ import annotations
 
@@ -13,19 +17,45 @@ import optax
 from trustworthy_dl_tpu.core.config import TrainingConfig
 
 
+def build_schedule(config: TrainingConfig) -> optax.Schedule:
+    """LR schedule from config: optional linear warmup from 0, then
+    constant / cosine / linear decay to ``min_lr_ratio * peak`` over
+    ``lr_decay_steps`` post-warmup steps."""
+    peak = config.learning_rate
+    name = config.lr_schedule.lower()
+    if name not in ("constant", "cosine", "linear"):
+        raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
+    warmup = max(int(config.warmup_steps), 0)
+    decay = max(int(config.lr_decay_steps), 0)
+    floor = peak * config.min_lr_ratio
+    if name == "constant" or decay == 0:
+        body = optax.constant_schedule(peak)
+    elif name == "cosine":
+        body = optax.cosine_decay_schedule(
+            peak, decay, alpha=config.min_lr_ratio
+        )
+    elif name == "linear":
+        body = optax.linear_schedule(peak, floor, decay)
+    if warmup == 0:
+        return body
+    ramp = optax.linear_schedule(0.0, peak, warmup)
+    return optax.join_schedules([ramp, body], [warmup])
+
+
 def build_optimizer(config: TrainingConfig) -> optax.GradientTransformation:
     chain = []
     if config.grad_clip_norm and config.grad_clip_norm > 0:
         chain.append(optax.clip_by_global_norm(config.grad_clip_norm))
+    schedule = build_schedule(config)
     name = config.optimizer.lower()
     if name == "adamw":
         chain.append(
-            optax.adamw(config.learning_rate, weight_decay=config.weight_decay)
+            optax.adamw(schedule, weight_decay=config.weight_decay)
         )
     elif name == "adam":
-        chain.append(optax.adam(config.learning_rate))
+        chain.append(optax.adam(schedule))
     elif name == "sgd":
-        chain.append(optax.sgd(config.learning_rate, momentum=0.9))
+        chain.append(optax.sgd(schedule, momentum=0.9))
     else:
         raise ValueError(f"unknown optimizer {config.optimizer!r}")
     return optax.chain(*chain)

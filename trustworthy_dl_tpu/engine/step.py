@@ -214,16 +214,23 @@ def build_train_step(
         # (what the reference's per-partition hook watched,
         # distributed_trainer.py:160-170).  For LMs these are ~65× smaller
         # than the logits, keeping the battery off the CE-loss fusion path.
-        if bundle.apply_monitor is not None:
+        if bundle.loss_monitor is not None:
+            # Loss-bearing path: lets the model fuse head+CE (the vocab-
+            # chunked fused head never materialises logits at all).
+            loss, feats, mean_logits = bundle.loss_monitor(
+                params, node_batch
+            )
+        elif bundle.apply_monitor is not None:
             logits, feats, mean_logits = bundle.apply_monitor(
                 params, node_batch["input"]
             )
+            loss = L.cross_entropy_loss(logits, node_batch["target"])
         else:
             logits = bundle.apply(params, node_batch["input"])
             feats = logits
             lead = tuple(range(logits.ndim - 1))
             mean_logits = jnp.mean(logits.astype(jnp.float32), axis=lead)
-        loss = L.cross_entropy_loss(logits, node_batch["target"])
+            loss = L.cross_entropy_loss(logits, node_batch["target"])
         out_stats = _output_stat_vector(feats, max_sort)
         aux = (out_stats, jnp.mean(feats), jnp.std(feats), mean_logits)
         return loss, aux

@@ -136,6 +136,18 @@ class DistributedTrainer:
             "gpt"
         ):
             model_overrides.setdefault("attn_impl", "ring")
+        if config.lm_head_chunk and config.model_name.startswith("gpt"):
+            if config.parallelism == "model":
+                # The pipeline step computes its own per-stage loss on full
+                # logits; the fused head does not reach it.
+                logger.warning(
+                    "lm_head_chunk is not supported under pipeline "
+                    "parallelism; ignoring"
+                )
+            else:
+                model_overrides.setdefault(
+                    "lm_head_chunk", config.lm_head_chunk
+                )
         self.model = ModelFactory().create_model(
             config.model_name, **model_overrides
         )

@@ -44,6 +44,14 @@ class ModelBundle:
     apply_monitor: Optional[Callable[
         [Params, jax.Array], "tuple[jax.Array, jax.Array, jax.Array]"
     ]] = None
+    # Loss-bearing monitor variant: (params, batch) -> (loss, features,
+    # mean_logits).  When present the engine's hot path uses it instead of
+    # apply_monitor + cross_entropy — required for the vocab-chunked fused
+    # head (ops/fused_ce.py), where the logits never exist to hand back.
+    loss_monitor: Optional[Callable[
+        [Params, Dict[str, jax.Array]],
+        "tuple[jax.Array, jax.Array, jax.Array]"
+    ]] = None
 
     def example_batch(self, batch_size: int, rng: Optional[jax.Array] = None
                       ) -> Dict[str, jax.Array]:
@@ -95,6 +103,9 @@ class ModelFactory:
                 apply_monitor=lambda p, x, c=cfg: moe.forward_with_monitor(
                     p, x, c
                 ),
+                loss_monitor=lambda p, b, c=cfg: moe.loss_with_monitor(
+                    p, b, c
+                ),
             )
         if name.startswith("gpt"):
             seq_len = overrides.pop("seq_len", 128)
@@ -110,6 +121,9 @@ class ModelFactory:
                 input_spec={"seq_len": seq_len, "vocab_size": cfg.vocab_size},
                 apply_monitor=lambda p, x, c=cfg: gpt2.forward_with_monitor(
                     p, x, c
+                ),
+                loss_monitor=lambda p, b, c=cfg: gpt2.loss_with_monitor(
+                    p, b, c
                 ),
             )
         if name.startswith("resnet"):

@@ -50,6 +50,9 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "full"   # full | flash | ring | ulysses
     remat: bool = False
+    # Vocab-chunked fused lm-head+CE (ops/fused_ce.py): the loss never
+    # materialises the [B, T, V] logits.  0 disables (full logits path).
+    lm_head_chunk: int = 0
 
     @staticmethod
     def from_name(name: str, **overrides: Any) -> "GPT2Config":
@@ -256,8 +259,53 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: GPT2Config
             ) -> jax.Array:
     """Next-token cross entropy on {'input','target'} batches (targets are
     the shifted stream, produced by data/loader.py)."""
+    if cfg.lm_head_chunk:
+        loss, _, _ = loss_with_monitor(params, batch, cfg)
+        return loss
     logits = forward(params, batch["input"], cfg)
     return L.cross_entropy_loss(logits, batch["target"])
+
+
+def head_loss_and_signature(params: Params, x: jax.Array,
+                            targets: jax.Array, cfg: GPT2Config
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Final ln_f + tied head on [B, T, D] hiddens -> (mean CE, mean_logits).
+
+    One implementation shared by the GPT-2 and MoE loss paths.  When
+    ``cfg.lm_head_chunk`` is set the cross-entropy goes through the
+    vocab-chunked fused head (ops/fused_ce.py), so the [B, T, V] logits
+    are never materialised.  ``mean_logits`` (the Byzantine/backdoor
+    consensus signature) stays exact and cheap either way: the tied
+    projection is linear, so it is computed from the position-mean of the
+    normed activations ([D] @ [D, V])."""
+    normed = L.layernorm(params["ln_f"], x)
+    mean_normed = jnp.mean(normed, axis=tuple(range(normed.ndim - 1)))
+    mean_logits = project_logits(params, mean_normed, cfg)
+    if cfg.lm_head_chunk:
+        from trustworthy_dl_tpu.ops.fused_ce import fused_lm_loss
+
+        loss = fused_lm_loss(normed, params["wte"], targets,
+                             cfg.lm_head_chunk, cfg.dtype)
+    else:
+        logits = project_logits(params, normed, cfg)
+        loss = L.cross_entropy_loss(logits, targets)
+    return loss, mean_logits
+
+
+def loss_with_monitor(params: Params, batch: Dict[str, jax.Array],
+                      cfg: GPT2Config
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """{'input','target'} -> (loss, features [B,T,D], mean_logits [V]).
+
+    The loss-bearing twin of ``forward_with_monitor`` for the engine's hot
+    path: same detector features (pre-ln_f hidden states) and consensus
+    signature, with the head fused via ``head_loss_and_signature``."""
+    x = embed(params, batch["input"], cfg)
+    x = apply_blocks(params["blocks"], x, cfg)
+    loss, mean_logits = head_loss_and_signature(
+        params, x, batch["target"], cfg
+    )
+    return loss, x, mean_logits
 
 
 def num_params(params: Params) -> int:

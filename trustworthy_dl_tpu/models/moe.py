@@ -265,11 +265,24 @@ def forward_with_monitor(params: Params, tokens: jax.Array, cfg: MoEConfig
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: MoEConfig
             ) -> jax.Array:
+    loss, _, _ = loss_with_monitor(params, batch, cfg)
+    return loss
+
+
+def loss_with_monitor(params: Params, batch: Dict[str, jax.Array],
+                      cfg: MoEConfig
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Same contract as gpt2.loss_with_monitor, with the Switch
+    load-balance aux loss folded in (the apply_monitor + external-CE path
+    cannot carry it).  The head — incl. the ``cfg.lm_head_chunk`` fused
+    vocab-chunked path — is gpt2.head_loss_and_signature, shared so the
+    two families cannot drift."""
     x = gpt2.embed(params, batch["input"], cfg)
     x, aux = apply_blocks(params["blocks"], x, cfg)
-    logits = gpt2.unembed(params, x, cfg)
-    lm = L.cross_entropy_loss(logits, batch["target"])
-    return lm + cfg.aux_weight * aux
+    lm, mean_logits = gpt2.head_loss_and_signature(
+        params, x, batch["target"], cfg
+    )
+    return lm + cfg.aux_weight * aux, x, mean_logits
 
 
 def moe_ep_specs(params: Params):
