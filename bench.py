@@ -16,8 +16,14 @@ Prints ONE JSON line on stdout:
 Diagnostics go to stderr.
 
 Env overrides: TDDL_BENCH_MODEL (gpt2), TDDL_BENCH_NODES (4),
-TDDL_BENCH_BATCH (per-node, 2), TDDL_BENCH_SEQ (512),
-TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3).
+TDDL_BENCH_BATCH (per-node, 16), TDDL_BENCH_SEQ (512),
+TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3), TDDL_BENCH_REMAT (1),
+TDDL_BENCH_CHUNK (0 = materialised-logits CE; >0 = fused vocab-chunked
+head), TDDL_BENCH_ATTN (model default).
+
+Default config is the measured single-v5e sweet spot: per-node batch 16
+(64 x 512 tokens/step) with block rematerialisation — larger batches fit
+only via TDDL_BENCH_CHUNK and are compute-bound slightly below it.
 """
 
 from __future__ import annotations
@@ -60,7 +66,7 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
     attn = os.environ.get("TDDL_BENCH_ATTN")
     if attn:
         overrides["attn_impl"] = attn
-    if os.environ.get("TDDL_BENCH_REMAT") == "1":
+    if os.environ.get("TDDL_BENCH_REMAT", "1") == "1":
         overrides["remat"] = True
     trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
@@ -135,7 +141,7 @@ def bench_longctx() -> None:
 def main() -> None:
     model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
     num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
-    per_node_batch = int(os.environ.get("TDDL_BENCH_BATCH", "2"))
+    per_node_batch = int(os.environ.get("TDDL_BENCH_BATCH", "16"))
     seq_len = int(os.environ.get("TDDL_BENCH_SEQ", "512"))
     steps = int(os.environ.get("TDDL_BENCH_STEPS", "20"))
     warmup = int(os.environ.get("TDDL_BENCH_WARMUP", "3"))
@@ -157,6 +163,17 @@ def main() -> None:
                         steps, warmup)
     log(f"detection ON:  {sps_on:.3f} steps/s "
         f"({sps_on * tokens_per_step / n_chips:,.0f} tok/s/chip)")
+    if not 0.3 <= sps_on / sps_off <= 1.2:
+        # Implausible ratio — seen once on the remote-TPU tunnel where a
+        # timed loop returned ~1000x too fast (execution caching artifact).
+        # Detection adds bounded work, so ON/OFF far outside [0.3, 1.2]
+        # means a bogus measurement: redo both once and trust the rerun.
+        log(f"implausible ON/OFF ratio {sps_on / sps_off:.3f}; remeasuring")
+        sps_off = bench_mode(False, model, num_nodes, per_node_batch,
+                             seq_len, steps, warmup)
+        sps_on = bench_mode(True, model, num_nodes, per_node_batch,
+                            seq_len, steps, warmup)
+        log(f"remeasured OFF {sps_off:.3f} / ON {sps_on:.3f} steps/s")
 
     tps_on = sps_on * tokens_per_step / n_chips
     ratio = sps_on / sps_off

@@ -121,3 +121,44 @@ def test_engine_trains_with_fused_head(tmp_path):
         losses.append(float(metrics.loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_apply_monitor_only_bundle_path():
+    """A custom ModelBundle may define apply_monitor without loss_monitor
+    (the documented extension point); the engine must drive that branch —
+    external CE over the returned logits — and match the loss_monitor
+    path's numbers on the same model."""
+    import dataclasses
+
+    from trustworthy_dl_tpu.attacks import null_plan
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine.optimizer import build_optimizer
+    from trustworthy_dl_tpu.engine.state import init_train_state
+    from trustworthy_dl_tpu.engine.step import build_train_step
+
+    config = TrainingConfig(model_name="gpt2", batch_size=8, num_nodes=4,
+                            learning_rate=1e-3)
+    bundle_full = create_model("gpt2", seq_len=TINY["seq_len"],
+                               **{k: v for k, v in TINY.items()
+                                  if k != "seq_len"})
+    bundle_am = dataclasses.replace(bundle_full, loss_monitor=None)
+    assert bundle_am.apply_monitor is not None
+
+    opt = build_optimizer(config)
+    plan = null_plan(4)
+    batch = bundle_full.example_batch(8)
+    node_batch = {k: v.reshape(4, 2, *v.shape[1:]) for k, v in batch.items()}
+
+    params = bundle_full.init(jax.random.PRNGKey(0))
+    outs = []
+    for bundle in (bundle_full, bundle_am):
+        step = jax.jit(build_train_step(bundle, config, opt))
+        state = init_train_state(jax.random.PRNGKey(1), params,
+                                 opt.init(params), num_nodes=4)
+        state, metrics = step(state, node_batch, plan)
+        outs.append(metrics)
+    np.testing.assert_allclose(np.asarray(outs[0].per_node_loss),
+                               np.asarray(outs[1].per_node_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[0].out_stats),
+                               np.asarray(outs[1].out_stats), rtol=1e-5,
+                               atol=1e-6)

@@ -38,6 +38,7 @@ _EXPORTS = {
     "NodeMonitor": "trustworthy_dl_tpu.utils.monitor",
     "AdversarialAttacker": "trustworthy_dl_tpu.attacks.adversarial",
     "ExperimentRunner": "trustworthy_dl_tpu.experiments.runner",
+    "generate": "trustworthy_dl_tpu.models.generate",
 }
 
 __all__ = sorted(_EXPORTS)
