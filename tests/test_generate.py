@@ -80,3 +80,22 @@ def test_length_validation(params):
         generate(params, CFG, prompt, max_new_tokens=20)  # 60 > 48
     with pytest.raises(ValueError):
         generate(params, CFG, prompt, max_new_tokens=0)
+
+
+def test_top_k_validation(params):
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError):
+        generate(params, CFG, prompt, 2, temperature=1.0, top_k=500)
+
+
+def test_temperature_sweep_no_recompile(params):
+    """temperature is traced: a sweep reuses one compiled program."""
+    from trustworthy_dl_tpu.models.generate import _generate_jit
+
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (1, 4), 0,
+                                CFG.vocab_size)
+    generate(params, CFG, prompt, 3, temperature=0.7, top_k=5)
+    misses0 = _generate_jit._cache_size()
+    generate(params, CFG, prompt, 3, temperature=0.9, top_k=5)
+    generate(params, CFG, prompt, 3, temperature=1.3, top_k=5)
+    assert _generate_jit._cache_size() == misses0

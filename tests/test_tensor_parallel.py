@@ -158,3 +158,29 @@ def test_tp_composes_with_trusted_step(tmp_path, tp_mesh):
     assert losses[-1] < losses[0]
     assert len(trainer.attack_history) == 0
     assert all(trainer.trust_manager.get_trust_score(i) > 0.6 for i in range(2))
+
+
+def test_hybrid_mesh_trusted_step_with_tp(eight_devices, tmp_path):
+    """parallelism='hybrid' with {'data':2,'model':4}: the trainer must
+    apply the TP layout (params actually sharded on 'model') AND run the
+    trusted step with 2 trust nodes — the explicit-mesh spelling of what
+    'tensor' mode builds implicitly."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=4,
+        num_nodes=2, learning_rate=1e-3, checkpoint_interval=10 ** 9,
+        parallelism="hybrid", mesh_shape={DATA_AXIS: 2, MODEL_AXIS: 4},
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    trainer = DistributedTrainer(
+        config, model_overrides=dict(TINY, seq_len=16)
+    )
+    trainer.initialize()
+    qkv = trainer.state.params["blocks"]["attn"]["qkv"]["w"]
+    spec = tuple(qkv.sharding.spec)
+    assert MODEL_AXIS in spec, spec
+
+    dl = get_dataloader("openwebtext", batch_size=4, seq_len=16,
+                        vocab_size=128, num_examples=16)
+    loss = trainer.train_epoch(dl, 0)
+    assert np.isfinite(loss)
+    assert trainer.state.trust.scores.shape == (2,)

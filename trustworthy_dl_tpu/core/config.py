@@ -107,6 +107,12 @@ class TrainingConfig:
     # HBM tensor of the loss step and unlocks larger per-chip batches.
     # 0 disables; typical value 8192 (multiple of 128 for MXU tiling).
     lm_head_chunk: int = 0
+    # ZeRO-1-style optimizer-state sharding over the data axis (data
+    # parallelism only).  Pure GSPMD annotation: the Adam moments shard
+    # across the data devices, XLA partitions the update computation and
+    # gathers the params — identical numerics, ~(1 - 1/n_data) of the
+    # moment memory reclaimed per chip.
+    shard_opt_state: bool = False
     checkpoint_dir: str = "checkpoints"
     # Migration-time model rate for reassignment estimates.  The reference
     # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer

@@ -158,9 +158,19 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     }
     migrated_shared = jax.tree_util.tree_map(
         lambda leaf: jax.device_put(leaf, replicated),
-        {"params": compact.params, "opt_state": compact.opt_state,
+        {"params": compact.params,
          "step": compact.step, "epoch": compact.epoch, "rng": compact.rng},
     )
+    if config.shard_opt_state and data_size > 1:
+        from trustworthy_dl_tpu.engine.state import zero1_place_opt_state
+
+        migrated_shared["opt_state"] = zero1_place_opt_state(
+            compact.opt_state, new_mesh
+        )
+    else:
+        migrated_shared["opt_state"] = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, replicated), compact.opt_state
+        )
     new_state = compact._replace(**migrated_nodes, **migrated_shared)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0

@@ -39,7 +39,8 @@ from trustworthy_dl_tpu.detect.stats import (
 from trustworthy_dl_tpu.detect.verifier import GradientVerifier
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
-from trustworthy_dl_tpu.engine.state import TrainState, init_train_state
+from trustworthy_dl_tpu.engine.state import TrainState, init_train_state, \
+    zero1_place_opt_state
 from trustworthy_dl_tpu.engine.step import StepMetrics, build_eval_step, \
     build_train_step
 from trustworthy_dl_tpu.models.factory import ModelFactory
@@ -228,11 +229,12 @@ class DistributedTrainer:
             params["blocks"] = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, stage_sharding), params["blocks"]
             )
-        if self.config.parallelism == "tensor":
+        if self.config.parallelism in ("tensor", "hybrid"):
             from trustworthy_dl_tpu.parallel.tensor_parallel import (
                 apply_tp_sharding,
             )
 
+            # No-op when the mesh has no 'model' axis (hybrid without TP).
             params = apply_tp_sharding(params, self.mesh)
         opt_state = self.optimizer.init(params)
         canary = None
@@ -304,10 +306,15 @@ class DistributedTrainer:
             per_node["canary"] = state.canary
         placed = {k: jax.tree_util.tree_map(place_row, v)
                   for k, v in per_node.items()}
+        if self.config.shard_opt_state and \
+                self.config.parallelism == "data" and \
+                sizes.get(DATA_AXIS, 1) > 1:
+            opt_state = zero1_place_opt_state(state.opt_state, mesh)
+        else:
+            opt_state = jax.tree_util.tree_map(keep_or_repl, state.opt_state)
         shared = {
             "params": jax.tree_util.tree_map(keep_or_repl, state.params),
-            "opt_state": jax.tree_util.tree_map(keep_or_repl,
-                                                state.opt_state),
+            "opt_state": opt_state,
         }
         scalars = jax.tree_util.tree_map(
             lambda l: jax.device_put(l, repl),

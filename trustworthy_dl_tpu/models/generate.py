@@ -122,10 +122,12 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
     return logits, KVCache(k=new_k, v=new_v, length=start + t)
 
 
-def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
-            top_k: int) -> jax.Array:
-    """[B, V] -> [B] next tokens.  temperature<=0 → greedy."""
-    if temperature <= 0.0:
+def _sample(logits: jax.Array, rng: jax.Array, temperature: jax.Array,
+            greedy: bool, top_k: int) -> jax.Array:
+    """[B, V] -> [B] next tokens.  ``greedy`` and ``top_k`` are static
+    (top_k changes lax.top_k output shapes); ``temperature`` is traced so
+    sampling sweeps reuse one compiled program."""
+    if greedy:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k > 0:
@@ -134,21 +136,22 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 6))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7))
 def _generate_jit(params: Params, prompt: jax.Array, rng: jax.Array,
-                  cfg: gpt2.GPT2Config, max_new_tokens: int,
-                  temperature: float, top_k: int) -> jax.Array:
+                  temperature: jax.Array, cfg: gpt2.GPT2Config,
+                  max_new_tokens: int, greedy: bool, top_k: int
+                  ) -> jax.Array:
     b, t_prompt = prompt.shape
     cache = init_cache(cfg, b, t_prompt + max_new_tokens)
     logits, cache = _apply_with_cache(params, prompt, cache, cfg)
-    first = _sample(logits, rng, temperature, top_k)
+    first = _sample(logits, rng, temperature, greedy, top_k)
 
     def body(carry, step_rng):
         tok, cache = carry
         logits, cache = _apply_with_cache(
             params, tok[:, None], cache, cfg
         )
-        nxt = _sample(logits, step_rng, temperature, top_k)
+        nxt = _sample(logits, step_rng, temperature, greedy, top_k)
         return (nxt, cache), nxt
 
     if max_new_tokens == 1:
@@ -169,7 +172,19 @@ def generate(params: Params, cfg: gpt2.GPT2Config, prompt: jax.Array,
 
     Returns [B, T + max_new_tokens].  ``temperature=0`` decodes greedily;
     ``top_k>0`` restricts sampling to the k most likely tokens.  The whole
-    call is one jitted XLA program (static-shape KV cache)."""
+    call is one jitted XLA program (static-shape KV cache), compiled once
+    per (shape, greedy, top_k) — temperature is traced, so temperature
+    sweeps do not recompile.
+
+    ``rng=None`` defaults to ``PRNGKey(0)``: sampling is DETERMINISTIC
+    across identical calls by design (reproducibility-first, like every
+    other seed in this framework) — pass a fresh key per call for variety.
+
+    Decode always runs the fused XLA attention over the cache; numerics
+    are pinned token-for-token against the training forward with the
+    default ``attn_impl='full'`` (tests/test_generate.py).  A model
+    *trained* with the Pallas flash kernel agrees to kernel-vs-XLA
+    epsilon, where near-tie logits can flip under greedy decode."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     total = prompt.shape[-1] + max_new_tokens
@@ -177,6 +192,12 @@ def generate(params: Params, cfg: gpt2.GPT2Config, prompt: jax.Array,
         raise ValueError(
             f"prompt+new = {total} exceeds n_positions={cfg.n_positions}"
         )
+    if not 0 <= top_k <= cfg.vocab_size:
+        raise ValueError(
+            f"top_k={top_k} out of range [0, vocab_size={cfg.vocab_size}]"
+        )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    return _generate_jit(params, prompt, rng, cfg, int(max_new_tokens),
-                         float(temperature), int(top_k))
+    return _generate_jit(params, prompt, rng,
+                         jnp.asarray(max(temperature, 1e-6), jnp.float32),
+                         cfg, int(max_new_tokens),
+                         float(temperature) <= 0.0, int(top_k))
