@@ -40,9 +40,9 @@ def log(msg: str) -> None:
 
 def bench_mode(detection: bool, model: str, num_nodes: int,
                per_node_batch: int, seq_len: int, steps: int,
-               warmup: int) -> float:
-    """Steps/sec of the jitted step, driven device-side (no host sync in
-    the timed loop beyond dispatch)."""
+               warmup: int) -> "tuple[float, int]":
+    """(steps/sec, param count) of the jitted step, driven device-side
+    (no host sync in the timed loop beyond dispatch)."""
     import jax
     import numpy as np
 
@@ -70,6 +70,7 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
         overrides["remat"] = True
     trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
+    n_params = trainer.model.num_params(trainer.state.params)
 
     rng = np.random.default_rng(0)
     vocab = trainer.model.config.vocab_size
@@ -92,7 +93,7 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
     jax.block_until_ready(metrics.loss)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(float(metrics.loss)), "bench step produced NaN loss"
-    return steps / elapsed
+    return steps / elapsed, n_params
 
 
 def bench_longctx() -> None:
@@ -155,12 +156,12 @@ def main() -> None:
 
     tokens_per_step = num_nodes * per_node_batch * seq_len
 
-    sps_off = bench_mode(False, model, num_nodes, per_node_batch, seq_len,
-                         steps, warmup)
+    sps_off, n_params = bench_mode(False, model, num_nodes, per_node_batch,
+                                   seq_len, steps, warmup)
     log(f"detection OFF: {sps_off:.3f} steps/s "
         f"({sps_off * tokens_per_step / n_chips:,.0f} tok/s/chip)")
-    sps_on = bench_mode(True, model, num_nodes, per_node_batch, seq_len,
-                        steps, warmup)
+    sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch, seq_len,
+                           steps, warmup)
     log(f"detection ON:  {sps_on:.3f} steps/s "
         f"({sps_on * tokens_per_step / n_chips:,.0f} tok/s/chip)")
     if not 0.3 <= sps_on / sps_off <= 1.2:
@@ -169,24 +170,30 @@ def main() -> None:
         # Detection adds bounded work, so ON/OFF far outside [0.3, 1.2]
         # means a bogus measurement: redo both once and trust the rerun.
         log(f"implausible ON/OFF ratio {sps_on / sps_off:.3f}; remeasuring")
-        sps_off = bench_mode(False, model, num_nodes, per_node_batch,
-                             seq_len, steps, warmup)
-        sps_on = bench_mode(True, model, num_nodes, per_node_batch,
-                            seq_len, steps, warmup)
+        sps_off, _ = bench_mode(False, model, num_nodes, per_node_batch,
+                                seq_len, steps, warmup)
+        sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch,
+                               seq_len, steps, warmup)
         log(f"remeasured OFF {sps_off:.3f} / ON {sps_on:.3f} steps/s")
 
     tps_on = sps_on * tokens_per_step / n_chips
     ratio = sps_on / sps_off
     overhead_pct = (1.0 - ratio) * 100.0
     log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
+    # Standard transformer-training estimate: ~6 FLOPs per param per token
+    # (fwd 2 + bwd 4); remat adds recompute not counted here, so this is a
+    # lower bound on hardware FLOPs actually executed.
+    tflops = 6.0 * n_params * tps_on / 1e12
+    log(f"achieved model FLOPs: {tflops:.1f} TFLOP/s/chip "
+        f"({n_params / 1e6:.0f}M params)")
 
     if os.environ.get("TDDL_BENCH_FUSED") == "1":
         # Native-tier A/B: detection ON with the Pallas fused moment battery
         # (ops/fused_stats.py) instead of XLA's fused reductions.
         os.environ["TDDL_FUSED_STATS"] = "1"
         try:
-            sps_fused = bench_mode(True, model, num_nodes, per_node_batch,
-                                   seq_len, steps, warmup)
+            sps_fused, _ = bench_mode(True, model, num_nodes, per_node_batch,
+                                      seq_len, steps, warmup)
         finally:
             del os.environ["TDDL_FUSED_STATS"]
         log(f"detection ON (pallas fused stats): {sps_fused:.3f} steps/s "
@@ -204,6 +211,7 @@ def main() -> None:
         "platform": platform,
         "num_chips": n_chips,
         "tokens_per_step": tokens_per_step,
+        "model_tflops_per_chip": round(tflops, 2),
     }))
 
 

@@ -162,3 +162,28 @@ def test_apply_monitor_only_bundle_path():
     np.testing.assert_allclose(np.asarray(outs[0].out_stats),
                                np.asarray(outs[1].out_stats), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_pipeline_with_fused_head(tmp_path):
+    """Pipeline parallelism honours lm_head_chunk: loss equals the
+    materialised-head pipeline loss, training stays finite."""
+    from trustworthy_dl_tpu.attacks import null_plan
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    losses = {}
+    for chunk in (0, 32):
+        config = TrainingConfig(
+            model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+            num_nodes=2, learning_rate=1e-3, checkpoint_interval=10 ** 9,
+            parallelism="model", num_microbatches=2, lm_head_chunk=chunk,
+            checkpoint_dir=str(tmp_path / f"ck{chunk}"),
+        )
+        trainer = DistributedTrainer(config, model_overrides=TINY)
+        trainer.initialize()
+        batch = trainer._node_batch(trainer.model.example_batch(8))
+        state, metrics = trainer._train_step(trainer.state, batch,
+                                             null_plan(2))
+        losses[chunk] = float(metrics.loss)
+        assert np.isfinite(losses[chunk])
+    np.testing.assert_allclose(losses[32], losses[0], rtol=1e-5)

@@ -99,3 +99,34 @@ def test_temperature_sweep_no_recompile(params):
     generate(params, CFG, prompt, 3, temperature=0.9, top_k=5)
     generate(params, CFG, prompt, 3, temperature=1.3, top_k=5)
     assert _generate_jit._cache_size() == misses0
+
+
+def test_top_p_tiny_equals_greedy(params):
+    """A vanishing nucleus keeps only the argmax token."""
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 5), 0,
+                                CFG.vocab_size)
+    nucleus = generate(params, CFG, prompt, 5, temperature=1.0,
+                       top_p=1e-6, rng=jax.random.PRNGKey(3))
+    greedy = generate(params, CFG, prompt, 5, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+
+def test_top_p_one_skips_filter_and_half_restricts(params):
+    """top_p=1.0 compiles the nucleus filter out (identical program to the
+    plain sampler), while top_p<1 actually changes what gets sampled."""
+    from trustworthy_dl_tpu.models.generate import _generate_jit
+
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (1, 5), 0,
+                                CFG.vocab_size)
+    a = generate(params, CFG, prompt, 10, temperature=0.9,
+                 rng=jax.random.PRNGKey(5))
+    before = _generate_jit._cache_size()
+    b = generate(params, CFG, prompt, 10, temperature=0.9, top_p=1.0,
+                 rng=jax.random.PRNGKey(5))
+    assert _generate_jit._cache_size() == before  # same compiled program
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = generate(params, CFG, prompt, 10, temperature=0.9, top_p=0.5,
+                 rng=jax.random.PRNGKey(5))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    with pytest.raises(ValueError):
+        generate(params, CFG, prompt, 2, temperature=1.0, top_p=0.0)

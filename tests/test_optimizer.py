@@ -67,3 +67,27 @@ def test_scheduled_optimizer_updates_shrink():
     # decayed lr -> strictly smaller update magnitude by the horizon
     s2 = float(jnp.abs(u2["w"][0]))
     assert s2 < s0
+
+
+def test_validate_metrics_surface(tmp_path):
+    """validate_metrics returns loss + accuracy + perplexity for LMs and
+    validate() stays the reference's plain float."""
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        num_nodes=4, checkpoint_dir=str(tmp_path / "ck"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(
+        n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+        seq_len=16))
+    trainer.initialize()
+    dl = get_dataloader("openwebtext", split="validation", batch_size=8,
+                        seq_len=16, vocab_size=128, num_examples=16)
+    m = trainer.validate_metrics(dl)
+    assert set(m) == {"loss", "accuracy", "perplexity"}
+    assert np.isfinite(m["loss"]) and 0.0 <= m["accuracy"] <= 1.0
+    assert np.isclose(m["perplexity"], np.exp(m["loss"]), rtol=1e-5)
+    assert np.isclose(trainer.validate(dl), m["loss"], rtol=1e-6)

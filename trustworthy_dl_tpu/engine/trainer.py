@@ -138,17 +138,7 @@ class DistributedTrainer:
         ):
             model_overrides.setdefault("attn_impl", "ring")
         if config.lm_head_chunk and config.model_name.startswith("gpt"):
-            if config.parallelism == "model":
-                # The pipeline step computes its own per-stage loss on full
-                # logits; the fused head does not reach it.
-                logger.warning(
-                    "lm_head_chunk is not supported under pipeline "
-                    "parallelism; ignoring"
-                )
-            else:
-                model_overrides.setdefault(
-                    "lm_head_chunk", config.lm_head_chunk
-                )
+            model_overrides.setdefault("lm_head_chunk", config.lm_head_chunk)
         self.model = ModelFactory().create_model(
             config.model_name, **model_overrides
         )
@@ -644,7 +634,15 @@ class DistributedTrainer:
         return {"epochs": history, "stats": self.get_training_stats()}
 
     def validate(self, val_dataloader) -> float:
-        total, batches = 0.0, 0
+        """Mean validation loss (reference signature,
+        distributed_trainer.py:494-508)."""
+        return self.validate_metrics(val_dataloader)["loss"]
+
+    def validate_metrics(self, val_dataloader) -> Dict[str, float]:
+        """Full validation metrics: loss, accuracy, and (for LMs)
+        perplexity — the eval step already computes them; the reference
+        only surfaced loss."""
+        total, acc, batches = 0.0, 0.0, 0
         for batch in val_dataloader:
             if self.config.parallelism == "model":
                 batch = self._node_batch(batch)  # trims to microbatch multiple
@@ -652,8 +650,13 @@ class DistributedTrainer:
                 batch = {k: jnp.asarray(v) for k, v in batch.items()}
             out = self._eval_step(self.state.params, batch)
             total += float(out["loss"])
+            acc += float(out["accuracy"])
             batches += 1
-        return total / max(batches, 1)
+        n = max(batches, 1)
+        metrics = {"loss": total / n, "accuracy": acc / n}
+        if self.model.kind == "lm":
+            metrics["perplexity"] = float(np.exp(min(metrics["loss"], 30.0)))
+        return metrics
 
     def sync_host_state(self) -> None:
         """Epoch-cadence absorption of device state into the host reporting

@@ -123,35 +123,56 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: jax.Array,
-            greedy: bool, top_k: int) -> jax.Array:
-    """[B, V] -> [B] next tokens.  ``greedy`` and ``top_k`` are static
-    (top_k changes lax.top_k output shapes); ``temperature`` is traced so
-    sampling sweeps reuse one compiled program."""
+            greedy: bool, top_k: int, top_p: jax.Array,
+            use_top_p: bool) -> jax.Array:
+    """[B, V] -> [B] next tokens.  ``greedy``, ``top_k`` and ``use_top_p``
+    are static (top_k changes lax.top_k output shapes; the nucleus filter
+    costs a full-vocab sort per token, so it is compiled out entirely when
+    not requested); ``temperature`` and ``top_p`` are traced so sampling
+    sweeps reuse one compiled program."""
     if greedy:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]   # [B, 1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if use_top_p:
+        # Nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass exceeds top_p.  One sort, no scatter — the keep-mask
+        # is mapped back by threshold comparison.
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        # Threshold = probability of the last kept token: smallest sorted
+        # index where cumulative mass reaches top_p.
+        keep_sorted = cum - sorted_probs < top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(probs >= threshold, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6, 7))
+@partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
 def _generate_jit(params: Params, prompt: jax.Array, rng: jax.Array,
-                  temperature: jax.Array, cfg: gpt2.GPT2Config,
-                  max_new_tokens: int, greedy: bool, top_k: int
-                  ) -> jax.Array:
+                  temperature: jax.Array, top_p: jax.Array,
+                  cfg: gpt2.GPT2Config,
+                  max_new_tokens: int, greedy: bool, top_k: int,
+                  use_top_p: bool) -> jax.Array:
     b, t_prompt = prompt.shape
     cache = init_cache(cfg, b, t_prompt + max_new_tokens)
     logits, cache = _apply_with_cache(params, prompt, cache, cfg)
-    first = _sample(logits, rng, temperature, greedy, top_k)
+    first = _sample(logits, rng, temperature, greedy, top_k, top_p,
+                    use_top_p)
 
     def body(carry, step_rng):
         tok, cache = carry
         logits, cache = _apply_with_cache(
             params, tok[:, None], cache, cfg
         )
-        nxt = _sample(logits, step_rng, temperature, greedy, top_k)
+        nxt = _sample(logits, step_rng, temperature, greedy, top_k, top_p,
+                      use_top_p)
         return (nxt, cache), nxt
 
     if max_new_tokens == 1:
@@ -167,14 +188,17 @@ def _generate_jit(params: Params, prompt: jax.Array, rng: jax.Array,
 
 def generate(params: Params, cfg: gpt2.GPT2Config, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
+             top_p: float = 1.0, rng: Optional[jax.Array] = None
+             ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` [B, T].
 
     Returns [B, T + max_new_tokens].  ``temperature=0`` decodes greedily;
-    ``top_k>0`` restricts sampling to the k most likely tokens.  The whole
-    call is one jitted XLA program (static-shape KV cache), compiled once
-    per (shape, greedy, top_k) — temperature is traced, so temperature
-    sweeps do not recompile.
+    ``top_k>0`` restricts sampling to the k most likely tokens;
+    ``top_p<1`` restricts to the nucleus holding that probability mass
+    (filters compose: top-k first, then top-p).  The whole call is one
+    jitted XLA program (static-shape KV cache), compiled once per
+    (shape, greedy, top_k) — temperature and top_p are traced, so
+    sampling sweeps do not recompile.
 
     ``rng=None`` defaults to ``PRNGKey(0)``: sampling is DETERMINISTIC
     across identical calls by design (reproducibility-first, like every
@@ -196,8 +220,12 @@ def generate(params: Params, cfg: gpt2.GPT2Config, prompt: jax.Array,
         raise ValueError(
             f"top_k={top_k} out of range [0, vocab_size={cfg.vocab_size}]"
         )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} out of range (0, 1]")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     return _generate_jit(params, prompt, rng,
                          jnp.asarray(max(temperature, 1e-6), jnp.float32),
+                         jnp.asarray(top_p, jnp.float32),
                          cfg, int(max_new_tokens),
-                         float(temperature) <= 0.0, int(top_k))
+                         float(temperature) <= 0.0, int(top_k),
+                         float(top_p) < 1.0)

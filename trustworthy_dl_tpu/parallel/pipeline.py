@@ -296,19 +296,19 @@ def build_pipeline_train_step(
     pipe_apply = build_pipeline_apply(cfg, mesh, S, M, max_sort)
     canary_const = make_canary(cfg, config.canary_tokens)
 
-    def forward(params, tokens):
-        x = gpt2.embed(params, tokens, cfg)
+    def loss_fn(params, batch):
+        x = gpt2.embed(params, batch["input"], cfg)
         b, t, d = x.shape
         mb = b // M
         x_mb = x.reshape(M, mb, t, d)
         y_mb, stage_stats, act_mean, act_std = pipe_apply(params["blocks"], x_mb)
         y = y_mb.reshape(b, t, d)
-        logits = gpt2.unembed(params, y, cfg)
-        return logits, (stage_stats, act_mean, act_std)
-
-    def loss_fn(params, batch):
-        logits, aux = forward(params, batch["input"])
-        return L.cross_entropy_loss(logits, batch["target"]), aux
+        # Head via the shared helper: honours cfg.lm_head_chunk (fused
+        # vocab-chunked CE — the logits never materialise), identical to
+        # the data-parallel loss path so the modes cannot drift.
+        loss, _ = gpt2.head_loss_and_signature(params, y, batch["target"],
+                                               cfg)
+        return loss, (stage_stats, act_mean, act_std)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
