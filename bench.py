@@ -40,9 +40,32 @@ def log(msg: str) -> None:
 
 def bench_mode(detection: bool, model: str, num_nodes: int,
                per_node_batch: int, seq_len: int, steps: int,
-               warmup: int) -> "tuple[float, int]":
+               warmup: int, _attempt: int = 0) -> "tuple[float, int]":
     """(steps/sec, param count) of the jitted step, driven device-side
-    (no host sync in the timed loop beyond dispatch)."""
+    (no host sync in the timed loop beyond dispatch).
+
+    The remote-TPU compile tunnel fails transiently (HTTP 500 /
+    truncated-body from the compile helper); such infrastructure errors —
+    not OOMs or NaNs — are retried up to twice before giving up."""
+    try:
+        return _bench_mode(detection, model, num_nodes, per_node_batch,
+                           seq_len, steps, warmup)
+    except Exception as exc:
+        msg = str(exc)
+        transient = ("remote_compile" in msg or "response body" in msg
+                     or "tpu_compile_helper" in msg)
+        if transient and _attempt < 2:
+            log(f"transient compile-tunnel failure (attempt {_attempt + 1})"
+                f": {msg[:120]}; retrying")
+            time.sleep(10 * (_attempt + 1))
+            return bench_mode(detection, model, num_nodes, per_node_batch,
+                              seq_len, steps, warmup, _attempt + 1)
+        raise
+
+
+def _bench_mode(detection: bool, model: str, num_nodes: int,
+                per_node_batch: int, seq_len: int, steps: int,
+                warmup: int) -> "tuple[float, int]":
     import jax
     import numpy as np
 
@@ -62,24 +85,25 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
         parallelism="data",
         lm_head_chunk=int(os.environ.get("TDDL_BENCH_CHUNK", "0")),
     )
-    overrides: dict = {"seq_len": seq_len}
-    attn = os.environ.get("TDDL_BENCH_ATTN")
-    if attn:
-        overrides["attn_impl"] = attn
-    if os.environ.get("TDDL_BENCH_REMAT", "1") == "1":
-        overrides["remat"] = True
+    overrides: dict = {}
+    if model.startswith("gpt"):
+        overrides["seq_len"] = seq_len
+        attn = os.environ.get("TDDL_BENCH_ATTN")
+        if attn:
+            overrides["attn_impl"] = attn
+        if os.environ.get("TDDL_BENCH_REMAT", "1") == "1":
+            overrides["remat"] = True
     trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
     n_params = trainer.model.num_params(trainer.state.params)
 
-    rng = np.random.default_rng(0)
-    vocab = trainer.model.config.vocab_size
-    tokens = rng.integers(
-        0, vocab, (num_nodes * per_node_batch, seq_len + 1), dtype=np.int32
-    )
-    batch = trainer._node_batch(
-        {"input": tokens[:, :-1], "target": tokens[:, 1:]}
-    )
+    import jax.random as jrandom
+
+    batch = trainer._node_batch(jax.tree_util.tree_map(
+        np.asarray,
+        trainer.model.example_batch(num_nodes * per_node_batch,
+                                    jrandom.PRNGKey(0)),
+    ))
     plan = trainer.attack_plan
 
     state = trainer.state
@@ -151,19 +175,22 @@ def main() -> None:
 
     n_chips = max(jax.device_count(), 1)
     platform = jax.devices()[0].platform
+    is_lm = model.startswith("gpt")
     log(f"bench: {model} nodes={num_nodes} batch/node={per_node_batch} "
         f"seq={seq_len} steps={steps} on {n_chips} {platform} device(s)")
 
-    tokens_per_step = num_nodes * per_node_batch * seq_len
+    # Work per step: tokens for LMs, samples for vision models.
+    tokens_per_step = num_nodes * per_node_batch * (seq_len if is_lm else 1)
+    unit = "tokens/sec/chip" if is_lm else "samples/sec/chip"
 
     sps_off, n_params = bench_mode(False, model, num_nodes, per_node_batch,
                                    seq_len, steps, warmup)
     log(f"detection OFF: {sps_off:.3f} steps/s "
-        f"({sps_off * tokens_per_step / n_chips:,.0f} tok/s/chip)")
+        f"({sps_off * tokens_per_step / n_chips:,.0f} {unit})")
     sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch, seq_len,
                            steps, warmup)
     log(f"detection ON:  {sps_on:.3f} steps/s "
-        f"({sps_on * tokens_per_step / n_chips:,.0f} tok/s/chip)")
+        f"({sps_on * tokens_per_step / n_chips:,.0f} {unit})")
     if not 0.3 <= sps_on / sps_off <= 1.2:
         # Implausible ratio — seen once on the remote-TPU tunnel where a
         # timed loop returned ~1000x too fast (execution caching artifact).
@@ -180,12 +207,15 @@ def main() -> None:
     ratio = sps_on / sps_off
     overhead_pct = (1.0 - ratio) * 100.0
     log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
-    # Standard transformer-training estimate: ~6 FLOPs per param per token
-    # (fwd 2 + bwd 4); remat adds recompute not counted here, so this is a
-    # lower bound on hardware FLOPs actually executed.
-    tflops = 6.0 * n_params * tps_on / 1e12
-    log(f"achieved model FLOPs: {tflops:.1f} TFLOP/s/chip "
-        f"({n_params / 1e6:.0f}M params)")
+    tflops = None
+    if is_lm:
+        # Standard transformer-training estimate: ~6 FLOPs per param per
+        # token (fwd 2 + bwd 4); remat adds recompute not counted here, so
+        # this is a lower bound on hardware FLOPs actually executed.  (No
+        # comparable param-count formula for convs, so vision skips it.)
+        tflops = 6.0 * n_params * tps_on / 1e12
+        log(f"achieved model FLOPs: {tflops:.1f} TFLOP/s/chip "
+            f"({n_params / 1e6:.0f}M params)")
 
     if os.environ.get("TDDL_BENCH_FUSED") == "1":
         # Native-tier A/B: detection ON with the Pallas fused moment battery
@@ -203,15 +233,16 @@ def main() -> None:
         bench_longctx()
 
     print(json.dumps({
-        "metric": f"{model}_tokens_per_sec_per_chip_detection_on",
+        "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"
+                  "_detection_on",
         "value": round(tps_on, 1),
-        "unit": "tokens/sec/chip",
+        "unit": unit,
         "vs_baseline": round(ratio, 4),
         "detection_overhead_pct": round(overhead_pct, 2),
         "platform": platform,
         "num_chips": n_chips,
         "tokens_per_step": tokens_per_step,
-        "model_tflops_per_chip": round(tflops, 2),
+        "model_tflops_per_chip": round(tflops, 2) if tflops else None,
     }))
 
 

@@ -264,3 +264,30 @@ def test_epoch_intelligence_wired(clean_run):
     assert len(trainer.attack_detector.output_history[0]) == stats["global_step"]
     assert len(trainer.attack_detector.gradient_history[0]) == stats["global_step"]
     assert "ml_flags" in stats
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_checkpoint=True: save returns without blocking on disk, the
+    in-flight write joins on restore, and the payload round-trips — incl.
+    continued training (donated buffers) between save and restore."""
+    trainer = gpt_trainer(tmp_path, num_nodes=4, async_checkpoint=True)
+    trainer.initialize()
+    batch = trainer._node_batch(trainer.model.example_batch(8))
+    from trustworthy_dl_tpu.attacks import null_plan
+    plan = null_plan(4)
+    state = trainer.state
+    for _ in range(3):
+        state, _ = trainer._train_step(state, batch, plan)
+    trainer.state = state
+    trainer.global_step = 3
+    path = trainer.save_checkpoint()
+    saved_trust = np.asarray(state.trust.scores)
+    # keep training on donated buffers while the write is in flight
+    for _ in range(2):
+        state, _ = trainer._train_step(state, batch, plan)
+    trainer.state = state
+    restored = trainer.checkpointer.restore(trainer.state)
+    assert int(restored.step) == 3
+    np.testing.assert_array_equal(np.asarray(restored.trust.scores),
+                                  saved_trust)
+    trainer.cleanup()

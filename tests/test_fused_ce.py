@@ -187,3 +187,34 @@ def test_pipeline_with_fused_head(tmp_path):
         losses[chunk] = float(metrics.loss)
         assert np.isfinite(losses[chunk])
     np.testing.assert_allclose(losses[32], losses[0], rtol=1e-5)
+
+
+def test_fused_eval_matches_materialised_both_modes(tmp_path):
+    """validate_metrics with lm_head_chunk on == off, in data AND pipeline
+    modes (the fused eval keeps the training path's no-logits contract)."""
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    dl_kwargs = dict(split="validation", batch_size=8, seq_len=16,
+                     vocab_size=TINY["vocab_size"], num_examples=16)
+    for mode, extra in (("data", {}),
+                        ("model", {"num_microbatches": 2})):
+        got = {}
+        for chunk in (0, 32):
+            config = TrainingConfig(
+                model_name="gpt2", dataset_name="openwebtext",
+                batch_size=8, num_nodes=2, parallelism=mode,
+                lm_head_chunk=chunk,
+                checkpoint_dir=str(tmp_path / f"ck_{mode}_{chunk}"),
+                **extra,
+            )
+            trainer = DistributedTrainer(config, model_overrides=TINY)
+            trainer.initialize()
+            got[chunk] = trainer.validate_metrics(
+                get_dataloader("openwebtext", **dl_kwargs)
+            )
+        np.testing.assert_allclose(got[32]["loss"], got[0]["loss"],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got[32]["accuracy"], got[0]["accuracy"],
+                                   atol=1e-6)

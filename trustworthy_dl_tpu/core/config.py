@@ -114,6 +114,10 @@ class TrainingConfig:
     # moment memory reclaimed per chip.
     shard_opt_state: bool = False
     checkpoint_dir: str = "checkpoints"
+    # Async checkpointing: save() returns after the device→host snapshot;
+    # disk serialisation overlaps the next training steps (Orbax async
+    # path).  cleanup()/restore join any in-flight write.
+    async_checkpoint: bool = False
     # Migration-time model rate for reassignment estimates.  The reference
     # hardcodes 1 GB/s (distributed_trainer.py:360); on TPU the transfer
     # rides ICI, so measure and override (elastic/reassignment.py).

@@ -78,8 +78,21 @@ class CheckpointManager:
         with open(path) as f:
             return json.load(f)
 
-    def save(self, state: Any, step: int, force: bool = False) -> str:
+    def save(self, state: Any, step: int, force: bool = False,
+             block: bool = True) -> str:
+        """``block=False`` returns as soon as the device→host copy is done
+        (Orbax's async path): disk serialisation overlaps the next training
+        steps instead of stalling them.  Buffer donation stays safe — the
+        step only donates the on-device arrays, which Orbax has already
+        snapshotted to host.  A later save/restore (or ``wait``) joins the
+        in-flight write."""
         path = self.path_for(step)
+        # Join any previous in-flight async save BEFORE inspecting the
+        # destination: Orbax commits async writes by rename, so an
+        # in-flight save of this same step only becomes visible to the
+        # exists() check once joined (skip/force decisions would otherwise
+        # race the commit).
+        self._ckptr.wait_until_finished()
         if os.path.exists(path):
             if not force:
                 logger.info("Checkpoint already exists: %s", path)
@@ -88,13 +101,20 @@ class CheckpointManager:
 
             shutil.rmtree(path)
         self._ckptr.save(path, state)
-        self._ckptr.wait_until_finished()
-        logger.info("Checkpoint saved: %s", path)
+        if block:
+            self._ckptr.wait_until_finished()
+        logger.info("Checkpoint %s: %s",
+                    "saved" if block else "saving (async)", path)
         return path
+
+    def wait(self) -> None:
+        """Join any in-flight async save."""
+        self._ckptr.wait_until_finished()
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of ``template``.  ``step``
         defaults to the latest available."""
+        self._ckptr.wait_until_finished()  # join an in-flight async save
         if step is None:
             step = _latest_step(self.directory)
             if step is None:

@@ -503,7 +503,25 @@ def build_train_step(
 def build_eval_step(bundle: ModelBundle
                     ) -> Callable[[Any, Dict[str, Array]], Dict[str, Array]]:
     """Validation step (distributed_trainer.py:494-508): loss + accuracy on
-    an un-noded batch, no detection machinery."""
+    an un-noded batch, no detection machinery.  LMs with the fused
+    vocab-chunked head keep its memory contract in eval too — the
+    [B, T, V] logits never materialise."""
+    chunk = getattr(bundle.config, "lm_head_chunk", 0)
+    if bundle.kind == "lm" and chunk and "moe" not in bundle.name:
+        from trustworthy_dl_tpu.models import gpt2 as _g
+        from trustworthy_dl_tpu.ops.fused_ce import fused_lm_eval
+
+        cfg = bundle.config
+
+        def eval_step(params, batch):
+            x = _g.embed(params, batch["input"], cfg)
+            x = _g.apply_blocks(params["blocks"], x, cfg)
+            normed = L.layernorm(params["ln_f"], x)
+            loss, acc = fused_lm_eval(normed, params["wte"],
+                                      batch["target"], chunk, cfg.dtype)
+            return {"loss": loss, "accuracy": acc}
+
+        return eval_step
 
     def eval_step(params, batch):
         logits = bundle.apply(params, batch["input"])

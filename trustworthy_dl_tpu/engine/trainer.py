@@ -704,7 +704,10 @@ class DistributedTrainer:
         # skips an existing step directory, so a pre-existing payload (a
         # reused checkpoint_dir) must not get its topology overwritten.
         already = os.path.exists(self.checkpointer.path_for(self.global_step))
-        path = self.checkpointer.save(self.state, self.global_step)
+        path = self.checkpointer.save(
+            self.state, self.global_step,
+            block=not self.config.async_checkpoint,
+        )
         if already:
             logger.warning(
                 "Checkpoint step %d already existed; keeping its sidecar "
@@ -805,5 +808,6 @@ class DistributedTrainer:
 
     def cleanup(self) -> None:
         """distributed_trainer.py:523-527."""
+        self.checkpointer.wait()  # join any in-flight async save
         self.state = None
         logger.info("Distributed training cleanup completed")

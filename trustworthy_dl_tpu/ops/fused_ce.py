@@ -166,3 +166,60 @@ def _bwd(chunk, compute_dtype, carry, g):
     dx = dx.reshape(x_shape).astype(x_dtype)
     dtgt = None  # int targets carry no tangent
     return dx, dw, dtgt
+
+
+def fused_lm_eval(x: Array, w: Array, targets: Array,
+                  chunk: int = DEFAULT_CHUNK,
+                  compute_dtype: Any = jnp.bfloat16
+                  ) -> Tuple[Array, Array]:
+    """(mean NLL, accuracy) without materialising the [N, V] logits —
+    the evaluation twin of fused_lm_loss (no backward pass, so no
+    custom_vjp needed).  Tracks the running (max logit, argmax) across
+    vocab chunks for accuracy alongside the online logsumexp for loss."""
+    compute_dtype = jnp.dtype(compute_dtype)
+    d = x.shape[-1]
+    xf = x.reshape(-1, d).astype(compute_dtype)
+    tgt = targets.reshape(-1)
+    n = xf.shape[0]
+    wp, v = _pad_vocab(w.astype(compute_dtype), chunk)
+    w_chunks = wp.reshape(-1, chunk, d)
+
+    def body(carry, args):
+        m, s, tlogit, best, best_idx = carry
+        wc, base = args
+        logits = jnp.einsum("nd,cd->nc", xf, wc,
+                            preferred_element_type=jnp.float32)
+        col = jnp.arange(chunk) + base
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        chunk_max = jnp.max(logits, axis=1)
+        chunk_arg = base + jnp.argmax(logits, axis=1)
+        better = chunk_max > best
+        best = jnp.where(better, chunk_max, best)
+        best_idx = jnp.where(better, chunk_arg, best_idx)
+        m_new = jnp.maximum(m, chunk_max)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1
+        )
+        local = tgt - base
+        in_chunk = (tgt >= base) & (tgt < base + chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1
+        )[:, 0]
+        tlogit = jnp.where(in_chunk, picked, tlogit)
+        return (m_new, s, tlogit, best, best_idx), None
+
+    n_chunks = w_chunks.shape[0]
+    bases = jnp.arange(n_chunks) * chunk
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.int32),
+    )
+    (m, s, tlogit, _, best_idx), _ = jax.lax.scan(
+        body, init, (w_chunks, bases)
+    )
+    loss = jnp.mean(m + jnp.log(s) - tlogit)
+    accuracy = jnp.mean((best_idx == tgt).astype(jnp.float32))
+    return loss, accuracy

@@ -567,7 +567,18 @@ def build_pipeline_eval_step(bundle, config: TrainingConfig, mesh: Mesh
         mb = b // config.num_microbatches
         x_mb = x.reshape(config.num_microbatches, mb, t, d)
         y_mb, _, _, _ = pipe_apply(params["blocks"], x_mb)
-        logits = gpt2.unembed(params, y_mb.reshape(b, t, d), cfg)
+        y = y_mb.reshape(b, t, d)
+        if cfg.lm_head_chunk:
+            # Same memory contract as training: the fused eval never
+            # materialises the [B, T, V] logits (ops/fused_ce.py).
+            from trustworthy_dl_tpu.ops.fused_ce import fused_lm_eval
+
+            normed = L.layernorm(params["ln_f"], y)
+            loss, acc = fused_lm_eval(normed, params["wte"],
+                                      batch["target"], cfg.lm_head_chunk,
+                                      cfg.dtype)
+            return {"loss": loss, "accuracy": acc}
+        logits = gpt2.unembed(params, y, cfg)
         return {
             "loss": L.cross_entropy_loss(logits, batch["target"]),
             "accuracy": L.accuracy(logits, batch["target"]),

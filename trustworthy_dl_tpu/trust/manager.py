@@ -344,9 +344,16 @@ class TrustManager:
         """Operator hints derived from the current aggregate picture."""
         out: List[str] = []
         stats = self.get_trust_statistics()
+        compromised = self.get_compromised_nodes()
+        if compromised:
+            out.append(
+                f"nodes {sorted(compromised)} are compromised: keep them "
+                "gated (or evict with elastic_resharding) and initiate "
+                "recovery only after the incident is understood"
+            )
         if stats.get("mean_trust", 1.0) < 0.6:
             out.append("mean trust below 0.6: audit the flagged nodes before continuing")
-        if len(self.get_compromised_nodes()) > self.num_nodes * 0.3:
+        if len(compromised) > self.num_nodes * 0.3:
             out.append(">30% of nodes compromised: treat as coordinated attack, rotate keys/hosts")
         if stats.get("total_attacks", 0) > 10:
             out.append("attack log is long: tighten detector thresholds or enable ML detectors")
