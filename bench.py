@@ -241,7 +241,8 @@ def main() -> None:
         "detection_overhead_pct": round(overhead_pct, 2),
         "platform": platform,
         "num_chips": n_chips,
-        "tokens_per_step": tokens_per_step,
+        ("tokens_per_step" if is_lm else "samples_per_step"):
+            tokens_per_step,
         "model_tflops_per_chip": round(tflops, 2) if tflops else None,
     }))
 

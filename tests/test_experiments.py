@@ -145,3 +145,33 @@ def test_cli_main_smoke(tmp_path):
     out = tmp_path / "cli_smoke"
     assert (out / "experiment_results.json").exists()
     assert (out / "experiment_report.md").exists()
+
+
+def test_cli_generate_smoke(tmp_path):
+    """trustworthy-dl-generate runs from a fresh init (no checkpoint) and
+    prints sampled token ids.  The overrides hook keeps the smoke model
+    tiny; a pipeline-trained checkpoint dir is refused with a clear
+    message rather than an Orbax structure error."""
+    from trustworthy_dl_tpu.cli import generate_main
+
+    tiny = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=64,
+                n_positions=32, seq_len=16)
+    rc = generate_main([
+        "--model", "gpt2", "--checkpoint-dir", str(tmp_path / "none"),
+        "--prompt", "5,6,7", "--max-new-tokens", "2",
+        "--temperature", "0.8", "--top-k", "10",
+    ], model_overrides=tiny)
+    assert rc == 0
+    assert generate_main(["--model", "resnet32"]) == 2
+
+    # Pipeline sidecar -> clear refusal.
+    from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "pp"))
+    os.makedirs(mgr.path_for(7), exist_ok=True)
+    mgr.save_metadata(7, {"parallelism": "model", "num_nodes": 4})
+    rc = generate_main(
+        ["--model", "gpt2", "--checkpoint-dir", str(tmp_path / "pp")],
+        model_overrides=tiny,
+    )
+    assert rc == 2

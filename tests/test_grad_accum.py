@@ -1,0 +1,98 @@
+"""Gradient accumulation (grad_accum_steps): microbatched gradients must
+equal the full-batch gradients — mean of equal-size microbatch means IS the
+full-batch mean — so training trajectories match, while activation memory
+shrinks by the accumulation factor."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import null_plan
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.engine import DistributedTrainer
+
+TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+
+
+def make(tmp_path, accum):
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=4, learning_rate=3e-3, checkpoint_interval=10 ** 9,
+        grad_accum_steps=accum, checkpoint_dir=str(tmp_path / f"ck{accum}"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    trainer.initialize()
+    return trainer
+
+
+def test_accum_matches_full_batch(tmp_path):
+    t1 = make(tmp_path / "a", accum=1)
+    t2 = make(tmp_path / "b", accum=2)
+    batch = t1._node_batch(t1.model.example_batch(16))
+    plan = null_plan(4)
+    s1, s2 = t1.state, t2.state
+    for step in range(3):
+        s1, m1 = t1._train_step(s1, batch, plan)
+        s2, m2 = t2._train_step(s2, batch, plan)
+        # bf16 forward + f32 partial sums: agreement is to accumulation
+        # precision, not bit-exact; later steps additionally compound the
+        # epsilon through Adam's early-step sign sensitivity, so the
+        # strict check is step 1 and the trajectory check is the relative
+        # parameter distance below.
+        tol = 1e-4 if step == 0 else 5e-3
+        np.testing.assert_allclose(float(m2.loss), float(m1.loss),
+                                   rtol=tol)
+        np.testing.assert_allclose(np.asarray(m2.per_node_loss),
+                                   np.asarray(m1.per_node_loss), rtol=tol)
+        np.testing.assert_allclose(float(m2.grad_norm), float(m1.grad_norm),
+                                   rtol=5e-4 if step == 0 else 5e-2)
+    # Parameter trajectories stay close.  Not tighter than 1e-2: while
+    # ν≈0, Adam's update is ≈ lr·sign(g), so epsilon-level gradient
+    # differences flip whole ±lr updates on near-zero-gradient params —
+    # the drift is a fixed small fraction of the distance travelled, not
+    # of machine epsilon (same bound as tests/test_zero1.py).
+    num = den = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        num += float(jnp.sum((a - b) ** 2))
+        den += float(jnp.sum(a ** 2))
+    assert (num / den) ** 0.5 < 1e-2
+
+
+def test_accum_detects_attack(tmp_path):
+    """Detection still fires under accumulation: batteries run on the
+    accumulated gradient, which a poisoning attack perturbs the same way."""
+    from trustworthy_dl_tpu.attacks import AdversarialAttacker, AttackConfig
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, learning_rate=3e-3, checkpoint_interval=10 ** 9,
+        grad_accum_steps=2, detector_warmup=3,
+        checkpoint_dir=str(tmp_path / "ck_att"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[2],
+        intensity=0.8, start_step=6,
+    ))
+    attacker.activate_attacks()
+    plan = attacker.plan(8)
+    batch = trainer._node_batch(trainer.model.example_batch(16))
+    state = trainer.state
+    attacked_nodes = set()
+    for _ in range(14):
+        state, metrics = trainer._train_step(state, batch, plan)
+        attacked_nodes |= set(np.where(np.asarray(metrics.attacked))[0])
+        assert np.isfinite(float(metrics.loss))
+    assert 2 in attacked_nodes
+    assert attacked_nodes <= {2}
+
+
+def test_accum_divisibility_validated(tmp_path):
+    trainer = make(tmp_path, accum=3)  # per-node batch 4 not divisible by 3
+    with pytest.raises(ValueError):
+        trainer._node_batch(trainer.model.example_batch(16))

@@ -94,5 +94,84 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
+def build_generate_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trustworthy-dl-generate",
+        description="Sample from a trained GPT-2 checkpoint with the "
+                    "KV-cache decoder (beyond-reference; the reference "
+                    "trains GPT-2 but cannot sample from it)",
+    )
+    parser.add_argument("--model", type=str, default="gpt2")
+    parser.add_argument("--checkpoint-dir", type=str, default="checkpoints",
+                        help="restore the latest checkpoint from here "
+                             "(falls back to fresh init with a warning)")
+    parser.add_argument("--prompt", type=str, default="1,2,3,4",
+                        help="comma-separated token ids")
+    parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--top-k", type=int, default=40)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def generate_main(argv: Optional[List[str]] = None,
+                  model_overrides: Optional[dict] = None) -> int:
+    """Console entry point ``trustworthy-dl-generate``.
+
+    ``model_overrides`` is an internal hook (tests shrink the model with
+    it); the CLI surface restores whatever the checkpoint was trained as.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
+    from trustworthy_dl_tpu.engine.trainer import DistributedTrainer
+    from trustworthy_dl_tpu.models.generate import generate
+
+    args = build_generate_parser().parse_args(argv)
+    if not args.model.startswith("gpt") or args.model.endswith("-moe"):
+        print("generation supports the dense GPT-2 family")
+        return 2
+    # Pipeline-trained checkpoints store stage-stacked [S, L/S, ...] block
+    # params — a different tree than the decoder's; refuse clearly rather
+    # than let Orbax fail with a structure mismatch.  The topology sidecar
+    # records the training parallelism for exactly this check.
+    probe = CheckpointManager(args.checkpoint_dir)
+    latest = probe.latest_step()
+    if latest is not None:
+        meta = probe.load_metadata(latest) or {}
+        if meta.get("parallelism") == "model":
+            print("checkpoint was trained with pipeline (stage) "
+                  "parallelism; generation needs a data-parallel "
+                  "checkpoint (params stage-stacked)")
+            return 2
+    config = TrainingConfig(model_name=args.model, num_nodes=1, batch_size=1,
+                            checkpoint_dir=args.checkpoint_dir)
+    trainer = DistributedTrainer(config, model_overrides=model_overrides)
+    trainer.initialize()
+    try:
+        trainer.load_checkpoint()
+        print(f"restored step {int(trainer.state.step)} "
+              f"from {args.checkpoint_dir}")
+    except FileNotFoundError:
+        print(f"no checkpoint under {args.checkpoint_dir!r}; "
+              "sampling from random init")
+
+    tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
+    prompt = jnp.asarray([tokens], jnp.int32)
+    out = generate(
+        trainer.state.params, trainer.model.config, prompt,
+        max_new_tokens=args.max_new_tokens, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p,
+        rng=jax.random.PRNGKey(args.seed),
+    )
+    print("prompt:    ", tokens)
+    print("generated: ", out[0, len(tokens):].tolist())
+    trainer.cleanup()
+    return 0
+
+
 if __name__ == "__main__":
     raise SystemExit(main())

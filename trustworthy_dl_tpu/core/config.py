@@ -72,6 +72,13 @@ class TrainingConfig:
     # Axes listed here parallelise over DCN; all others stay on ICI.
     dcn_mesh_shape: Optional[Dict[str, int]] = None
     num_microbatches: int = 4          # pipeline schedule depth
+    # Gradient accumulation (data-parallel modes): each node's batch is
+    # processed in this many sequential microbatches inside the step
+    # (lax.scan), averaging the gradients — activation memory shrinks by
+    # the same factor, so effective batches grow without remat/chunking.
+    # Detector semantics: batteries run on the ACCUMULATED gradient (what
+    # is aggregated); output stats ride the last microbatch's features.
+    grad_accum_steps: int = 1
     dtype: str = "bfloat16"            # compute dtype (params stay f32)
     seed: int = 0
     remat: bool = False                # jax.checkpoint the blocks

@@ -237,6 +237,49 @@ def build_train_step(
 
     grad_fn = jax.value_and_grad(node_loss, has_aux=True)
 
+    accum = max(int(getattr(config, "grad_accum_steps", 1)), 1)
+    if accum > 1:
+        base_grad_fn = grad_fn
+
+        def grad_fn(params, node_batch):  # noqa: F811 — accumulated variant
+            """Sequential microbatches inside the step (lax.scan):
+            gradients/losses are averaged (exactly the full-batch mean for
+            equal-size microbatches of a mean loss); mean_logits averages
+            (linear, exact); the stat battery and feature moments ride the
+            last microbatch."""
+            mbs = jax.tree_util.tree_map(
+                lambda v: v.reshape((accum, v.shape[0] // accum)
+                                    + v.shape[1:]),
+                node_batch,
+            )
+
+            def body(carry, mb):
+                loss_sum, grad_sum, ml_sum = carry
+                (loss, aux), g = base_grad_fn(params, mb)
+                out_stats, f_mean, f_std, ml = aux
+                carry = (
+                    loss_sum + loss,
+                    jax.tree_util.tree_map(jnp.add, grad_sum, g),
+                    ml_sum + ml,
+                )
+                return carry, (out_stats, f_mean, f_std)
+
+            init = (
+                jnp.zeros((), jnp.float32),
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                ),
+                jnp.zeros((num_classes,), jnp.float32),
+            )
+            (loss_sum, grad_sum, ml_sum), stacked = jax.lax.scan(
+                body, init, mbs
+            )
+            out_stats, f_mean, f_std = (x[-1] for x in stacked)
+            inv = 1.0 / accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+            aux = (out_stats, f_mean, f_std, ml_sum * inv)
+            return (loss_sum * inv, aux), grads
+
     def train_step(state: TrainState, batch: Dict[str, Array],
                    plan: AttackPlan) -> Tuple[TrainState, StepMetrics]:
         rng, k_data, k_grad = jax.random.split(state.rng, 3)

@@ -338,11 +338,17 @@ class DistributedTrainer:
             return out
         n = self.config.num_nodes
         out = {}
+        accum = max(self.config.grad_accum_steps, 1)
         for key, arr in batch.items():
             b = (arr.shape[0] // n) * n
             if b == 0:
                 raise ValueError(
                     f"batch size {arr.shape[0]} < num_nodes {n}"
+                )
+            if (b // n) % accum:
+                raise ValueError(
+                    f"per-node batch {b // n} not divisible by "
+                    f"grad_accum_steps={accum}"
                 )
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(
