@@ -93,6 +93,9 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
             overrides["attn_impl"] = attn
         if os.environ.get("TDDL_BENCH_REMAT", "1") == "1":
             overrides["remat"] = True
+            overrides["remat_policy"] = os.environ.get(
+                "TDDL_BENCH_REMAT_POLICY", "block"
+            )
     trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
     n_params = trainer.model.num_params(trainer.state.params)

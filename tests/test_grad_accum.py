@@ -92,7 +92,12 @@ def test_accum_detects_attack(tmp_path):
     assert attacked_nodes <= {2}
 
 
-def test_accum_divisibility_validated(tmp_path):
-    trainer = make(tmp_path, accum=3)  # per-node batch 4 not divisible by 3
+def test_accum_ragged_batch_trimmed(tmp_path):
+    """Ragged batches (drop_last=False loaders) trim to a multiple of
+    nodes x accum — same contract as the node split — instead of raising
+    mid-epoch; an unusably small batch still errors clearly."""
+    trainer = make(tmp_path, accum=3)  # nodes=4, so batches trim to 12s
+    nb = trainer._node_batch(trainer.model.example_batch(16))
+    assert nb["input"].shape[:2] == (4, 3)
     with pytest.raises(ValueError):
-        trainer._node_batch(trainer.model.example_batch(16))
+        trainer._node_batch(trainer.model.example_batch(8))  # < 4*3

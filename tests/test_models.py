@@ -118,3 +118,37 @@ def test_synthetic_vision_is_learnable():
                       for c in range(10)])
     pred = np.argmin(((x[:, None, :] - means[None]) ** 2).sum(-1), axis=1)
     assert (pred == y).mean() > 0.5
+
+
+def test_remat_policies_numerically_identical():
+    """remat off / block remat / attention-policy remat: same loss, same
+    gradients (remat changes scheduling, never math)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trustworthy_dl_tpu.models import gpt2
+
+    base = dict(vocab_size=64, n_positions=16, n_layer=2, n_embd=32,
+                n_head=4, dtype=jnp.float32)
+    params = gpt2.init_params(jax.random.PRNGKey(0),
+                              gpt2.GPT2Config(**base))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    batch = {"input": toks, "target": jnp.roll(toks, -1, -1)}
+
+    results = {}
+    for name, kw in (("off", dict(remat=False)),
+                     ("block", dict(remat=True)),
+                     ("attention", dict(remat=True,
+                                        remat_policy="attention"))):
+        cfg = gpt2.GPT2Config(**base, **kw)
+        loss, grads = jax.jit(
+            jax.value_and_grad(gpt2.loss_fn), static_argnums=2
+        )(params, batch, cfg)
+        results[name] = (float(loss), grads)
+    for name in ("block", "attention"):
+        assert np.isclose(results[name][0], results["off"][0], rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(results["off"][1]),
+                        jax.tree_util.tree_leaves(results[name][1])):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-5, atol=1e-7)

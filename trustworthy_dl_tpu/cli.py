@@ -159,7 +159,16 @@ def generate_main(argv: Optional[List[str]] = None,
         print(f"no checkpoint under {args.checkpoint_dir!r}; "
               "sampling from random init")
 
-    tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
+    try:
+        tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        print(f"--prompt must be comma-separated token ids, got "
+              f"{args.prompt!r}")
+        return 2
+    vocab = trainer.model.config.vocab_size
+    if not tokens or any(not 0 <= t < vocab for t in tokens):
+        print(f"--prompt needs at least one token id in [0, {vocab})")
+        return 2
     prompt = jnp.asarray([tokens], jnp.int32)
     out = generate(
         trainer.state.params, trainer.model.config, prompt,

@@ -340,15 +340,14 @@ class DistributedTrainer:
         out = {}
         accum = max(self.config.grad_accum_steps, 1)
         for key, arr in batch.items():
-            b = (arr.shape[0] // n) * n
+            # Trim ragged batches (drop_last=False loaders) to a multiple
+            # of nodes × accumulation steps — same trimming contract as
+            # the node split and the pipeline microbatch branch.
+            b = (arr.shape[0] // (n * accum)) * n * accum
             if b == 0:
                 raise ValueError(
-                    f"batch size {arr.shape[0]} < num_nodes {n}"
-                )
-            if (b // n) % accum:
-                raise ValueError(
-                    f"per-node batch {b // n} not divisible by "
-                    f"grad_accum_steps={accum}"
+                    f"batch size {arr.shape[0]} < num_nodes x "
+                    f"grad_accum_steps = {n * accum}"
                 )
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(

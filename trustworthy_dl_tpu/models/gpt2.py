@@ -50,6 +50,13 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "full"   # full | flash | ring | ulysses
     remat: bool = False
+    # Remat granularity when ``remat`` is on: "block" rematerialises the
+    # whole transformer block (max memory saving, max recompute);
+    # "attention" saves every intermediate EXCEPT the O(T²) attention
+    # scores/probs — the dominant residuals — so only the attention core
+    # recomputes in the backward pass (less recompute, slightly more
+    # memory).
+    remat_policy: str = "block"
     # Vocab-chunked fused lm-head+CE (ops/fused_ce.py): the loss never
     # materialises the [B, T, V] logits.  0 disables (full logits path).
     lm_head_chunk: int = 0
@@ -79,14 +86,20 @@ def register_attention(name: str, fn: AttnFn) -> None:
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True) -> jax.Array:
     """[B, H, T, D] softmax attention.  XLA fuses the softmax chain; the
-    matmuls land on the MXU in bf16."""
+    matmuls land on the MXU in bf16.  The O(T²) intermediates are tagged
+    with checkpoint_name so the "attention" remat policy can drop exactly
+    them (see apply_blocks)."""
+    from jax.ad_checkpoint import checkpoint_name
+
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
     if causal:
         t_q, t_k = q.shape[-2], k.shape[-2]
         mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    scores = checkpoint_name(scores, "attn_scores")
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    probs = checkpoint_name(probs, "attn_probs")
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
@@ -194,7 +207,21 @@ def apply_blocks(blocks: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
     block body regardless of depth."""
     body = block_forward
     if cfg.remat:
-        body = jax.checkpoint(body, static_argnums=(2,))
+        if cfg.remat_policy == "attention" and cfg.attn_impl == "full":
+            # Save everything except the O(T²) scores/probs: only the
+            # attention core recomputes in the backward pass.  Only the
+            # "full" impl tags those names — the Pallas/ring paths never
+            # materialise them (that is their point), so for any other
+            # impl the policy would match nothing and silently save ALL
+            # intermediates; fall through to block remat instead.
+            from jax.ad_checkpoint import checkpoint_policies as cp
+
+            policy = cp.save_anything_except_these_names(
+                "attn_scores", "attn_probs"
+            )
+            body = jax.checkpoint(body, static_argnums=(2,), policy=policy)
+        else:
+            body = jax.checkpoint(body, static_argnums=(2,))
 
     def scan_fn(h, block):
         return body(block, h, cfg), None
