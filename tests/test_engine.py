@@ -291,3 +291,22 @@ def test_async_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(restored.trust.scores),
                                   saved_trust)
     trainer.cleanup()
+
+
+def test_tensorboard_metrics_export(tmp_path):
+    """tensorboard_dir writes real event files with batch/epoch scalars
+    (the reference pinned tensorboard but never wrote an event)."""
+    import glob
+    import os
+
+    pytest.importorskip("torch.utils.tensorboard")
+
+    tb_dir = str(tmp_path / "tb")
+    trainer = gpt_trainer(tmp_path, num_nodes=4, tensorboard_dir=tb_dir)
+    trainer.initialize()
+    dl = gpt_loader(num_nodes=4, num_examples=16)
+    trainer.train_epoch(dl, 0)
+    trainer.cleanup()
+    events = glob.glob(os.path.join(tb_dir, "events.out.tfevents.*"))
+    assert events, "no TensorBoard event file written"
+    assert os.path.getsize(events[0]) > 0

@@ -147,9 +147,21 @@ def generate_main(argv: Optional[List[str]] = None,
                   "parallelism; generation needs a data-parallel "
                   "checkpoint (params stage-stacked)")
             return 2
+    # Validate the prompt BEFORE the expensive init/restore: the int parse
+    # needs nothing, the vocab bound only needs the (cheap) model config.
+    try:
+        tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        print(f"--prompt must be comma-separated token ids, got "
+              f"{args.prompt!r}")
+        return 2
     config = TrainingConfig(model_name=args.model, num_nodes=1, batch_size=1,
                             checkpoint_dir=args.checkpoint_dir)
     trainer = DistributedTrainer(config, model_overrides=model_overrides)
+    vocab = trainer.model.config.vocab_size
+    if not tokens or any(not 0 <= t < vocab for t in tokens):
+        print(f"--prompt needs at least one token id in [0, {vocab})")
+        return 2
     trainer.initialize()
     try:
         trainer.load_checkpoint()
@@ -159,16 +171,6 @@ def generate_main(argv: Optional[List[str]] = None,
         print(f"no checkpoint under {args.checkpoint_dir!r}; "
               "sampling from random init")
 
-    try:
-        tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
-    except ValueError:
-        print(f"--prompt must be comma-separated token ids, got "
-              f"{args.prompt!r}")
-        return 2
-    vocab = trainer.model.config.vocab_size
-    if not tokens or any(not 0 <= t < vocab for t in tokens):
-        print(f"--prompt needs at least one token id in [0, {vocab})")
-        return 2
     prompt = jnp.asarray([tokens], jnp.int32)
     out = generate(
         trainer.state.params, trainer.model.config, prompt,

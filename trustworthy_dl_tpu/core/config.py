@@ -86,6 +86,10 @@ class TrainingConfig:
     # (trust_manager.py:113-114); inside a compiled step we use
     # step_count * time_per_step as the clock so the math stays pure.
     time_per_step: float = 1.0
+    # Remat granularity when ``remat`` is set: "block" (whole transformer
+    # block) or "attention" (only the O(T²) attention core recomputes;
+    # falls back to block for non-"full" attention impls).
+    remat_policy: str = "block"
     # Exact order statistics (median/percentiles) cost a sort on TPU
     # (attack_detector.py:190-196 computes them on host numpy); disable to
     # trade fidelity for speed — see SURVEY §7.4(2).
@@ -109,6 +113,9 @@ class TrainingConfig:
     # normally gated in-step by the verifier instead).
     profile_dir: Optional[str] = None
     debug_nans: bool = False
+    # TensorBoard event-file export of batch/epoch metrics (the reference
+    # pinned tensorboard in requirements but never wrote an event).
+    tensorboard_dir: Optional[str] = None
     # Vocab-chunked fused lm-head+cross-entropy (ops/fused_ce.py): the LM
     # loss never materialises the [B, T, V] logits — removes the dominant
     # HBM tensor of the loss step and unlocks larger per-chip batches.

@@ -100,7 +100,10 @@ class DistributedTrainer:
         self.attack_detector = AttackDetector(
             exact_order_stats=config.exact_order_stats
         )
-        self.metrics_collector = MetricsCollector()
+        self.metrics_collector = MetricsCollector(
+            tensorboard_dir=config.tensorboard_dir
+        )
+        self._warned_trim = False
 
         # Node configurations (reference: :85-87).  On TPU, rank == mesh
         # coordinate along the node axis.
@@ -139,6 +142,11 @@ class DistributedTrainer:
             model_overrides.setdefault("attn_impl", "ring")
         if config.lm_head_chunk and config.model_name.startswith("gpt"):
             model_overrides.setdefault("lm_head_chunk", config.lm_head_chunk)
+        if config.model_name.startswith("gpt"):
+            if config.remat:
+                model_overrides.setdefault("remat", True)
+                model_overrides.setdefault("remat_policy",
+                                           config.remat_policy)
         self.model = ModelFactory().create_model(
             config.model_name, **model_overrides
         )
@@ -349,6 +357,16 @@ class DistributedTrainer:
                     f"batch size {arr.shape[0]} < num_nodes x "
                     f"grad_accum_steps = {n * accum}"
                 )
+            if b < arr.shape[0] and not self._warned_trim:
+                # Once per trainer: a ragged LAST batch is normal, but a
+                # batch size that never divides nodes×accum silently drops
+                # data every step — surface the misconfiguration.
+                self._warned_trim = True
+                logger.warning(
+                    "batch of %d trimmed to %d (num_nodes=%d x "
+                    "grad_accum_steps=%d); pick a divisible batch size to "
+                    "avoid dropping examples", arr.shape[0], b, n, accum,
+                )
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(
                 zip(self.mesh.axis_names, self.mesh.devices.shape)
@@ -404,6 +422,12 @@ class DistributedTrainer:
         self.sync_host_state()
         self._epoch_intelligence()
         avg = epoch_loss / max(num_batches, 1)
+        self.metrics_collector.collect_epoch_metrics({
+            "epoch": epoch,
+            "avg_loss": avg,
+            "num_batches": num_batches,
+            "system_trust": self.trust_manager.calculate_system_trust(),
+        })
         logger.info("Epoch %d completed. Average loss: %.4f", epoch, avg)
         return avg
 
@@ -814,5 +838,6 @@ class DistributedTrainer:
     def cleanup(self) -> None:
         """distributed_trainer.py:523-527."""
         self.checkpointer.wait()  # join any in-flight async save
+        self.metrics_collector.close()  # flush + release the TB writer
         self.state = None
         logger.info("Distributed training cleanup completed")

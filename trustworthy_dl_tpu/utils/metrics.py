@@ -1,26 +1,62 @@
 """MetricsCollector — the implied ``utils.metrics`` module (imported at
 distributed_trainer.py:23, experiment_runner.py:25; call sites
 collect_batch_metrics at distributed_trainer.py:417 and get_summary at
-:520)."""
+:520).
+
+Optional TensorBoard export: the reference pinned ``tensorboard``/``wandb``
+in requirements.txt:44-45 but never imported either; here a
+``tensorboard_dir`` writes real event files (scalars per batch/epoch) via
+torch's SummaryWriter when available, and degrades to a no-op otherwise.
+"""
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
+
+def _make_tb_writer(logdir: str):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(logdir)
+    except Exception as exc:  # tensorboard optional — degrade, don't fail
+        logger.warning("TensorBoard writer unavailable (%s); metrics stay "
+                       "in-memory only", exc)
+        return None
+
 
 class MetricsCollector:
     """Accumulates per-batch metric dicts and summarises them."""
 
-    def __init__(self, max_records: int = 100_000):
+    def __init__(self, max_records: int = 100_000,
+                 tensorboard_dir: Optional[str] = None):
         self.max_records = max_records
         self.batch_metrics: List[Dict[str, Any]] = []
         self.epoch_metrics: List[Dict[str, Any]] = []
         self._step_times: List[float] = []
         self._last_tick: Optional[float] = None
+        self._tb = _make_tb_writer(tensorboard_dir) if tensorboard_dir \
+            else None
+
+    def _tb_scalars(self, prefix: str, record: Dict[str, Any],
+                    step: int) -> None:
+        if self._tb is None:
+            return
+        for key, value in record.items():
+            if isinstance(value, (int, float)) and key != "timestamp":
+                self._tb.add_scalar(f"{prefix}/{key}", value, step)
+            elif isinstance(value, dict):  # e.g. per-node trust scores
+                for sub, v in value.items():
+                    if isinstance(v, (int, float)):
+                        self._tb.add_scalar(f"{prefix}/{key}/{sub}", v,
+                                            step)
 
     def collect_batch_metrics(self, metrics: Dict[str, Any]) -> None:
         if len(self.batch_metrics) >= self.max_records:
@@ -28,11 +64,25 @@ class MetricsCollector:
         record = dict(metrics)
         record.setdefault("timestamp", time.time())
         self.batch_metrics.append(record)
+        self._tb_scalars("batch", record,
+                         int(record.get("step", len(self.batch_metrics))))
 
     def collect_epoch_metrics(self, metrics: Dict[str, Any]) -> None:
         record = dict(metrics)
         record.setdefault("timestamp", time.time())
         self.epoch_metrics.append(record)
+        self._tb_scalars("epoch", record,
+                         int(record.get("epoch", len(self.epoch_metrics))))
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        """Flush and release the event-file writer (thread + fd)."""
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def tick(self) -> None:
         """Step-time histogram support (SURVEY §5.1)."""
