@@ -100,3 +100,72 @@ def test_prefetch_loader_propagates_errors():
     loader = PrefetchLoader(boom(), depth=1)
     with pytest.raises(RuntimeError, match="producer died"):
         list(loader)
+
+
+def test_window_gather_native_matches_fallback():
+    """The C++ random-window sampler and the numpy fallback must produce
+    bit-identical batches (same splitmix offsets)."""
+    import os
+
+    from trustworthy_dl_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    stream = np.arange(10_000, dtype=np.int32) % 997
+    a_in, a_tg = native.window_gather(stream, seq_len=32, batch=128, seed=42)
+    # Force the fallback path via the internal implementation.
+    offs = (native.splitmix_fill(42, 128) % np.uint64(10_000 - 33)).astype(
+        np.int64
+    )
+    gather = offs[:, None] + np.arange(33, dtype=np.int64)[None, :]
+    windows = stream[gather]
+    np.testing.assert_array_equal(a_in, windows[:, :-1])
+    np.testing.assert_array_equal(a_tg, windows[:, 1:])
+    # targets are the shifted inputs
+    np.testing.assert_array_equal(a_in[:, 1:], a_tg[:, :-1])
+
+
+def test_token_stream_loader_contract():
+    """TokenStreamLoader: deterministic per epoch, fresh windows per batch,
+    {'input','target'} contract, trains with the engine loaders."""
+    from trustworthy_dl_tpu.data import TokenStreamLoader, get_dataloader
+
+    stream = np.arange(5_000, dtype=np.int32) % 101
+    dl = TokenStreamLoader(stream, batch_size=8, seq_len=16,
+                           steps_per_epoch=3, seed=7)
+    assert len(dl) == 3
+    e0 = [b for b in dl]
+    e1 = [b for b in dl]
+    assert len(e0) == 3
+    assert e0[0]["input"].shape == (8, 16)
+    np.testing.assert_array_equal(e0[0]["input"][:, 1:],
+                                  e0[0]["target"][:, :-1])
+    # different batches and different epochs draw different windows
+    assert not np.array_equal(e0[0]["input"], e0[1]["input"])
+    assert not np.array_equal(e0[0]["input"], e1[0]["input"])
+    # same (seed, epoch, step) reproduces exactly
+    dl2 = TokenStreamLoader(stream, batch_size=8, seq_len=16,
+                            steps_per_epoch=3, seed=7)
+    np.testing.assert_array_equal(next(iter(dl2))["input"], e0[0]["input"])
+
+    wdl = get_dataloader("openwebtext", batch_size=4, seq_len=16,
+                         vocab_size=128, num_examples=32,
+                         sampling="windows")
+    batch = next(iter(wdl))
+    assert batch["input"].shape == (4, 16)
+
+
+def test_token_stream_loader_no_epoch_step_collision():
+    """(epoch, step) folds through splitmix: long epochs must never repeat
+    a batch across epoch boundaries (a linear mix collided at step 10007)."""
+    from trustworthy_dl_tpu.data import TokenStreamLoader
+
+    stream = np.arange(4_000, dtype=np.int32)
+    dl = TokenStreamLoader(stream, batch_size=2, seq_len=8,
+                           steps_per_epoch=10_008, seed=0)
+    it0 = iter(dl)
+    first_epoch = [next(it0)["input"] for _ in range(10_008)]
+    it1 = iter(dl)
+    b1_0 = next(it1)["input"]
+    assert not any(np.array_equal(b1_0, b) for b in first_epoch[10_000:])
+    assert not np.array_equal(b1_0, first_epoch[0])

@@ -171,6 +171,11 @@ class TrainingConfig:
                 f"parallelism must be one of {PARALLELISM_MODES}, "
                 f"got {self.parallelism!r}"
             )
+        if self.remat_policy not in ("block", "attention"):
+            raise ValueError(
+                "remat_policy must be 'block' or 'attention', "
+                f"got {self.remat_policy!r}"
+            )
 
 
 @dataclass

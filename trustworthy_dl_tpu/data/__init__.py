@@ -1,7 +1,9 @@
 from trustworthy_dl_tpu.data.loader import (
     ArrayDataLoader,
     PrefetchLoader,
+    TokenStreamLoader,
     get_dataloader,
 )
 
-__all__ = ["ArrayDataLoader", "PrefetchLoader", "get_dataloader"]
+__all__ = ["ArrayDataLoader", "PrefetchLoader", "TokenStreamLoader",
+           "get_dataloader"]

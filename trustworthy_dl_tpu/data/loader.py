@@ -69,6 +69,47 @@ class ArrayDataLoader:
             }
 
 
+class TokenStreamLoader:
+    """Random-window batches over a contiguous token stream — the
+    nanoGPT-style LM sampler: every batch draws ``batch_size`` windows of
+    ``seq_len + 1`` tokens at fresh splitmix-derived offsets (native
+    multi-threaded gather, bit-exact fallback), so an "epoch" is a step
+    budget rather than a fixed partition of the stream.
+
+    Deterministic: batch k of epoch e depends only on (seed, e, k)."""
+
+    def __init__(self, stream: np.ndarray, batch_size: int, seq_len: int,
+                 steps_per_epoch: int, seed: int = 0):
+        self.stream = np.ascontiguousarray(stream, np.int32)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.steps_per_epoch = steps_per_epoch
+        self.seed = seed
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        epoch = self._epoch
+        self._epoch += 1
+        mask = (1 << 64) - 1
+        # Two splitmix rounds fold (seed, epoch, step) into the batch seed:
+        # a linear small-prime mix would collide across (epoch, step)
+        # pairs (e.g. epoch e step P == epoch e+1 step 0) and silently
+        # repeat batches on long epochs.
+        k_epoch = int(native.splitmix_fill(
+            ((self.seed & ((1 << 32) - 1)) << 32 | (epoch & ((1 << 32) - 1))),
+            1,
+        )[0])
+        for step in range(self.steps_per_epoch):
+            seed = int(native.splitmix_fill((k_epoch + step) & mask, 1)[0])
+            inputs, targets = native.window_gather(
+                self.stream, self.seq_len, self.batch_size, seed
+            )
+            yield {"input": inputs, "target": targets}
+
+
 class PrefetchLoader:
     """Background-thread prefetch over any batch iterable: batch k+1
     assembles on the host (native gathers) while batch k trains on device —
@@ -177,9 +218,13 @@ def get_dataloader(
     num_examples: Optional[int] = None,
     seed: int = 0,
     data_dir: Optional[str] = None,
-) -> ArrayDataLoader:
+    sampling: str = "epoch",
+) -> Any:
     """Reference signature (experiment_runner.py:100-110) with TPU-side
-    extensions (seq_len/vocab_size for LM synthesis)."""
+    extensions (seq_len/vocab_size for LM synthesis; ``sampling``:
+    "epoch" partitions the stream into fixed shuffled windows,
+    "windows" draws fresh random windows every batch — the nanoGPT-style
+    sampler via the native gather, better coverage on real corpora)."""
     name = dataset_name.lower()
     data_dir = data_dir or os.environ.get("TDDL_DATA_DIR", "")
     split_seed = seed + (0 if split == "train" else 10_000)
@@ -196,6 +241,10 @@ def get_dataloader(
         else:
             tokens = _synthetic_tokens(n * (seq_len + 1) + 1,
                                        min(vocab_size, 512), split_seed)
+        if sampling == "windows":
+            steps = max(n // max(batch_size, 1), 1)
+            return TokenStreamLoader(tokens, batch_size, seq_len,
+                                     steps_per_epoch=steps, seed=split_seed)
         usable = (len(tokens) - 1) // seq_len
         usable = min(usable, n)
         window = tokens[: usable * seq_len + 1]

@@ -357,10 +357,13 @@ class DistributedTrainer:
                     f"batch size {arr.shape[0]} < num_nodes x "
                     f"grad_accum_steps = {n * accum}"
                 )
-            if b < arr.shape[0] and not self._warned_trim:
-                # Once per trainer: a ragged LAST batch is normal, but a
-                # batch size that never divides nodes×accum silently drops
-                # data every step — surface the misconfiguration.
+            if b < arr.shape[0] and arr.shape[0] == self.config.batch_size \
+                    and not self._warned_trim:
+                # Once per trainer, and only when a FULL configured batch
+                # is trimmed: that means the batch size itself never
+                # divides nodes×accum and data is dropped every step.
+                # A ragged final batch (smaller than configured) is the
+                # loader's normal drop_last=False tail and stays silent.
                 self._warned_trim = True
                 logger.warning(
                     "batch of %d trimmed to %d (num_nodes=%d x "

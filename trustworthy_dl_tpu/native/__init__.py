@@ -107,6 +107,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tddl_gather_rows.argtypes = [
         u8p, i64p, ctypes.c_int64, ctypes.c_int64, u8p, ctypes.c_int32
     ]
+    lib.tddl_window_gather.argtypes = [
+        i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_uint64, i32p, i32p, ctypes.c_int32
+    ]
     _LIB = lib
     return _LIB
 
@@ -220,6 +224,38 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     return out
 
 
+def window_gather(stream: np.ndarray, seq_len: int, batch: int, seed: int,
+                  n_threads: int = 4) -> "tuple[np.ndarray, np.ndarray]":
+    """(inputs i32[batch, seq_len], targets i32[batch, seq_len]): random
+    seq_len+1 windows of a contiguous token stream at splitmix-derived
+    offsets — the nanoGPT-style sampler, multi-threaded memcpy on the
+    native path.  Offsets are O(1) addressable (pure function of
+    (seed, row)), so batches are reproducible and the Python fallback is
+    bit-exact."""
+    stream = np.ascontiguousarray(stream, np.int32)
+    span = len(stream) - seq_len - 1
+    if span <= 0:
+        raise ValueError(
+            f"stream of {len(stream)} tokens too short for seq_len={seq_len}"
+        )
+    lib = _load()
+    if lib is not None and batch:
+        inputs = np.empty((batch, seq_len), np.int32)
+        targets = np.empty((batch, seq_len), np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.tddl_window_gather(
+            stream.ctypes.data_as(i32p), len(stream), seq_len, batch,
+            ctypes.c_uint64(seed),
+            inputs.ctypes.data_as(i32p), targets.ctypes.data_as(i32p),
+            n_threads,
+        )
+        return inputs, targets
+    offs = (splitmix_fill(seed, batch) % np.uint64(span)).astype(np.int64)
+    gather = offs[:, None] + np.arange(seq_len + 1, dtype=np.int64)[None, :]
+    windows = stream[gather]
+    return windows[:, :-1].copy(), windows[:, 1:].copy()
+
+
 __all__ = [
     "build_library",
     "gather_rows",
@@ -227,4 +263,5 @@ __all__ = [
     "permutation",
     "splitmix_fill",
     "synthetic_tokens",
+    "window_gather",
 ]

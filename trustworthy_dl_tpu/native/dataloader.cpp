@@ -106,4 +106,42 @@ void tddl_gather_rows(const uint8_t* src, const int64_t* idx, int64_t n_idx,
   for (auto& t : workers) t.join();
 }
 
+// Random-window sampling over a contiguous token stream (the nanoGPT-style
+// loader): row r of the batch reads seq_len+1 consecutive int32 tokens at
+// offset splitmix64(seed + r*GOLDEN) % (stream_len - seq_len - 1), split
+// into input (first seq_len) and next-token target (last seq_len).
+// Multi-threaded over rows; offsets are O(1) addressable so the Python
+// fallback reproduces them bit-for-bit.
+void tddl_window_gather(const int32_t* stream, int64_t stream_len,
+                        int64_t seq_len, int64_t batch, uint64_t seed,
+                        int32_t* out_inputs, int32_t* out_targets,
+                        int32_t n_threads) {
+  const int64_t span = stream_len - seq_len - 1;
+  if (span <= 0) return;
+  auto work = [=](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      uint64_t u = splitmix64(seed + (uint64_t)r * 0x9E3779B97F4A7C15ULL);
+      int64_t off = (int64_t)(u % (uint64_t)span);
+      std::memcpy(out_inputs + r * seq_len, stream + off,
+                  (size_t)seq_len * sizeof(int32_t));
+      std::memcpy(out_targets + r * seq_len, stream + off + 1,
+                  (size_t)seq_len * sizeof(int32_t));
+    }
+  };
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads == 1 || batch < 64) {
+    work(0, batch);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (batch + n_threads - 1) / n_threads;
+  for (int32_t w = 0; w < n_threads; ++w) {
+    int64_t lo = (int64_t)w * chunk;
+    int64_t hi = lo + chunk < batch ? lo + chunk : batch;
+    if (lo >= hi) break;
+    workers.emplace_back([=]() { work(lo, hi); });
+  }
+  for (auto& t : workers) t.join();
+}
+
 }  // extern "C"
