@@ -114,7 +114,7 @@ def test_window_gather_native_matches_fallback():
     stream = np.arange(10_000, dtype=np.int32) % 997
     a_in, a_tg = native.window_gather(stream, seq_len=32, batch=128, seed=42)
     # Force the fallback path via the internal implementation.
-    offs = (native.splitmix_fill(42, 128) % np.uint64(10_000 - 33)).astype(
+    offs = (native.splitmix_fill(42, 128) % np.uint64(10_000 - 32)).astype(
         np.int64
     )
     gather = offs[:, None] + np.arange(33, dtype=np.int64)[None, :]
@@ -169,3 +169,24 @@ def test_token_stream_loader_no_epoch_step_collision():
     b1_0 = next(it1)["input"]
     assert not any(np.array_equal(b1_0, b) for b in first_epoch[10_000:])
     assert not np.array_equal(b1_0, first_epoch[0])
+
+
+def test_text_file_byte_tier(tmp_path, monkeypatch):
+    """A plain .txt under $TDDL_DATA_DIR trains byte-level: ids are the
+    file's UTF-8 bytes with a 95/5 train/validation split."""
+    from trustworthy_dl_tpu.data import get_dataloader
+
+    text = ("the quick brown fox jumps over the lazy dog. " * 200).encode()
+    (tmp_path / "openwebtext.txt").write_bytes(text)
+    monkeypatch.setenv("TDDL_DATA_DIR", str(tmp_path))
+    dl = get_dataloader("openwebtext", batch_size=4, seq_len=32,
+                        num_examples=16)
+    batch = next(iter(dl))
+    assert batch["input"].shape == (4, 32)
+    assert batch["input"].max() < 256 and batch["input"].min() >= 0
+    np.testing.assert_array_equal(batch["input"][:, 1:],
+                                  batch["target"][:, :-1])
+    # windows sampling rides the same stream
+    wdl = get_dataloader("openwebtext", batch_size=4, seq_len=32,
+                         num_examples=16, sampling="windows")
+    assert next(iter(wdl))["input"].shape == (4, 32)

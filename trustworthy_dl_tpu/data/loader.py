@@ -226,18 +226,36 @@ def get_dataloader(
     "windows" draws fresh random windows every batch — the nanoGPT-style
     sampler via the native gather, better coverage on real corpora)."""
     name = dataset_name.lower()
+    if sampling not in ("epoch", "windows"):
+        raise ValueError(
+            f"sampling must be 'epoch' or 'windows', got {sampling!r}"
+        )
     data_dir = data_dir or os.environ.get("TDDL_DATA_DIR", "")
     split_seed = seed + (0 if split == "train" else 10_000)
 
     if name in ("openwebtext", "wikitext", "lm", "synthetic_lm"):
         n = num_examples or (2048 if split == "train" else 256)
         bin_path = os.path.join(data_dir, f"{name}.bin") if data_dir else ""
+        txt_path = os.path.join(data_dir, f"{name}.txt") if data_dir else ""
         if bin_path and os.path.exists(bin_path):
             tokens = np.memmap(bin_path, dtype=np.uint16, mode="r")
             # Hold out the final 5% for validation.
             cut = int(len(tokens) * 0.95)
             tokens = tokens[:cut] if split == "train" else tokens[cut:]
             tokens = np.asarray(tokens, np.int32)
+        elif txt_path and os.path.exists(txt_path):
+            # Byte-level tier: any plain-text corpus trains without a
+            # tokenizer — ids are raw UTF-8 bytes (256 ≤ every GPT vocab).
+            if vocab_size < 256:
+                raise ValueError(
+                    f"byte-level corpus {txt_path} needs vocab_size >= 256 "
+                    f"(got {vocab_size}): byte ids would exceed the "
+                    "embedding table"
+                )
+            raw = np.fromfile(txt_path, dtype=np.uint8)
+            cut = int(len(raw) * 0.95)
+            tokens = np.asarray(raw[:cut] if split == "train" else raw[cut:],
+                                np.int32)
         else:
             tokens = _synthetic_tokens(n * (seq_len + 1) + 1,
                                        min(vocab_size, 512), split_seed)
@@ -256,6 +274,11 @@ def get_dataloader(
                                seed=split_seed)
 
     if name in ("cifar10", "cifar-10", "cifar100", "imagenet", "synthetic_vision"):
+        if sampling == "windows":
+            raise ValueError(
+                "sampling='windows' is a token-stream sampler; vision "
+                "datasets use epoch sampling"
+            )
         num_classes = 100 if "100" in name else (1000 if "imagenet" in name else 10)
         shape = (224, 224, 3) if "imagenet" in name else (32, 32, 3)
         n = num_examples or (2048 if split == "train" else 512)

@@ -104,6 +104,7 @@ class DistributedTrainer:
             tensorboard_dir=config.tensorboard_dir
         )
         self._warned_trim = False
+        self._trimmed_sizes: set = set()
 
         # Node configurations (reference: :85-87).  On TPU, rank == mesh
         # coordinate along the node axis.
@@ -357,19 +358,20 @@ class DistributedTrainer:
                     f"batch size {arr.shape[0]} < num_nodes x "
                     f"grad_accum_steps = {n * accum}"
                 )
-            if b < arr.shape[0] and arr.shape[0] == self.config.batch_size \
-                    and not self._warned_trim:
-                # Once per trainer, and only when a FULL configured batch
-                # is trimmed: that means the batch size itself never
-                # divides nodes×accum and data is dropped every step.
-                # A ragged final batch (smaller than configured) is the
-                # loader's normal drop_last=False tail and stays silent.
-                self._warned_trim = True
-                logger.warning(
-                    "batch of %d trimmed to %d (num_nodes=%d x "
-                    "grad_accum_steps=%d); pick a divisible batch size to "
-                    "avoid dropping examples", arr.shape[0], b, n, accum,
-                )
+            if b < arr.shape[0] and not self._warned_trim:
+                # A single ragged batch (drop_last=False tail) is normal
+                # and stays silent; the SAME size being trimmed twice
+                # means the loader's batch size never divides nodes×accum
+                # and data is dropped every step — warn once per trainer.
+                if arr.shape[0] in self._trimmed_sizes:
+                    self._warned_trim = True
+                    logger.warning(
+                        "batches of %d are persistently trimmed to %d "
+                        "(num_nodes=%d x grad_accum_steps=%d); pick a "
+                        "divisible batch size to avoid dropping examples",
+                        arr.shape[0], b, n, accum,
+                    )
+                self._trimmed_sizes.add(arr.shape[0])
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(
                 zip(self.mesh.axis_names, self.mesh.devices.shape)

@@ -233,7 +233,9 @@ def window_gather(stream: np.ndarray, seq_len: int, batch: int, seed: int,
     (seed, row)), so batches are reproducible and the Python fallback is
     bit-exact."""
     stream = np.ascontiguousarray(stream, np.int32)
-    span = len(stream) - seq_len - 1
+    # A window consumes seq_len+1 tokens, so valid offsets are
+    # [0, len - seq_len - 1] — span = len - seq_len of them.
+    span = len(stream) - seq_len
     if span <= 0:
         raise ValueError(
             f"stream of {len(stream)} tokens too short for seq_len={seq_len}"

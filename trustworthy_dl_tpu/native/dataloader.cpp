@@ -116,7 +116,9 @@ void tddl_window_gather(const int32_t* stream, int64_t stream_len,
                         int64_t seq_len, int64_t batch, uint64_t seed,
                         int32_t* out_inputs, int32_t* out_targets,
                         int32_t n_threads) {
-  const int64_t span = stream_len - seq_len - 1;
+  // A window consumes seq_len+1 tokens: valid offsets are
+  // [0, stream_len - seq_len - 1], span = stream_len - seq_len of them.
+  const int64_t span = stream_len - seq_len;
   if (span <= 0) return;
   auto work = [=](int64_t lo, int64_t hi) {
     for (int64_t r = lo; r < hi; ++r) {
