@@ -166,6 +166,43 @@ def bench_longctx() -> None:
                     f"{type(exc).__name__}: {str(exc)[:120]}")
 
 
+def bench_generate() -> None:
+    """Optional decode benchmark (TDDL_BENCH_GEN=1): KV-cache generation
+    throughput on the full GPT-2, batch x new-token grid.  Diagnostics
+    only — stderr."""
+    import jax
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.models.generate import generate
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_GEN_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    prompt_len, new = 32, int(os.environ.get("TDDL_BENCH_GEN_NEW", "128"))
+    reps = 4
+    for batch in (1, 8, 32):
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, prompt_len), 0, cfg.vocab_size)
+        out = generate(params, cfg, prompt, new, temperature=0.8, top_k=40)
+        out.block_until_ready()  # compile
+        # Chain: each call's prompt is the previous call's tail, so the
+        # remote tunnel cannot serve cached/overlapped executions (the
+        # same trick bench_longctx uses — unchained timings here once
+        # read 1000x too fast).
+        cur = prompt
+        t0 = time.perf_counter()
+        for i in range(reps):
+            full = generate(params, cfg, cur, new, temperature=0.8,
+                            top_k=40, rng=jax.random.PRNGKey(i))
+            cur = full[:, -prompt_len:]
+        cur.block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        log(f"generate b={batch:3d}: {new} new tokens in {dt * 1e3:7.1f} ms "
+            f"({batch * new / dt:,.0f} tok/s, "
+            f"{dt / new * 1e3:.2f} ms/token)")
+
+
 def main() -> None:
     model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
     num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
@@ -234,6 +271,8 @@ def main() -> None:
 
     if os.environ.get("TDDL_BENCH_LONGCTX") == "1":
         bench_longctx()
+    if os.environ.get("TDDL_BENCH_GEN") == "1":
+        bench_generate()
 
     print(json.dumps({
         "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"

@@ -252,7 +252,7 @@ def get_dataloader(
                     f"(got {vocab_size}): byte ids would exceed the "
                     "embedding table"
                 )
-            raw = np.fromfile(txt_path, dtype=np.uint8)
+            raw = np.memmap(txt_path, dtype=np.uint8, mode="r")
             cut = int(len(raw) * 0.95)
             tokens = np.asarray(raw[:cut] if split == "train" else raw[cut:],
                                 np.int32)

@@ -348,30 +348,31 @@ class DistributedTrainer:
         n = self.config.num_nodes
         out = {}
         accum = max(self.config.grad_accum_steps, 1)
-        for key, arr in batch.items():
-            # Trim ragged batches (drop_last=False loaders) to a multiple
-            # of nodes × accumulation steps — same trimming contract as
-            # the node split and the pipeline microbatch branch.
-            b = (arr.shape[0] // (n * accum)) * n * accum
-            if b == 0:
-                raise ValueError(
-                    f"batch size {arr.shape[0]} < num_nodes x "
-                    f"grad_accum_steps = {n * accum}"
+        # Trim ragged batches (drop_last=False loaders) to a multiple of
+        # nodes × accumulation steps — same trimming contract as the node
+        # split and the pipeline microbatch branch.  Trim bookkeeping runs
+        # once per BATCH (input/target share the leading size), keyed on
+        # the size: a single ragged tail is normal and stays silent, the
+        # same size trimmed on a second batch means the loader's batch
+        # size never divides nodes×accum — warn once per trainer.
+        lead = min(arr.shape[0] for arr in batch.values())
+        b = (lead // (n * accum)) * n * accum
+        if b == 0:
+            raise ValueError(
+                f"batch size {lead} < num_nodes x grad_accum_steps = "
+                f"{n * accum}"
+            )
+        if b < lead and not self._warned_trim:
+            if lead in self._trimmed_sizes:
+                self._warned_trim = True
+                logger.warning(
+                    "batches of %d are persistently trimmed to %d "
+                    "(num_nodes=%d x grad_accum_steps=%d); pick a "
+                    "divisible batch size to avoid dropping examples",
+                    lead, b, n, accum,
                 )
-            if b < arr.shape[0] and not self._warned_trim:
-                # A single ragged batch (drop_last=False tail) is normal
-                # and stays silent; the SAME size being trimmed twice
-                # means the loader's batch size never divides nodes×accum
-                # and data is dropped every step — warn once per trainer.
-                if arr.shape[0] in self._trimmed_sizes:
-                    self._warned_trim = True
-                    logger.warning(
-                        "batches of %d are persistently trimmed to %d "
-                        "(num_nodes=%d x grad_accum_steps=%d); pick a "
-                        "divisible batch size to avoid dropping examples",
-                        arr.shape[0], b, n, accum,
-                    )
-                self._trimmed_sizes.add(arr.shape[0])
+            self._trimmed_sizes.add(lead)
+        for key, arr in batch.items():
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(
                 zip(self.mesh.axis_names, self.mesh.devices.shape)
