@@ -1,0 +1,266 @@
+"""Engine-driven recovery / readmission (VERDICT r2 item 3).
+
+The reference exposes ``initiate_recovery`` (trust_manager.py:198-206) and a
+COMPROMISED→RECOVERING→TRUSTED ladder (:162-181) but no code path ever calls
+it.  Here both halves are wired into the engine:
+
+* in-step probation (`trust/state.py:probation_recovery`): a hard-gated node
+  with ``recovery_probation_steps`` consecutive clean steps transitions to
+  RECOVERING (boosted 0.02 recovery rate) and its aggregation weight
+  returns — a transient attack / false positive costs bounded steps;
+* elastic readmission (`elastic/reassignment.py:readmit_and_reshard`): an
+  evicted mesh coordinate is restored after ``readmit_after_steps``, with
+  fresh detector baselines and probation trust; a still-hostile node is
+  re-detected and re-evicted.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker, \
+    null_plan
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.trust.state import NodeStatus
+
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
+TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
+                n_positions=32, seq_len=16)
+
+
+def make_trainer(tmp_path, num_nodes=4, **kw):
+    kw.setdefault("detector_warmup", 4)
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext",
+        batch_size=2 * num_nodes, num_nodes=num_nodes,
+        learning_rate=3e-3, checkpoint_interval=10_000,
+        checkpoint_dir=str(tmp_path / "ckpt"), **kw,
+    )
+    return DistributedTrainer(config, model_overrides=dict(TINY_GPT))
+
+
+def test_probation_recovery_after_transient_attack(tmp_path):
+    """Transient attack: node 1 is detected and hard-gated; once the attack
+    ends, the probation path readmits it — RECOVERING appears in its status
+    trajectory, the aggregation weight returns, and it ends TRUSTED."""
+    trainer = make_trainer(tmp_path, num_nodes=4,
+                           recovery_probation_steps=2)
+    trainer.initialize()
+    batch = trainer._node_batch(trainer.model.example_batch(8))
+
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[1],
+        intensity=0.5, start_step=6,
+    ))
+    attacker.activate_attacks()
+    plan = attacker.plan(4)
+
+    state = trainer.state
+    gated = False
+    for _ in range(14):
+        state, metrics = trainer._train_step(state, batch, plan)
+        status = np.asarray(metrics.status)
+        if status[1] == int(NodeStatus.COMPROMISED):
+            gated = True
+            assert float(np.asarray(metrics.weights)[1]) == 0.0
+    assert gated, "attacked node was never confirmed-compromised"
+
+    # Attack ends; the node's evidence is clean again.
+    clean = null_plan(4)
+    statuses, weights = [], []
+    for _ in range(30):
+        state, metrics = trainer._train_step(state, batch, clean)
+        statuses.append(int(np.asarray(metrics.status)[1]))
+        weights.append(float(np.asarray(metrics.weights)[1]))
+
+    assert int(NodeStatus.RECOVERING) in statuses, \
+        f"probation never fired; trajectory {statuses}"
+    assert statuses[-1] == int(NodeStatus.TRUSTED)
+    assert weights[-1] > 0.0
+    # Boosted recovery rate per initiate_recovery semantics.
+    assert float(np.asarray(state.trust.recovery_rate)[1]) == \
+        pytest.approx(0.02)
+    # Readmission is bounded: the weight must return well before the end.
+    first_back = next(i for i, w in enumerate(weights) if w > 0)
+    assert first_back <= 10
+    # Clean nodes were never disturbed.
+    for node in (0, 2, 3):
+        assert statuses and int(np.asarray(state.trust.status)[node]) == \
+            int(NodeStatus.TRUSTED)
+
+
+def test_probation_does_not_readmit_sustained_attacker(tmp_path):
+    """A node under SUSTAINED attack accrues no clean streak: it stays
+    gated for the whole run even with a short probation."""
+    trainer = make_trainer(tmp_path, num_nodes=4,
+                           recovery_probation_steps=2)
+    trainer.initialize()
+    batch = trainer._node_batch(trainer.model.example_batch(8))
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[1],
+        intensity=0.5, start_step=6,
+    ))
+    attacker.activate_attacks()
+    plan = attacker.plan(4)
+
+    state = trainer.state
+    confirmed_at = None
+    for i in range(30):
+        state, metrics = trainer._train_step(state, batch, plan)
+        if confirmed_at is None and np.asarray(metrics.attacked)[1]:
+            confirmed_at = i
+        if confirmed_at is not None and i > confirmed_at:
+            assert float(np.asarray(metrics.weights)[1]) == 0.0
+            assert int(np.asarray(metrics.status)[1]) == \
+                int(NodeStatus.COMPROMISED)
+    assert confirmed_at is not None
+    assert int(np.asarray(state.clean_streak)[1]) == 0
+
+
+def test_readmission_restores_evicted_coordinate(tmp_path):
+    """Eviction → cool-off → readmission: the mesh grows back to 8
+    coordinates, the readmitted identity re-enters on probation, and
+    training continues finite."""
+    trainer = make_trainer(
+        tmp_path, num_nodes=8, elastic_resharding=True,
+        readmit_after_steps=8,
+    )
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[5],
+        intensity=0.5, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+
+    epoch = 0
+    while trainer.config.num_nodes == 8 and epoch < 4:
+        loss0 = trainer.train_epoch(dl, epoch)
+        epoch += 1
+    assert trainer.config.num_nodes == 7, "eviction did not happen"
+    assert 5 in trainer._evicted_at
+
+    # Attack over: clear the schedule so the readmitted node behaves.
+    trainer.set_attack_plan(null_plan(7))
+    while trainer.config.num_nodes == 7 and epoch < 8:
+        loss1 = trainer.train_epoch(dl, epoch)
+        epoch += 1
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+
+    assert trainer.config.num_nodes == 8
+    assert trainer.node_map[-1] == 5
+    assert trainer.state.trust.scores.shape == (8,)
+    assert 5 not in trainer._evicted_at
+    readmits = [r for r in trainer.reassignment_history
+                if "readmitted_nodes" in r]
+    assert len(readmits) == 1 and readmits[0]["readmitted_nodes"] == [5]
+    # Probation standing: boosted recovery rate on the readmitted row.
+    coord = trainer.node_map.index(5)
+    assert float(np.asarray(trainer.state.trust.recovery_rate)[coord]) == \
+        pytest.approx(0.02)
+    # Host mirror is no longer hard-compromised.
+    assert trainer.trust_manager.get_node_status(5) != NodeStatus.COMPROMISED
+    # Fresh detector rows: the readmitted coordinate re-warms.
+    assert int(np.asarray(trainer.state.out_baseline.count)[coord]) < \
+        int(np.asarray(trainer.state.out_baseline.count)[0])
+
+    # Training continues on the full fleet.
+    loss2 = trainer.train_epoch(dl, epoch)
+    assert np.isfinite(loss2)
+
+
+def test_readmitted_attacker_is_re_evicted(tmp_path):
+    """A readmitted node still in the attack schedule attacks again and is
+    evicted a second time — probation does not whitewash hostility."""
+    trainer = make_trainer(
+        tmp_path, num_nodes=8, elastic_resharding=True,
+        readmit_after_steps=6,
+    )
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[5],
+        intensity=0.5, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+
+    for epoch in range(6):
+        trainer.train_epoch(dl, epoch)
+        evictions = [r for r in trainer.reassignment_history
+                     if "evicted_nodes" in r]
+        if len(evictions) >= 2:
+            break
+
+    evictions = [r for r in trainer.reassignment_history
+                 if "evicted_nodes" in r and r["evicted_nodes"] == [5]]
+    readmits = [r for r in trainer.reassignment_history
+                if "readmitted_nodes" in r]
+    assert len(evictions) >= 2, (
+        f"expected re-eviction; history {trainer.reassignment_history}"
+    )
+    assert len(readmits) >= 1
+    assert trainer.config.num_nodes == 7
+
+
+def test_loader_resized_after_eviction(tmp_path):
+    """VERDICT r2 weak #6: after eviction the live loader's batch size is
+    rebuilt to divide nodes × accum — no persistent trimming, no dropped
+    samples, no warning."""
+    trainer = make_trainer(
+        tmp_path, num_nodes=8, elastic_resharding=True,
+    )
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[5],
+        intensity=0.5, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+
+    epoch = 0
+    while trainer.config.num_nodes == 8 and epoch < 4:
+        trainer.train_epoch(dl, epoch)
+        epoch += 1
+    assert trainer.config.num_nodes == 7
+    # The raw loader was re-sized to 7 nodes x 2/node.
+    assert dl.batch_size == 14
+    assert trainer.config.batch_size == 14
+    trainer.train_epoch(dl, epoch)
+    assert not trainer._warned_trim
+
+
+def test_host_detection_stats_reflect_ground_truth(tmp_path):
+    """VERDICT r2 weak #5: the host detector's TP/FP rates are fed from
+    injection ground truth — a detected real attack counts as a true
+    positive, so get_detection_statistics() no longer reports 0.0."""
+    trainer = make_trainer(tmp_path, num_nodes=4)
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=48)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[1],
+        intensity=0.5, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(4))
+    for epoch in range(3):
+        trainer.train_epoch(dl, epoch)
+
+    assert {r["node_id"] for r in trainer.attack_history} == {1}
+    stats = trainer.attack_detector.get_detection_statistics()
+    assert stats["total_detections"] >= 1
+    assert stats["true_positive_rate"] == 1.0
+    assert stats["false_positive_rate"] == 0.0
+    assert sum(stats["attack_type_distribution"].values()) == \
+        stats["total_detections"]

@@ -1,0 +1,151 @@
+"""Pipeline-stage restaffing (elastic/restaff.py) — VERDICT r2 item 1.
+
+The reference's headline capability on its own parallelism mode
+(distributed_trainer.py:324-380) made real: a confirmed-compromised stage's
+layer shard migrates to trusted hardware via repartition, and EVERY layer
+keeps training — not the freeze+relabel no-op."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+from trustworthy_dl_tpu.elastic.restaff import (
+    choose_stage_count,
+    restack_blocks,
+)
+from trustworthy_dl_tpu.parallel.pipeline import unstack_stages
+
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
+TINY = dict(n_layer=8, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+
+
+def test_choose_stage_count():
+    assert choose_stage_count(8, 7) == 4
+    assert choose_stage_count(12, 5) == 4
+    assert choose_stage_count(12, 7) == 6
+    assert choose_stage_count(6, 2) == 2
+    assert choose_stage_count(7, 6) == 1  # prime layer count: single stage
+
+
+def test_restack_preserves_layer_order():
+    blocks = {"w": jnp.arange(8 * 3 * 2, dtype=jnp.float32).reshape(8, 1, 3, 2)}
+    restacked = restack_blocks(blocks, 4)
+    assert restacked["w"].shape == (4, 2, 3, 2)
+    np.testing.assert_array_equal(
+        np.asarray(unstack_stages(restacked)["w"]),
+        np.asarray(unstack_stages(blocks)["w"]),
+    )
+
+
+@pytest.fixture(scope="module")
+def restaffed_run(tmp_path_factory):
+    """8-stage pipeline, stage 5 poisoned at step 8 with elastic
+    resharding ON: the stage is confirmed and the model repartitions onto
+    trusted survivors."""
+    tmp_path = tmp_path_factory.mktemp("restaff")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_epochs=1, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4, elastic_resharding=True,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=64)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[5],
+                     intensity=0.5, start_step=8)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+
+    losses, epoch = [], 0
+    while trainer.config.num_nodes == 8 and epoch < 4:
+        losses.append(trainer.train_epoch(dl, epoch))
+        epoch += 1
+    # Post-restaff blocks snapshot, then keep training.
+    post_restaff_blocks = jax.tree_util.tree_map(
+        np.asarray, trainer.state.params["blocks"]
+    )
+    losses.append(trainer.train_epoch(dl, epoch))
+    losses.append(trainer.train_epoch(dl, epoch + 1))
+    return trainer, losses, post_restaff_blocks
+
+
+def test_restaff_repartitions_all_layers(restaffed_run):
+    trainer, losses, _ = restaffed_run
+    records = [r for r in trainer.reassignment_history
+               if "new_num_stages" in r]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["evicted_nodes"] == [5]
+    assert rec["old_num_stages"] == 8
+    assert rec["new_num_stages"] == 4      # largest divisor of 8 ≤ 7
+    assert rec["new_num_stages"] * rec["layers_per_stage"] == TINY["n_layer"]
+    assert rec["bytes_moved"] > 0 and rec["migration_time_s"] > 0
+    assert trainer.config.num_nodes == 4
+    assert 5 not in trainer.node_map
+    assert len(trainer.node_map) == 4
+    # The blocks really are [4, 2, ...] now.
+    lead = jax.tree_util.tree_leaves(trainer.state.params["blocks"])[0]
+    assert lead.shape[:2] == (4, 2)
+    # Stage-state shapes follow.
+    assert trainer.state.trust.scores.shape == (4,)
+    assert trainer.state.canary.prev.shape[0] == 4
+    assert np.isfinite(losses).all()
+
+
+def test_restaff_all_layers_keep_training(restaffed_run):
+    """The core claim: after restaffing, EVERY layer's params change —
+    including the layers that belonged to the evicted stage (the reference
+    froze or dropped them)."""
+    trainer, losses, before = restaffed_run
+    after = jax.tree_util.tree_map(np.asarray,
+                                   trainer.state.params["blocks"])
+    b = unstack_stages(before)
+    a = unstack_stages(after)
+    leaf_b = jax.tree_util.tree_leaves(b)
+    leaf_a = jax.tree_util.tree_leaves(a)
+    # Per-layer L2 delta of every leaf: all strictly positive.
+    for x, y in zip(leaf_b, leaf_a):
+        deltas = np.sqrt(((y - x) ** 2).reshape(x.shape[0], -1).sum(axis=1))
+        assert (deltas > 0).all(), deltas
+    # Loss keeps improving after the repartition.
+    assert losses[-1] < losses[0]
+
+
+def test_restaff_clean_survivors_keep_trust(restaffed_run):
+    trainer, _, _ = restaffed_run
+    # Host standing: node 5 compromised, survivors healthy.
+    from trustworthy_dl_tpu.trust.state import NodeStatus
+
+    assert trainer.trust_manager.get_node_status(5) == NodeStatus.COMPROMISED
+    for nid in trainer.node_map:
+        assert trainer.trust_manager.get_trust_score(nid) > 0.5
+    # Device column count shrank (8 one-device stages -> 4).
+    assert len(list(trainer.mesh.devices.flat)) == 4
+
+
+def test_restaff_device_column_drop():
+    """Unit: the evicted stage's device column leaves; survivors keep
+    their column order."""
+    from trustworthy_dl_tpu.core.mesh import build_mesh
+
+    devices = jax.devices()[:8]
+    mesh = build_mesh(8, "model", devices=devices)
+    assert mesh.devices.shape[-1] == 8
+    grid = mesh.devices.reshape(-1, 8)
+    keep = [c for c in range(8) if c != 5]
+    survivors = list(grid[:, keep].reshape(-1))
+    assert len(survivors) == 7
+    assert grid[0, 5] not in survivors

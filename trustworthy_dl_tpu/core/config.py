@@ -142,6 +142,19 @@ class TrainingConfig:
     # already neutralises the node immediately; eviction additionally
     # reclaims its device at the cost of a recompile.
     elastic_resharding: bool = False
+    # Recovery / readmission (trust_manager.py:198-206 semantics, wired
+    # into the engine — the reference exposed initiate_recovery but no
+    # path ever called it).  A confirmed-compromised (hard-gated, NOT
+    # evicted) node that produces this many consecutive clean steps
+    # transitions COMPROMISED -> RECOVERING in-step (boosted recovery
+    # rate, weight restored); 0 disables the probation path.
+    recovery_probation_steps: int = 25
+    # Elastic-readmission: an evicted mesh coordinate is re-admitted
+    # (device restored to the mesh, fresh detector rows, RECOVERING
+    # status) this many steps after its eviction.  0 disables — an
+    # eviction is then permanent, and a false positive costs 1/n of the
+    # fleet for the rest of the run.
+    readmit_after_steps: int = 0
     # Optimizer
     optimizer: str = "adamw"
     weight_decay: float = 0.0

@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -41,6 +42,59 @@ logger = logging.getLogger(__name__)
 def _tree_bytes(tree: Any) -> int:
     return sum(leaf.size * leaf.dtype.itemsize
                for leaf in jax.tree_util.tree_leaves(tree))
+
+
+# TrainState fields whose arrays carry a per-node leading axis.  ONE list —
+# eviction compaction, readmission expansion, and every migration below
+# iterate it, so a new per-node field is added here (and in the compaction
+# /expansion surgeries) exactly once.
+PER_NODE_FIELDS = ("trust", "out_baseline", "grad_baseline", "verifier",
+                   "monitor", "prev_suspects", "clean_streak")
+
+
+def row_placer(mesh: jax.sharding.Mesh, axis: str, n: int):
+    """The ONE per-node placement rule shared by eviction, readmission and
+    stage restaff: a leaf whose leading axis is the node count shards over
+    ``axis`` (when the mesh carries it evenly), everything else
+    replicates.  Returns (place_row, replicated_sharding)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axis_size = sizes.get(axis, 1)
+    repl = NamedSharding(mesh, P())
+
+    def place_row(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n and \
+                axis_size > 1 and n % axis_size == 0:
+            spec = P(axis, *([None] * (leaf.ndim - 1)))
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(leaf, repl)
+
+    return place_row, repl
+
+
+def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
+                  n: int, shard_opt: bool) -> TrainState:
+    """Place a (compacted or expanded) TrainState onto ``mesh``: per-node
+    rows shard over ``axis``, params/opt/scalars replicate (opt optionally
+    ZeRO-1-sharded over the data axis)."""
+    place_row, repl = row_placer(mesh, axis, n)
+    per_node = {
+        k: jax.tree_util.tree_map(place_row, getattr(state, k))
+        for k in PER_NODE_FIELDS
+    }
+    shared = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, repl),
+        {"params": state.params, "step": state.step,
+         "epoch": state.epoch, "rng": state.rng},
+    )
+    if shard_opt:
+        from trustworthy_dl_tpu.engine.state import zero1_place_opt_state
+
+        shared["opt_state"] = zero1_place_opt_state(state.opt_state, mesh)
+    else:
+        shared["opt_state"] = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, repl), state.opt_state
+        )
+    return state._replace(**per_node, **shared)
 
 
 def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
@@ -89,6 +143,7 @@ def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
         verifier=verifier,
         monitor=monitor,
         prev_suspects=take(state.prev_suspects),
+        clean_streak=take(state.clean_streak),
     )
 
 
@@ -127,6 +182,14 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         raise ValueError("cannot evict every node")
 
     t0 = time.perf_counter()
+    # Remember each evicted coordinate's device so a later readmission
+    # (readmit_and_reshard) can restore it to the mesh.  In dev mode
+    # (logical nodes vmapped within fewer devices) no device leaves.
+    old_devices = list(trainer.mesh.devices.flat)
+    for i in drop:
+        trainer._evicted_devices[trainer.node_map[i]] = (
+            old_devices[i] if len(old_devices) == n else None
+        )
     new_devices = surviving_devices(trainer.mesh, n, drop)
     new_mesh = build_mesh(len(keep), "data", devices=new_devices)
     new_config = dataclasses.replace(config, num_nodes=len(keep))
@@ -136,42 +199,12 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     # Migrate onto the new mesh: per-node arrays shard over the surviving
     # data axis; everything else replicates.  This is the device_put
     # migration the reference's no-op claimed to do.
-    mesh_axis = dict(zip(new_mesh.axis_names, new_mesh.devices.shape))
-    data_size = mesh_axis.get(DATA_AXIS, 1)
-    replicated = NamedSharding(new_mesh, P())
-
-    def shard_per_node(leaf):
-        if leaf.ndim >= 1 and leaf.shape[0] == len(keep) and \
-                data_size > 1 and len(keep) % data_size == 0:
-            spec = P(DATA_AXIS, *([None] * (leaf.ndim - 1)))
-            return jax.device_put(leaf, NamedSharding(new_mesh, spec))
-        return jax.device_put(leaf, replicated)
-
-    per_node_fields = dict(
-        trust=compact.trust, out_baseline=compact.out_baseline,
-        grad_baseline=compact.grad_baseline, verifier=compact.verifier,
-        monitor=compact.monitor, prev_suspects=compact.prev_suspects,
+    data_size = dict(zip(new_mesh.axis_names,
+                         new_mesh.devices.shape)).get(DATA_AXIS, 1)
+    new_state = migrate_state(
+        compact, new_mesh, DATA_AXIS, len(keep),
+        shard_opt=config.shard_opt_state and data_size > 1,
     )
-    migrated_nodes = {
-        k: jax.tree_util.tree_map(shard_per_node, v)
-        for k, v in per_node_fields.items()
-    }
-    migrated_shared = jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, replicated),
-        {"params": compact.params,
-         "step": compact.step, "epoch": compact.epoch, "rng": compact.rng},
-    )
-    if config.shard_opt_state and data_size > 1:
-        from trustworthy_dl_tpu.engine.state import zero1_place_opt_state
-
-        migrated_shared["opt_state"] = zero1_place_opt_state(
-            compact.opt_state, new_mesh
-        )
-    else:
-        migrated_shared["opt_state"] = jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, replicated), compact.opt_state
-        )
-    new_state = compact._replace(**migrated_nodes, **migrated_shared)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
 
@@ -213,5 +246,156 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         "device(s); migrated %.1f MB in %.3fs (%.2f GB/s)",
         evicted_ids, len(keep), len(new_devices), bytes_moved / 2**20,
         migration_time, measured_gbps,
+    )
+    return record
+
+
+def expand_train_state(state: TrainState, num_new: int,
+                       now: float,
+                       decay_rate: float,
+                       readmit_trust: float = 0.5) -> TrainState:
+    """Append ``num_new`` fresh per-node rows to every per-node array of the
+    training world-view — the state surgery behind readmission.
+
+    Readmitted rows start in probation: trust at ``readmit_trust`` with
+    RECOVERING status and the boosted 0.02 recovery rate
+    (``initiate_recovery`` semantics, trust_manager.py:198-206), empty
+    detector baselines/verifier/monitor (fresh warmup — their old history
+    described a poisoned node), no suspicion carry-over."""
+    from trustworthy_dl_tpu.trust.state import METRIC_DEFAULTS, NodeStatus
+
+    r = num_new
+
+    def app(leaf, fill=0):
+        fresh = jnp.full((r,) + leaf.shape[1:], fill, leaf.dtype)
+        return jnp.concatenate([jnp.asarray(leaf), fresh], axis=0)
+
+    trust = state.trust._replace(
+        scores=app(state.trust.scores, readmit_trust),
+        status=app(state.trust.status, int(NodeStatus.RECOVERING)),
+        update_count=app(state.trust.update_count),
+        last_updated=app(state.trust.last_updated, now),
+        decay_rate=app(state.trust.decay_rate, decay_rate),
+        recovery_rate=app(state.trust.recovery_rate, 0.02),
+        metrics=jnp.concatenate(
+            [jnp.asarray(state.trust.metrics),
+             jnp.tile(METRIC_DEFAULTS[None, :], (r, 1))], axis=0
+        ),
+        attack_count=app(state.trust.attack_count),
+    )
+    out_bl = state.out_baseline._replace(
+        ring=app(state.out_baseline.ring),
+        count=app(state.out_baseline.count),
+    )
+    grad_bl = state.grad_baseline._replace(
+        ring=app(state.grad_baseline.ring),
+        count=app(state.grad_baseline.count),
+    )
+    verifier = state.verifier._replace(
+        count=app(state.verifier.count),
+        mean=app(state.verifier.mean),
+        m2=app(state.verifier.m2),
+    )
+    monitor = MonitorState(
+        count=app(state.monitor.count),
+        out_mean_avg=app(state.monitor.out_mean_avg),
+        out_std_avg=app(state.monitor.out_std_avg),
+        grad_norm_avg=app(state.monitor.grad_norm_avg),
+    )
+    return state._replace(
+        trust=trust,
+        out_baseline=out_bl,
+        grad_baseline=grad_bl,
+        verifier=verifier,
+        monitor=monitor,
+        prev_suspects=app(state.prev_suspects),
+        clean_streak=app(state.clean_streak),
+    )
+
+
+def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
+    """Re-admit evicted ORIGINAL node ids: restore their devices to the
+    mesh, append probation state rows (see expand_train_state), re-jit.
+
+    This is the missing half of elasticity: without it an eviction — even a
+    false positive — permanently costs 1/n of the fleet.  The readmitted
+    coordinate re-enters RECOVERING with fresh detector baselines; if it is
+    still hostile, the cross-sectional checks (which need no history) and
+    the post-warmup batteries evict it again."""
+    from trustworthy_dl_tpu.engine.step import build_eval_step, \
+        build_train_step
+
+    config = trainer.config
+    if config.parallelism != "data":
+        raise NotImplementedError(
+            "elastic readmission follows eviction: data parallelism only"
+        )
+    node_ids = [int(i) for i in node_ids]
+    unknown = [i for i in node_ids if i not in trainer._evicted_devices]
+    if unknown:
+        raise ValueError(f"nodes {unknown} were never evicted")
+    n_old = config.num_nodes
+    n_new = n_old + len(node_ids)
+
+    t0 = time.perf_counter()
+    devices = list(trainer.mesh.devices.flat)
+    for nid in node_ids:
+        dev = trainer._evicted_devices[nid]
+        if dev is not None:
+            devices.append(dev)
+    new_mesh = build_mesh(n_new, "data", devices=devices)
+    new_config = dataclasses.replace(config, num_nodes=n_new)
+
+    now = float(trainer.state.step) * config.time_per_step
+    expanded = expand_train_state(
+        trainer.state, len(node_ids), now=now,
+        decay_rate=config.trust_decay_rate,
+    )
+
+    data_size = dict(zip(new_mesh.axis_names,
+                         new_mesh.devices.shape)).get(DATA_AXIS, 1)
+    new_state = migrate_state(
+        expanded, new_mesh, DATA_AXIS, n_new,
+        shard_opt=config.shard_opt_state and data_size > 1,
+    )
+    jax.block_until_ready(new_state)
+    migration_time = time.perf_counter() - t0
+
+    trainer.mesh = new_mesh
+    trainer.config = new_config
+    trainer._train_step = jax.jit(
+        build_train_step(trainer.model, new_config, trainer.optimizer),
+        donate_argnums=(0,),
+    )
+    trainer._eval_step = jax.jit(build_eval_step(trainer.model))
+    trainer.state = new_state
+    trainer.node_map = list(trainer.node_map) + node_ids
+    # Rebuild the injection mask from original identities: a readmitted
+    # node that is still in the experiment's target set will attack again
+    # and be re-evicted — the probation does not whitewash it.
+    bits = np.array(
+        [bool(trainer._plan_bits.get(nid, False))
+         for nid in trainer.node_map], bool,
+    )
+    trainer.attack_plan = trainer.attack_plan._replace(
+        target_mask=jnp.asarray(bits)
+    )
+
+    for nid in node_ids:
+        trainer._evicted_devices.pop(nid, None)
+        trainer._evicted_at.pop(nid, None)
+        trainer._open_incidents.discard(nid)
+        trainer.trust_manager.initiate_recovery(nid)
+
+    record = {
+        "readmitted_nodes": node_ids,
+        "all_nodes": list(trainer.node_map),
+        "migration_time_s": migration_time,
+        "new_device_count": len(devices),
+        "timestamp": time.time(),
+    }
+    logger.warning(
+        "Elastic readmission: nodes %s restored on probation; %d "
+        "coordinates on %d device(s)", node_ids, n_new, len(devices),
     )
     return record

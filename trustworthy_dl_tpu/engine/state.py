@@ -86,6 +86,10 @@ class TrainState(NamedTuple):
     # Pipeline-mode canary probe state (parallel/pipeline.py:CanaryState);
     # None in data-parallel mode, where cross-node checks need no probe.
     canary: Any = None
+    # i32[n] consecutive clean steps per node — drives the in-step
+    # COMPROMISED -> RECOVERING probation (trust_manager.py:198-206
+    # semantics; config.recovery_probation_steps).
+    clean_streak: Any = None
 
 
 def init_train_state(
@@ -126,6 +130,7 @@ def init_train_state(
         epoch=jnp.zeros((), jnp.int32),
         rng=rng,
         canary=canary,
+        clean_streak=jnp.zeros((num_nodes,), jnp.int32),
     )
 
 

@@ -245,8 +245,10 @@ def build_train_step(
             """Sequential microbatches inside the step (lax.scan):
             gradients/losses are averaged (exactly the full-batch mean for
             equal-size microbatches of a mean loss); mean_logits averages
-            (linear, exact); the stat battery and feature moments ride the
-            last microbatch."""
+            (linear, exact); the stat battery and feature moments average
+            across microbatches (cheap per-node scalars in the scan), so
+            output-anomaly detection sees every microbatch — a corruption
+            confined to early microbatches still moves the battery."""
             mbs = jax.tree_util.tree_map(
                 lambda v: v.reshape((accum, v.shape[0] // accum)
                                     + v.shape[1:]),
@@ -274,7 +276,7 @@ def build_train_step(
             (loss_sum, grad_sum, ml_sum), stacked = jax.lax.scan(
                 body, init, mbs
             )
-            out_stats, f_mean, f_std = (x[-1] for x in stacked)
+            out_stats, f_mean, f_std = (jnp.mean(x, axis=0) for x in stacked)
             inv = 1.0 / accum
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
             aux = (out_stats, f_mean, f_std, ml_sum * inv)
@@ -472,6 +474,16 @@ def build_train_step(
             alpha=config.trust_alpha,
         )
 
+        # 7b. Probation recovery (trust_manager.py:198-206 wired in): a
+        # hard-gated node with recovery_probation_steps consecutive clean
+        # steps re-enters as RECOVERING — its weight returns below, and the
+        # status machine promotes it to TRUSTED once trust climbs.  A
+        # single false positive costs bounded steps, not the run.
+        trust, clean_streak = ts.probation_recovery(
+            trust, state.clean_streak, verified & ~candidates,
+            config.recovery_probation_steps,
+        )
+
         # 8. Trust-gated aggregation — the psum the reference never issued
         # (SURVEY §2.5).  Gated-out nodes are hard-masked with jnp.where,
         # not merely scaled: 0 * NaN = NaN, so a node emitting non-finite
@@ -518,6 +530,7 @@ def build_train_step(
             step=state.step + 1,
             epoch=state.epoch,
             rng=rng,
+            clean_streak=clean_streak,
         )
         metrics = StepMetrics(
             loss=loss,

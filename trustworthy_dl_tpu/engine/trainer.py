@@ -134,6 +134,22 @@ class DistributedTrainer:
         # (the reference re-records per batch, which grows history without
         # bound on long runs).
         self._open_incidents: set = set()
+        # Elastic-readmission bookkeeping: original id -> eviction step /
+        # the device its coordinate occupied (None in dev mode), and the
+        # per-original-id injection bits so a readmitted node's attack
+        # schedule survives the mask compaction/expansion round-trip.
+        self._evicted_at: Dict[int, int] = {}
+        self._evicted_devices: Dict[int, Any] = {}
+        self._plan_bits: Dict[int, bool] = {}
+        # Pipeline restaff: healthy survivors a stage-count repartition
+        # could not seat (id -> their parked devices); re-staffed by the
+        # next restaff (elastic/restaff.py).
+        self._idle_pool: Dict[int, Any] = {}
+        # Loader auto-resize after topology changes (per-node microbatch
+        # captured lazily from the first batch seen).
+        self._active_loader: Any = None
+        self._per_node_batch: Optional[int] = None
+        self._trim_grace = 0
 
         # Model / optimizer / mesh / step.
         model_overrides = dict(model_overrides or {})
@@ -300,6 +316,7 @@ class DistributedTrainer:
             trust=state.trust, out_baseline=state.out_baseline,
             grad_baseline=state.grad_baseline, verifier=state.verifier,
             monitor=state.monitor, prev_suspects=state.prev_suspects,
+            clean_streak=state.clean_streak,
         )
         if state.canary is not None:
             per_node["canary"] = state.canary
@@ -324,16 +341,24 @@ class DistributedTrainer:
     def set_attack_plan(self, plan: AttackPlan) -> None:
         """Install the experiment's fault-injection schedule."""
         self.attack_plan = plan
+        mask = np.asarray(plan.target_mask)
+        self._plan_bits = {
+            self.node_map[i]: bool(mask[i])
+            for i in range(min(len(mask), len(self.node_map)))
+        }
 
     # ------------------------------------------------------------------
     # Batch plumbing
     # ------------------------------------------------------------------
 
-    def _node_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+    def _node_batch(self, batch: Dict[str, np.ndarray]
+                    ) -> Optional[Dict[str, jax.Array]]:
         """[B, ...] -> [n, B//n, ...] with the node axis laid over the
         mesh's data axis — the reference's per-node data split, as sharding.
         Pipeline mode keeps the global batch (microbatching is internal) but
-        trims B to a multiple of num_microbatches."""
+        trims B to a multiple of num_microbatches.  Returns None for a
+        stale undersized batch during a topology-growth transition (the
+        caller skips it)."""
         if self.config.parallelism == "model":
             m = self.config.num_microbatches
             out = {}
@@ -358,12 +383,24 @@ class DistributedTrainer:
         lead = min(arr.shape[0] for arr in batch.values())
         b = (lead // (n * accum)) * n * accum
         if b == 0:
+            if self._trim_grace > 0:
+                # Stale pre-resize batch after a GROWTH transition
+                # (readmission): too small to split over the larger fleet.
+                # Skip it rather than crash — the resized loader's batches
+                # are already behind it in the queue.
+                self._trim_grace -= 1
+                return None
             raise ValueError(
                 f"batch size {lead} < num_nodes x grad_accum_steps = "
                 f"{n * accum}"
             )
         if b < lead and not self._warned_trim:
-            if lead in self._trimmed_sizes:
+            if self._trim_grace > 0:
+                # Transitional old-size batches right after a topology
+                # resize (prefetch queue backlog) — expected, not a
+                # persistent mismatch.
+                self._trim_grace -= 1
+            elif lead in self._trimmed_sizes:
                 self._warned_trim = True
                 logger.warning(
                     "batches of %d are persistently trimmed to %d "
@@ -371,7 +408,8 @@ class DistributedTrainer:
                     "divisible batch size to avoid dropping examples",
                     lead, b, n, accum,
                 )
-            self._trimmed_sizes.add(lead)
+            else:
+                self._trimmed_sizes.add(lead)
         for key, arr in batch.items():
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
             data_size = dict(
@@ -396,6 +434,10 @@ class DistributedTrainer:
             self.initialize()
         self.current_epoch = epoch
         epoch_loss, num_batches = 0.0, 0
+        # Per-epoch loader binding: the per-node microbatch is re-derived
+        # from THIS loader's first batch, so a later epoch with a
+        # different-sized loader is never resized against a stale capture.
+        self._per_node_batch = None
 
         if self.config.prefetch_depth > 0 and not isinstance(
             dataloader, PrefetchLoader
@@ -404,10 +446,21 @@ class DistributedTrainer:
             # (native row gathers) runs while the current step trains.
             dataloader = PrefetchLoader(dataloader,
                                         depth=self.config.prefetch_depth)
+        self._active_loader = dataloader
 
         for batch_idx, batch in enumerate(dataloader):
             self.global_step += 1
+            if self._per_node_batch is None and \
+                    self.config.parallelism != "model":
+                lead = min(arr.shape[0] for arr in batch.values())
+                accum = max(self.config.grad_accum_steps, 1)
+                per = lead // (self.config.num_nodes * accum)
+                if per > 0:
+                    self._per_node_batch = per
             node_batch = self._node_batch(batch)
+            if node_batch is None:  # stale undersized batch mid-transition
+                self.global_step -= 1
+                continue
             with step_annotation(self.global_step):
                 self.state, metrics = self._train_step(
                     self.state, node_batch, self.attack_plan
@@ -415,6 +468,7 @@ class DistributedTrainer:
             self.metrics_collector.tick()
             loss = float(metrics.loss)
             self._record_batch(metrics, epoch, loss)
+            self._maybe_readmit()
             epoch_loss += loss
             num_batches += 1
 
@@ -552,6 +606,78 @@ class DistributedTrainer:
             record = evict_and_reshard(self, evict_coords)
             record["step"] = self.global_step
             self.reassignment_history.append(record)
+            for orig in record["evicted_nodes"]:
+                self._evicted_at[int(orig)] = self.global_step
+            self._resize_loader()
+        elif (evict_coords and self.config.elastic_resharding
+                and self.config.parallelism == "model"
+                and len(evict_coords) < self.config.num_nodes):
+            # Model-parallel restaff: the compromised stage's layer shard
+            # migrates to trusted hardware and the model repartitions —
+            # ALL layers keep training (elastic/restaff.py), not the
+            # freeze+relabel the reference ships.
+            from trustworthy_dl_tpu.elastic.restaff import restaff_pipeline
+
+            record = restaff_pipeline(self, evict_coords)
+            record["step"] = self.global_step
+            self.reassignment_history.append(record)
+
+    def _maybe_readmit(self) -> None:
+        """Re-admit evicted coordinates whose cool-off has elapsed
+        (config.readmit_after_steps) — the elastic counterpart of the
+        in-step probation: without it a false-positive eviction costs 1/n
+        of the fleet for the rest of the run."""
+        cfg = self.config
+        if not (cfg.elastic_resharding and cfg.readmit_after_steps > 0
+                and cfg.parallelism == "data" and self._evicted_at):
+            return
+        due = sorted(
+            nid for nid, when in self._evicted_at.items()
+            if self.global_step - when >= cfg.readmit_after_steps
+        )
+        if not due:
+            return
+        from trustworthy_dl_tpu.elastic.reassignment import (
+            readmit_and_reshard,
+        )
+
+        record = readmit_and_reshard(self, due)
+        record["step"] = self.global_step
+        self.reassignment_history.append(record)
+        self._resize_loader()
+
+    def _resize_loader(self) -> None:
+        """Re-size the live data pipeline after a topology change so batch
+        sizes divide nodes × accum again — without this, every post-change
+        batch is trimmed and silently drops the same samples' worth of data
+        each step.  Works on any loader exposing a ``batch_size``
+        attribute (all bundled loaders); foreign loaders keep the trimming
+        fallback with its warning."""
+        import dataclasses
+
+        loader = self._active_loader
+        if loader is None or self._per_node_batch is None or \
+                self.config.parallelism == "model":
+            return
+        accum = max(self.config.grad_accum_steps, 1)
+        new_bs = self._per_node_batch * self.config.num_nodes * accum
+        target = loader.loader if isinstance(loader, PrefetchLoader) else loader
+        if hasattr(target, "batch_size") and target.batch_size != new_bs:
+            logger.info(
+                "Loader re-sized for new topology: batch %d -> %d "
+                "(%d nodes x %d/node x %d accum)", target.batch_size,
+                new_bs, self.config.num_nodes, self._per_node_batch, accum,
+            )
+            target.batch_size = new_bs
+            self.config = dataclasses.replace(self.config,
+                                              batch_size=new_bs)
+            # A few old-size batches may already sit in the prefetch queue
+            # (and the current epoch of an epoch-partitioned loader keeps
+            # its size until re-iterated): tolerate that transition without
+            # tripping the persistent-trim warning.
+            self._warned_trim = False
+            self._trimmed_sizes.clear()
+            self._trim_grace = max(self.config.prefetch_depth, 1) + 1
 
     def _handle_detected_attack(self, node_id: int, attack_type: str,
                                 metrics: StepMetrics,
@@ -564,6 +690,20 @@ class DistributedTrainer:
         ``coord`` its current mesh coordinate (equal until eviction)."""
         coord = node_id if coord is None else coord
         logger.error("Attack detected on node %d (%s)", node_id, attack_type)
+        # Ground-truth accounting: the injection plan knows whether this
+        # node was actually under attack this step, so the host detector's
+        # TP/FP counters report reality (the reference initialised them and
+        # never incremented either — its rates were always 0.0).
+        plan = self.attack_plan
+        mask = np.asarray(plan.target_mask)
+        live = bool(plan.active) and (self.global_step - 1) >= int(
+            plan.start_step
+        )
+        is_tp = live and coord < len(mask) and bool(mask[coord])
+        ds = self.attack_detector.detection_stats
+        ds["total_detections"] += 1
+        ds["attack_types"][attack_type] += 1
+        ds["true_positives" if is_tp else "false_positives"] += 1
         self.attack_history.append(
             {
                 "node_id": node_id,
@@ -578,9 +718,10 @@ class DistributedTrainer:
         )
         self.trust_manager.mark_compromised(node_id, attack_type)
         if not (self.config.elastic_resharding
-                and self.config.parallelism == "data"):
+                and self.config.parallelism in ("data", "model")):
             # Legacy greedy handoff (relabel) — elastic mode replaces it
-            # with the real eviction in _record_batch.
+            # with the real eviction (data) or stage restaff (model) in
+            # _record_batch.
             self.reassign_node_tasks(node_id, exclude=exclude)
         self.training_state = TrainingState.UNDER_ATTACK
 
@@ -769,11 +910,11 @@ class DistributedTrainer:
         (post-eviction) node count — SURVEY §5.4's resume requirement."""
         import dataclasses
 
-        if self.config.parallelism != "data":
+        if self.config.parallelism not in ("data", "model"):
             raise NotImplementedError(
                 "post-eviction resume onto a different node count is only "
-                "defined for data parallelism (eviction itself is, "
-                "elastic/reassignment.py)"
+                "defined for data and model parallelism (eviction itself "
+                "is, elastic/reassignment.py + elastic/restaff.py)"
             )
         n = int(meta["num_nodes"])
         logger.info(
@@ -783,11 +924,26 @@ class DistributedTrainer:
         self.config = dataclasses.replace(self.config, num_nodes=n)
         self.mesh = build_mesh(n, self.config.parallelism,
                                self.config.mesh_shape)
-        self._train_step = jax.jit(
-            build_train_step(self.model, self.config, self.optimizer),
-            donate_argnums=(0,),
-        )
-        self._eval_step = jax.jit(build_eval_step(self.model))
+        if self.config.parallelism == "model":
+            from trustworthy_dl_tpu.parallel.pipeline import (
+                build_pipeline_eval_step,
+                build_pipeline_train_step,
+            )
+
+            self._train_step = jax.jit(
+                build_pipeline_train_step(self.model, self.config,
+                                          self.optimizer, self.mesh),
+                donate_argnums=(0,),
+            )
+            self._eval_step = jax.jit(
+                build_pipeline_eval_step(self.model, self.config, self.mesh)
+            )
+        else:
+            self._train_step = jax.jit(
+                build_train_step(self.model, self.config, self.optimizer),
+                donate_argnums=(0,),
+            )
+            self._eval_step = jax.jit(build_eval_step(self.model))
         self.node_map = [int(i) for i in meta["node_map"]]
         # Any attack plan was shaped for the constructor's node count;
         # injection targets are per-run anyway — reset, caller re-plans.

@@ -473,6 +473,16 @@ def build_pipeline_train_step(
                                 alpha=config.trust_alpha,
                                 update_mask=jnp.broadcast_to(~byz_any, (S,)))
 
+        # Probation recovery (trust_manager.py:198-206 wired in): a frozen
+        # stage with enough consecutive clean steps re-enters as RECOVERING
+        # and its updates resume.  ~byz_any: a live canary verdict means the
+        # whole pipeline's evidence is contaminated — no streak credit.
+        trust, clean_streak = ts.probation_recovery(
+            trust, state.clean_streak,
+            verified & ~candidates & ~byz_any,
+            config.recovery_probation_steps,
+        )
+
         # Gate: a flagged stage's parameters freeze (update zeroed) — the
         # model topology is preserved, unlike the reference's layer-drop.
         # Hard-mask with jnp.where, not scale: 0 * NaN = NaN, so a frozen
@@ -526,6 +536,7 @@ def build_pipeline_train_step(
             epoch=state.epoch,
             rng=rng,
             canary=canary_state,
+            clean_streak=clean_streak,
         )
         metrics = StepMetrics(
             loss=loss,

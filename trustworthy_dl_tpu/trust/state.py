@@ -207,6 +207,33 @@ def initiate_recovery(state: TrustState, node_mask: jax.Array) -> TrustState:
     )
 
 
+def probation_recovery(
+    state: TrustState,
+    clean_streak: jax.Array,
+    clean_now: jax.Array,
+    probation_steps: int,
+) -> Tuple[TrustState, jax.Array]:
+    """Engine-driven recovery: after ``probation_steps`` consecutive clean
+    steps a COMPROMISED node transitions to RECOVERING with the boosted
+    recovery rate (``initiate_recovery`` semantics, trust_manager.py:198-206
+    — which the reference exposed but no path ever called).
+
+    Returns (new_state, new_clean_streak).  The readmitted trust is floored
+    at 0.5: below 0.3 the status machine would demote the node straight
+    back to COMPROMISED on its next update, re-gating it forever."""
+    streak = jnp.where(clean_now.astype(bool), clean_streak + 1, 0)
+    if probation_steps <= 0:
+        return state, streak
+    rehab = (streak >= probation_steps) & (
+        state.status == NodeStatus.COMPROMISED
+    )
+    new = initiate_recovery(state, rehab)
+    new = new._replace(
+        scores=jnp.where(rehab, jnp.maximum(new.scores, 0.5), new.scores)
+    )
+    return new, jnp.where(rehab, 0, streak)
+
+
 def can_assign_task(state: TrustState) -> jax.Array:
     """bool[n]: TRUSTED or RECOVERING (trust_manager.py:239-242)."""
     return (state.status == NodeStatus.TRUSTED) | (
