@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--learning-rate", type=float, default=None)
     parser.add_argument("--parallelism", type=str, default=None,
                         choices=["data", "model", "tensor", "sequence",
-                                 "hybrid"])
+                                 "expert", "hybrid"])
     parser.add_argument("--checkpoint-dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true",
                         help="restore the latest checkpoint before training")
@@ -181,6 +181,48 @@ def generate_main(argv: Optional[List[str]] = None,
     print("prompt:    ", tokens)
     print("generated: ", out[0, len(tokens):].tolist())
     trainer.cleanup()
+    return 0
+
+
+def build_prepare_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trustworthy-dl-prepare-data",
+        description="Tokenize a raw .txt corpus into the loader's .bin "
+                    "token memmap (byte-level BPE, trained on the corpus "
+                    "or loaded from GPT-2-format vocab.json/merges.txt)",
+    )
+    parser.add_argument("txt", type=str, help="input UTF-8 text file")
+    parser.add_argument("--out", type=str, default=None,
+                        help="output .bin path (default: alongside input)")
+    parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--tokenizer-dir", type=str, default=None,
+                        help="directory holding (or to receive) "
+                             "vocab.json + merges.txt")
+    parser.add_argument("--val-fraction", type=float, default=0.0,
+                        help="also write a *_val.bin holdout split")
+    return parser
+
+
+def prepare_main(argv: Optional[List[str]] = None) -> int:
+    """Console entry point ``trustworthy-dl-prepare-data`` — the offline
+    .txt → .bin pipeline (experiment_runner.py:100-110 parity: the
+    'openwebtext' tier works from raw text with no external tooling)."""
+    import os
+
+    from trustworthy_dl_tpu.data.tokenizer import prepare_data
+
+    args = build_prepare_parser().parse_args(argv)
+    if not os.path.exists(args.txt):
+        print(f"no such file: {args.txt}")
+        return 2
+    info = prepare_data(args.txt, out_path=args.out,
+                        vocab_size=args.vocab_size,
+                        tokenizer_dir=args.tokenizer_dir,
+                        val_fraction=args.val_fraction)
+    print(f"wrote {info['num_tokens']} tokens (vocab {info['vocab_size']}) "
+          f"to {info['out_path']}"
+          + (f" + val split {info['val_path']}" if info["val_path"] else ""))
+    print(f"tokenizer files in {info['tokenizer_dir']}")
     return 0
 
 

@@ -4,6 +4,12 @@ from trustworthy_dl_tpu.data.loader import (
     TokenStreamLoader,
     get_dataloader,
 )
+from trustworthy_dl_tpu.data.tokenizer import (
+    BPETokenizer,
+    prepare_data,
+    train_bpe,
+)
 
-__all__ = ["ArrayDataLoader", "PrefetchLoader", "TokenStreamLoader",
-           "get_dataloader"]
+__all__ = ["ArrayDataLoader", "BPETokenizer", "PrefetchLoader",
+           "TokenStreamLoader", "get_dataloader", "prepare_data",
+           "train_bpe"]

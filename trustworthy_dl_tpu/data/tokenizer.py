@@ -1,0 +1,322 @@
+"""Byte-level BPE tokenizer — the raw-text ingestion tier the reference
+implies but never ships (``get_dataloader('openwebtext', ...)`` at
+experiment_runner.py:100-110 presumes tokenized data; README.md:80 tells the
+user to "prepare" it elsewhere).
+
+GPT-2-style byte-level BPE, self-contained and offline:
+
+* the byte→unicode table and merge algorithm follow the GPT-2 scheme, and
+  the on-disk format is GPT-2's exact ``vocab.json`` + ``merges.txt`` — so
+  a user who HAS OpenAI's files drops them in and gets the canonical
+  50257-token vocabulary;
+* this zero-egress build cannot vendor those files, so ``train_bpe`` learns
+  a merge table from the corpus itself (the standard BPE trainer:
+  iteratively merge the most frequent adjacent pair).  A corpus-fit vocab
+  is what nanoGPT-class training wants anyway;
+* ``prepare_data`` is the .txt → .bin pipeline: learn/load a tokenizer,
+  encode, write a uint16 token memmap in the loader's nanoGPT layout
+  (data/loader.py), plus the tokenizer files next to it.
+
+Console entry: ``trustworthy-dl-prepare-data`` (cli shim in
+trustworthy_dl_tpu/cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # GPT-2's exact pre-tokenizer needs \p classes (regex module).
+    import regex as _re
+
+    _PAT = _re.compile(
+        r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+        r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+    )
+except ImportError:  # std-re fallback: same shape with unicode classes
+    import re as _re
+
+    _PAT = _re.compile(
+        r"""'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+|"""
+        r""" ?[^\s\w]+|\s+(?!\S)|\s+""",
+        _re.UNICODE,
+    )
+
+
+def bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte→printable-unicode table: the 188 printable
+    latin-1 bytes map to themselves, the rest shift into U+0100+ so every
+    byte sequence round-trips through a unicode string."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+_BYTE_ENCODER = bytes_to_unicode()
+_BYTE_DECODER = {v: k for k, v in _BYTE_ENCODER.items()}
+
+
+def _word_to_units(word: str) -> Tuple[str, ...]:
+    """Pre-token → tuple of byte-units in the unicode alphabet."""
+    return tuple(_BYTE_ENCODER[b] for b in word.encode("utf-8"))
+
+
+def train_bpe(
+    text: str,
+    vocab_size: int = 8192,
+    min_pair_count: int = 2,
+) -> Tuple[Dict[str, int], List[Tuple[str, str]]]:
+    """Learn a byte-level BPE vocabulary from ``text``.
+
+    Standard BPE trainer over pre-tokenized words: start from the 256 byte
+    units, repeatedly merge the most frequent adjacent pair until
+    ``vocab_size`` entries (or no pair occurs ``min_pair_count`` times).
+    Returns (vocab: token→id, merges: ordered pair list) in GPT-2's
+    conventions (ids dense from 0, merge rank = list order)."""
+    units = sorted(set(_BYTE_ENCODER.values()))
+    if vocab_size < len(units):
+        raise ValueError(
+            f"vocab_size {vocab_size} < byte alphabet {len(units)}"
+        )
+    # Word histogram (BPE trains on word types, weighted by count).
+    word_counts = Counter()
+    for m in _PAT.findall(text):
+        word_counts[_word_to_units(m)] += 1
+    # Incremental trainer state: the global pair histogram plus an
+    # inverted index pair -> words containing it.  Each merge touches only
+    # the words that actually contain the merged pair, keeping training
+    # near-linear instead of O(vocab_size × word_types) full rescans.
+    words: Dict[Tuple[str, ...], int] = dict(word_counts)
+    pair_counts: Counter = Counter()
+    pair_words: Dict[Tuple[str, str], set] = {}
+    for word, cnt in words.items():
+        for pair in zip(word, word[1:]):
+            pair_counts[pair] += cnt
+            pair_words.setdefault(pair, set()).add(word)
+
+    # Lazy max-heap over pair counts: entries go stale when a count
+    # changes; pops validate against pair_counts and re-push the current
+    # value.  Keeps best-pair selection O(log P) per merge instead of a
+    # full histogram scan.
+    import heapq
+
+    heap: List[Tuple[int, Tuple[str, str]]] = [
+        (-c, p) for p, c in pair_counts.items()
+    ]
+    heapq.heapify(heap)
+
+    def _bump(pair: Tuple[str, str]) -> None:
+        c = pair_counts.get(pair)
+        if c:
+            heapq.heappush(heap, (-c, pair))
+
+    def _remove_word(word: Tuple[str, ...], cnt: int) -> None:
+        for pair in zip(word, word[1:]):
+            pair_counts[pair] -= cnt
+            if pair_counts[pair] <= 0:
+                del pair_counts[pair]
+            ws = pair_words.get(pair)
+            if ws is not None:
+                ws.discard(word)
+                if not ws:
+                    del pair_words[pair]
+
+    def _add_word(word: Tuple[str, ...], cnt: int) -> None:
+        for pair in zip(word, word[1:]):
+            pair_counts[pair] += cnt
+            pair_words.setdefault(pair, set()).add(word)
+            _bump(pair)
+
+    merges: List[Tuple[str, str]] = []
+    vocab: Dict[str, int] = {u: i for i, u in enumerate(units)}
+
+    while len(vocab) < vocab_size and heap:
+        neg, (a, b) = heapq.heappop(heap)
+        cnt = pair_counts.get((a, b), 0)
+        if -neg != cnt:  # stale entry: re-queue at the live count
+            _bump((a, b))
+            continue
+        if cnt < min_pair_count:
+            break
+        merged = a + b
+        merges.append((a, b))
+        vocab[merged] = len(vocab)
+        affected = list(pair_words.get((a, b), ()))
+        for word in affected:
+            c = words.pop(word)
+            _remove_word(word, c)
+            out = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            t = tuple(out)
+            words[t] = words.get(t, 0) + c
+            _add_word(t, c)
+    return vocab, merges
+
+
+class BPETokenizer:
+    """GPT-2-style byte-level BPE encoder/decoder.
+
+    ``vocab`` maps token strings (in the byte-unicode alphabet) to ids;
+    ``merges`` is the ordered merge list.  File format matches GPT-2's
+    ``vocab.json`` / ``merges.txt``, so OpenAI's published files load
+    directly for exact-vocabulary parity."""
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: Sequence[Tuple[str, str]]):
+        self.vocab = dict(vocab)
+        self.decoder = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(m): r for r, m in enumerate(merges)}
+        self._cache: Dict[str, List[str]] = {}
+
+    # -- core BPE ------------------------------------------------------
+
+    def _bpe(self, word: Tuple[str, ...]) -> List[str]:
+        key = " ".join(word)
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        parts = list(word)
+        while len(parts) > 1:
+            best_rank, best_i = None, None
+            for i, pair in enumerate(zip(parts, parts[1:])):
+                r = self.ranks.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_i is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        if len(self._cache) < 65536:
+            self._cache[key] = parts
+        return parts
+
+    # -- public API ----------------------------------------------------
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for m in _PAT.findall(text):
+            for token in self._bpe(_word_to_units(m)):
+                tid = self.vocab.get(token)
+                if tid is None:
+                    # Unknown merge product (foreign merges file): fall
+                    # back to the token's individual byte units, which are
+                    # always in the vocabulary.
+                    ids.extend(self.vocab[u] for u in token)
+                else:
+                    ids.append(tid)
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        text = "".join(self.decoder[int(i)] for i in ids)
+        data = bytes(_BYTE_DECODER[c] for c in text)
+        return data.decode("utf-8", errors="replace")
+
+    # -- persistence (GPT-2 file format) -------------------------------
+
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "vocab.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(self.vocab, f, ensure_ascii=False)
+        with open(os.path.join(directory, "merges.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            for (a, b), _ in sorted(self.ranks.items(),
+                                    key=lambda kv: kv[1]):
+                f.write(f"{a} {b}\n")
+
+    @classmethod
+    def load(cls, directory: str) -> "BPETokenizer":
+        with open(os.path.join(directory, "vocab.json"),
+                  encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(os.path.join(directory, "merges.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    @classmethod
+    def train(cls, text: str, vocab_size: int = 8192) -> "BPETokenizer":
+        vocab, merges = train_bpe(text, vocab_size)
+        return cls(vocab, merges)
+
+
+def prepare_data(
+    txt_path: str,
+    out_path: Optional[str] = None,
+    vocab_size: int = 8192,
+    tokenizer_dir: Optional[str] = None,
+    val_fraction: float = 0.0,
+) -> Dict[str, object]:
+    """.txt corpus → uint16 token memmap (.bin, nanoGPT layout) + tokenizer
+    files — the offline ``prepare`` step the reference's README hand-waves.
+
+    If ``tokenizer_dir`` already holds vocab.json/merges.txt (e.g. OpenAI's
+    GPT-2 files), they are used as-is; otherwise a BPE vocabulary is
+    trained on the corpus and saved there.  ``val_fraction`` > 0
+    additionally writes a ``*_val.bin`` split."""
+    with open(txt_path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    if out_path is None:
+        out_path = os.path.splitext(txt_path)[0] + ".bin"
+    if tokenizer_dir is None:
+        tokenizer_dir = os.path.join(os.path.dirname(os.path.abspath(
+            out_path)), "tokenizer")
+
+    if os.path.exists(os.path.join(tokenizer_dir, "vocab.json")):
+        tok = BPETokenizer.load(tokenizer_dir)
+    else:
+        tok = BPETokenizer.train(text, vocab_size)
+        tok.save(tokenizer_dir)
+
+    ids = tok.encode(text)
+    if tok.vocab_size > np.iinfo(np.uint16).max + 1:
+        raise ValueError(
+            f"vocab {tok.vocab_size} exceeds uint16 memmap range"
+        )
+    arr = np.asarray(ids, np.uint16)
+    if val_fraction > 0:
+        cut = int(len(arr) * (1.0 - val_fraction))
+        train_arr, val_arr = arr[:cut], arr[cut:]
+        val_path = os.path.splitext(out_path)[0] + "_val.bin"
+        val_arr.tofile(val_path)
+    else:
+        train_arr, val_path = arr, None
+    train_arr.tofile(out_path)
+    return {
+        "out_path": out_path,
+        "val_path": val_path,
+        "tokenizer_dir": tokenizer_dir,
+        # Tokens actually in out_path (the val split is carved out of it).
+        "num_tokens": int(len(train_arr)),
+        "val_tokens": int(len(arr) - len(train_arr)),
+        "vocab_size": tok.vocab_size,
+    }
