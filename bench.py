@@ -19,7 +19,8 @@ Env overrides: TDDL_BENCH_MODEL (gpt2), TDDL_BENCH_NODES (4),
 TDDL_BENCH_BATCH (per-node, 16), TDDL_BENCH_SEQ (512),
 TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3), TDDL_BENCH_REMAT (1),
 TDDL_BENCH_CHUNK (0 = materialised-logits CE; >0 = fused vocab-chunked
-head), TDDL_BENCH_ATTN (model default).
+head), TDDL_BENCH_ATTN (model default), TDDL_BENCH_ACCUM (grad
+accumulation microbatches, 1).
 
 Default config is the measured single-v5e sweet spot: per-node batch 16
 (64 x 512 tokens/step) with block rematerialisation — larger batches fit
@@ -84,6 +85,7 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
         gradient_verification_enabled=detection,
         parallelism="data",
         lm_head_chunk=int(os.environ.get("TDDL_BENCH_CHUNK", "0")),
+        grad_accum_steps=int(os.environ.get("TDDL_BENCH_ACCUM", "1")),
     )
     overrides: dict = {}
     if model.startswith("gpt"):

@@ -99,6 +99,41 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
     return x, layer_k, layer_v
 
 
+def _decode_view(params: Params, cfg: gpt2.GPT2Config) -> Params:
+    """Pre-cast the bandwidth-dominant weights to the compute dtype ONCE.
+
+    ``dense()``/``project_logits()`` cast their f32 master weights to
+    ``cfg.dtype`` at every use; inside the decode scan that cast re-reads
+    the f32 copy from HBM every token.  b=1 decode is pure
+    weight-bandwidth, so hoisting the cast halves the per-token HBM
+    traffic (f32 → bf16 reads).  Numerics are bit-identical: it is the
+    same cast, done once — ``dense``'s ``astype`` becomes a no-op on the
+    pre-cast leaves.  Embedding lookups and layernorms keep their f32
+    params (their numerics are defined in f32)."""
+    if cfg.dtype == jnp.float32:
+        return params
+
+    def cast_dense(d):
+        return {"w": d["w"].astype(cfg.dtype),
+                "b": d["b"].astype(cfg.dtype)}
+
+    blocks = params["blocks"]
+    out = dict(params)
+    out["blocks"] = {
+        "ln_1": blocks["ln_1"],
+        "ln_2": blocks["ln_2"],
+        "attn": {"qkv": cast_dense(blocks["attn"]["qkv"]),
+                 "proj": cast_dense(blocks["attn"]["proj"])},
+        "mlp": {"fc": cast_dense(blocks["mlp"]["fc"]),
+                "proj": cast_dense(blocks["mlp"]["proj"])},
+    }
+    # Pre-cast tied head for the per-token [B,D]x[D,V] projection — the
+    # single largest weight read of a decode step.  params["wte"] itself
+    # stays f32 for the embedding lookup.
+    out["wte_head"] = params["wte"].astype(cfg.dtype)
+    return out
+
+
 def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
                       cfg: gpt2.GPT2Config
                       ) -> Tuple[jax.Array, KVCache]:
@@ -118,7 +153,14 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
     x, (new_k, new_v) = jax.lax.scan(
         scan_fn, x, (params["blocks"], cache.k, cache.v)
     )
-    logits = gpt2.unembed(params, x[:, -1:, :], cfg)[:, 0, :]  # [B, V]
+    wte_head = params.get("wte_head")
+    if wte_head is None:
+        logits = gpt2.unembed(params, x[:, -1:, :], cfg)[:, 0, :]  # [B, V]
+    else:
+        normed = L.layernorm(params["ln_f"], x[:, -1:, :])
+        logits = (normed.astype(cfg.dtype) @ wte_head.T).astype(
+            jnp.float32
+        )[:, 0, :]
     return logits, KVCache(k=new_k, v=new_v, length=start + t)
 
 
@@ -161,6 +203,7 @@ def _generate_jit(params: Params, prompt: jax.Array, rng: jax.Array,
                   max_new_tokens: int, greedy: bool, top_k: int,
                   use_top_p: bool) -> jax.Array:
     b, t_prompt = prompt.shape
+    params = _decode_view(params, cfg)
     cache = init_cache(cfg, b, t_prompt + max_new_tokens)
     logits, cache = _apply_with_cache(params, prompt, cache, cfg)
     first = _sample(logits, rng, temperature, greedy, top_k, top_p,

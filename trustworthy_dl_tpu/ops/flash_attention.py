@@ -19,8 +19,15 @@ Three kernels:
     dV = Pᵀ·dO and dK = scale · (P ∘ (dO·Vᵀ − Δ))ᵀ · Q.
 
 Causal masking skips fully-masked tiles at the grid level (half the work)
-and masks the diagonal tile elementwise.  Numerics are f32 throughout the
-accumulators regardless of input dtype; outputs cast back.
+and masks the diagonal tile elementwise.  Crucially the skip also kills the
+tile's HBM traffic: ``pl.when`` alone only skips compute — Pallas's
+pipeline still DMAs every block named by the BlockSpec — so the index maps
+CLAMP masked iterations to the last useful block index; Pallas issues no
+copy when the block index repeats, making the causal skip save bandwidth
+as well as FLOPs (this was the round-2 "advantage shrinks with T" bug: at
+long T the kernel is bandwidth-bound and was streaming twice the needed
+K/V).  Numerics are f32 throughout the accumulators regardless of input
+dtype; outputs cast back.
 
 Registered with the GPT-2 attention registry as ``attn_impl="flash"``.
 Shapes that don't tile (T not a multiple of the block) fall back to the
@@ -45,11 +52,29 @@ _LANES = 128
 
 
 def _block_for(t: int) -> int:
-    """Largest supported block size dividing T (0 = no tiling, fall back)."""
-    for b in (256, 128, 64):
+    """Largest supported Q block size dividing T (0 = no tiling, fall
+    back)."""
+    for b in (512, 256, 128, 64):
         if t % b == 0 and t >= b:
             return b
     return 0
+
+
+def _blocks_for(t: int) -> Tuple[int, int]:
+    """(bq, bk) tile sizes, tuned on v5e (BASELINE.md sweep): large tiles
+    win — per-tile bookkeeping and online-softmax rescales amortise, and
+    the K loop (inner, streaming) benefits most, so bk runs up to 1024.
+    (512, 1024) measured 24.6 ms at T=16384 fwd+bwd vs 60.8 ms for the
+    round-2 (256, 256) choice and 77.8 ms for XLA full attention."""
+    bq = _block_for(t)
+    if not bq:
+        return 0, 0
+    bk = bq
+    for cand in (1024, 512):
+        if t % cand == 0 and t >= cand and cand > bk:
+            bk = cand
+            break
+    return bq, bk
 
 
 MAX_HEAD_DIM = 512
@@ -139,13 +164,22 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
     )
+    # Masked-tile DMA clamp (see module docstring): causal Q block i needs
+    # K/V blocks j ≤ jmax(i); beyond that the index pins to jmax so the
+    # pipeline issues no further copies for this row.
+    if causal:
+        kv_idx = lambda b, i, j: (
+            b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0
+        )
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
     o, lse_col = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, bk, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -259,14 +293,24 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
     lse_col = lse[..., None]
     delta_col = delta[..., None]
 
+    # Same masked-tile DMA clamps as the forward (module docstring).
+    if causal:
+        kv_idx = lambda b, i, j: (
+            b, jnp.minimum(j, ((i + 1) * bq - 1) // bk), 0
+        )
+        q_idx = lambda b, j, i: (b, jnp.maximum(i, (j * bk) // bq), 0)
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
+        q_idx = lambda b, j, i: (b, i, 0)
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, bk, d), kv_idx),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
@@ -282,12 +326,12 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
                           bq=bq, bk=bk, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), q_idx),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), q_idx),
+            pl.BlockSpec((1, bq, 1), q_idx),
+            pl.BlockSpec((1, bq, 1), q_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -376,10 +420,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if not supports_flash(t, d):
         out = full_attention(q, k, v, causal)
         return out[0] if squeeze else out
-    block = _block_for(t)
+    bq, bk = _blocks_for(t)
 
     merge = lambda a: a.reshape(b * h, t, d)
-    out = _flash(merge(q), merge(k), merge(v), causal, block, block)
+    out = _flash(merge(q), merge(k), merge(v), causal, bq, bk)
     out = out.reshape(b, h, t, d)
     return out[0] if squeeze else out
 

@@ -135,16 +135,16 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
     if _use_flash_chunks(tl, d):
         from trustworthy_dl_tpu.ops.flash_attention import (
-            _block_for,
+            _blocks_for,
             flash_chunk,
         )
 
-        block = _block_for(tl)
+        bq, bk = _blocks_for(tl)
         merge = lambda a: a.reshape(b * h, tl, d)
 
         def chunk(k_cur, v_cur, chunk_causal: bool):
             o, lse = flash_chunk(merge(q), merge(k_cur), merge(v_cur),
-                                 chunk_causal, block, block)
+                                 chunk_causal, bq, bk)
             return (o.reshape(b, h, tl, d),
                     lse.reshape(b, h, tl))
 
