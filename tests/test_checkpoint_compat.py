@@ -1,0 +1,55 @@
+"""Checkpoint structure-drift tolerance (engine/checkpoint.py): a template
+with fields the checkpoint lacks (new TrainState fields like round 3's
+``clean_streak``) or a checkpoint with leaves the template dropped (the
+constant schedule's count) restores via merge-by-name instead of failing."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.engine.checkpoint import (
+    CheckpointManager,
+    _merge_into_template,
+)
+
+
+def test_restore_tolerates_missing_and_extra_fields(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    saved = {
+        "a": jnp.arange(4, dtype=jnp.float32),
+        "nested": {"b": jnp.ones((2, 2)), "legacy_only": jnp.zeros((3,))},
+    }
+    mgr.save(saved, step=1)
+
+    template = {
+        "a": jnp.zeros(4, jnp.float32),
+        "nested": {
+            "b": jnp.zeros((2, 2)),
+            # New field the checkpoint doesn't have: keeps template value.
+            "new_field": jnp.full((5,), 7.0),
+        },
+    }
+    out = mgr.restore(template, step=1)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4))
+    np.testing.assert_array_equal(np.asarray(out["nested"]["b"]),
+                                  np.ones((2, 2)))
+    np.testing.assert_array_equal(np.asarray(out["nested"]["new_field"]),
+                                  np.full((5,), 7.0))
+    assert "legacy_only" not in out["nested"]
+
+
+def test_merge_handles_namedtuples_and_tuples():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", ["x", "y", "z"])
+    template = Point(x=jnp.zeros(2), y=jnp.zeros(3), z=jnp.full((1,), 9.0))
+    raw = {"x": np.arange(2.0), "y": np.arange(3.0)}  # no z
+    out = _merge_into_template(template, raw)
+    assert isinstance(out, Point)
+    np.testing.assert_array_equal(np.asarray(out.x), [0.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(out.z), [9.0])
+
+    tpl = (jnp.zeros(2), jnp.ones(1))
+    out = _merge_into_template(tpl, {"0": np.arange(2.0)})
+    np.testing.assert_array_equal(np.asarray(out[0]), [0.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(out[1]), [1.0])

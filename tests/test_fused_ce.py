@@ -15,6 +15,12 @@ from trustworthy_dl_tpu.ops.fused_ce import fused_lm_loss
 TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=100, n_positions=32,
             seq_len=16)
 
+# f32 matmul accumulation order differs on TPU backends; exact-match grad
+# tolerances only hold on the CPU harness.
+_ON_CPU = jax.default_backend() == "cpu"
+GRAD_RTOL = 1e-5 if _ON_CPU else 1e-4
+GRAD_ATOL = 1e-6 if _ON_CPU else 1e-5
+
 
 def _ref_loss(x, w, t):
     logits = jnp.einsum(
@@ -41,9 +47,9 @@ def test_fused_matches_materialised(chunk):
         argnums=(0, 1),
     )(x, w)
     np.testing.assert_allclose(np.asarray(g_got[0]), np.asarray(g_ref[0]),
-                               rtol=1e-5, atol=1e-6)
+                               rtol=GRAD_RTOL, atol=GRAD_ATOL)
     np.testing.assert_allclose(np.asarray(g_got[1]), np.asarray(g_ref[1]),
-                               rtol=1e-5, atol=1e-6)
+                               rtol=GRAD_RTOL, atol=GRAD_ATOL)
 
 
 def test_fused_under_vmap_jit():
@@ -95,6 +101,7 @@ def test_gpt2_loss_with_monitor_fused_matches_plain():
                                    rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_engine_trains_with_fused_head(tmp_path):
     """Two engine steps with lm_head_chunk on: finite loss, loss decreases
     over a short run, and the detector state advances (same contract as the
@@ -123,6 +130,7 @@ def test_engine_trains_with_fused_head(tmp_path):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_apply_monitor_only_bundle_path():
     """A custom ModelBundle may define apply_monitor without loss_monitor
     (the documented extension point); the engine must drive that branch —
@@ -164,6 +172,7 @@ def test_apply_monitor_only_bundle_path():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_with_fused_head(tmp_path):
     """Pipeline parallelism honours lm_head_chunk: loss equals the
     materialised-head pipeline loss, training stays finite."""
@@ -189,6 +198,7 @@ def test_pipeline_with_fused_head(tmp_path):
     np.testing.assert_allclose(losses[32], losses[0], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_eval_matches_materialised_both_modes(tmp_path):
     """validate_metrics with lm_head_chunk on == off, in data AND pipeline
     modes (the fused eval keeps the training path's no-logits contract)."""

@@ -58,10 +58,15 @@ class ArrayDataLoader:
         else:
             idx = np.arange(len(self.inputs), dtype=np.int64)
         self._epoch += 1
-        for start in range(0, len(idx) - (len(idx) % self.batch_size if self.drop_last else 0),
-                           self.batch_size):
-            sel = idx[start:start + self.batch_size]
-            if len(sel) == 0:
+        # ``batch_size`` is re-read every batch so a live re-size (elastic
+        # topology change mid-epoch, trainer._resize_loader) takes effect
+        # on the next batch, not the next epoch.
+        start = 0
+        while start < len(idx):
+            bs = self.batch_size
+            sel = idx[start:start + bs]
+            start += bs
+            if len(sel) == 0 or (self.drop_last and len(sel) < bs):
                 break
             yield {
                 "input": native.gather_rows(self.inputs, sel),
@@ -76,23 +81,30 @@ class TokenStreamLoader:
     multi-threaded gather, bit-exact fallback), so an "epoch" is a step
     budget rather than a fixed partition of the stream.
 
-    Deterministic: batch k of epoch e depends only on (seed, e, k)."""
+    Deterministic: batch k of epoch e depends only on (seed, e, k).
+    ``freeze_epoch=True`` pins every iteration to epoch 0 — a validation
+    loader must yield the SAME windows on every call, otherwise val loss is
+    computed on a fresh sample each epoch and any abandoned ``iter()``
+    silently shifts subsequent data."""
 
     def __init__(self, stream: np.ndarray, batch_size: int, seq_len: int,
-                 steps_per_epoch: int, seed: int = 0):
+                 steps_per_epoch: int, seed: int = 0,
+                 freeze_epoch: bool = False):
         self.stream = np.ascontiguousarray(stream, np.int32)
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.steps_per_epoch = steps_per_epoch
         self.seed = seed
+        self.freeze_epoch = freeze_epoch
         self._epoch = 0
 
     def __len__(self) -> int:
         return self.steps_per_epoch
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        epoch = self._epoch
-        self._epoch += 1
+        epoch = 0 if self.freeze_epoch else self._epoch
+        if not self.freeze_epoch:
+            self._epoch += 1
         mask = (1 << 64) - 1
         # Two splitmix rounds fold (seed, epoch, step) into the batch seed:
         # a linear small-prime mix would collide across (epoch, step)
@@ -262,7 +274,8 @@ def get_dataloader(
         if sampling == "windows":
             steps = max(n // max(batch_size, 1), 1)
             return TokenStreamLoader(tokens, batch_size, seq_len,
-                                     steps_per_epoch=steps, seed=split_seed)
+                                     steps_per_epoch=steps, seed=split_seed,
+                                     freeze_epoch=(split != "train"))
         usable = (len(tokens) - 1) // seq_len
         usable = min(usable, n)
         window = tokens[: usable * seq_len + 1]

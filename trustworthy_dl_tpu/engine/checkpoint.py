@@ -38,6 +38,101 @@ def _latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def _merge_into_template(template: Any, raw: Any) -> Any:
+    """Graft a restored raw tree (nested dicts/lists of host arrays, as
+    Orbax saves them) onto ``template`` by container key: same-named slots
+    take the saved value (placed with the template leaf's sharding),
+    missing slots keep the template's (freshly-initialised) value, and
+    saved keys with no template slot are dropped.  This is the
+    forward/backward-compat path for checkpoint structure drift."""
+    if raw is None:
+        return template
+    # Leaf in the template: adopt the saved value (cast/placed like the
+    # template leaf); container mismatches fall through to the walk below.
+    if hasattr(template, "dtype") and not isinstance(template, (dict,)):
+        leaf = raw
+        if hasattr(leaf, "dtype"):
+            # The fallback exists for STRUCTURE drift only.  A shape
+            # mismatch means topology drift (different node count) — keep
+            # that loud: silently adopting a [8, ...] row block onto a
+            # 4-node template would defer the failure to an opaque XLA
+            # error in the first step (use the elastic topology sidecar
+            # for cross-topology resume).
+            if tuple(np.shape(leaf)) != tuple(np.shape(template)):
+                raise ValueError(
+                    f"checkpoint leaf shape {np.shape(leaf)} does not "
+                    f"match template {np.shape(template)} — topology "
+                    "drift, not structure drift; restore via the "
+                    "topology sidecar (load_checkpoint handles this)"
+                )
+            # No host round-trip: an already-sharded jax leaf (the
+            # metadata-guided fallback restores straight onto the
+            # template's shardings) passes through / re-places on device.
+            arr = leaf if leaf.dtype == template.dtype else \
+                leaf.astype(template.dtype)
+            sharding = getattr(template, "sharding", None)
+            if sharding is not None:
+                return jax.device_put(arr, sharding)
+            return jax.numpy.asarray(arr)
+        return template
+    if isinstance(template, dict):
+        raw_map = raw if isinstance(raw, dict) else {}
+        return {
+            k: _merge_into_template(v, raw_map.get(k))
+            for k, v in template.items()
+        }
+    if isinstance(template, tuple):
+        fields = getattr(template, "_fields", None)
+        if fields is not None:  # NamedTuple: saved as a dict of fields
+            raw_map = raw if isinstance(raw, dict) else {}
+            return type(template)(**{
+                f: _merge_into_template(getattr(template, f),
+                                        raw_map.get(f))
+                for f in fields
+            })
+        raw_seq = raw if isinstance(raw, (list, tuple, dict)) else []
+        if isinstance(raw_seq, dict):  # tuples serialise as {"0": ..}
+            raw_seq = [raw_seq.get(str(i)) for i in range(len(template))]
+        raw_seq = list(raw_seq) + [None] * (len(template) - len(raw_seq))
+        return tuple(
+            _merge_into_template(v, r) for v, r in zip(template, raw_seq)
+        )
+    if isinstance(template, list):
+        raw_seq = raw if isinstance(raw, (list, tuple)) else []
+        raw_seq = list(raw_seq) + [None] * (len(template) - len(raw_seq))
+        return [
+            _merge_into_template(v, r) for v, r in zip(template, raw_seq)
+        ]
+    return template
+
+
+def _saved_abstract(meta_node: Any, template_node: Any) -> Any:
+    """Abstract restore tree mirroring the SAVED structure, with shardings
+    grafted from ``template_node`` wherever a same-named leaf of the same
+    shape exists.  This keeps the merge fallback viable at scale: leaves
+    the template knows restore directly onto their (possibly ZeRO-1)
+    shardings instead of materialising unsharded on one device; only
+    saved-only leaves (about to be dropped by the merge) land unplaced."""
+    if isinstance(meta_node, dict):
+        if hasattr(template_node, "_fields"):
+            tmpl = {f: getattr(template_node, f)
+                    for f in template_node._fields}
+        elif isinstance(template_node, dict):
+            tmpl = template_node
+        elif isinstance(template_node, (list, tuple)):
+            tmpl = {str(i): v for i, v in enumerate(template_node)}
+        else:
+            tmpl = {}
+        return {k: _saved_abstract(v, tmpl.get(k))
+                for k, v in meta_node.items()}
+    shape = tuple(meta_node.shape)
+    sharding = None
+    if template_node is not None and hasattr(template_node, "dtype") and \
+            tuple(np.shape(template_node)) == shape:
+        sharding = getattr(template_node, "sharding", None)
+    return jax.ShapeDtypeStruct(shape, meta_node.dtype, sharding=sharding)
+
+
 class CheckpointManager:
     """Step-addressed checkpoints under ``directory`` (path layout mirrors
     the reference's ``checkpoints/checkpoint_step_{N}`` naming,
@@ -113,7 +208,14 @@ class CheckpointManager:
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of ``template``.  ``step``
-        defaults to the latest available."""
+        defaults to the latest available.
+
+        Structure drift between versions (a TrainState field added — e.g.
+        ``clean_streak`` in round 3 — or an optimizer-state leaf removed,
+        like the constant schedule's count) falls back to a merge-by-name
+        restore: saved leaves land where the template has a same-named
+        slot, template values fill anything the checkpoint lacks, and
+        extra saved keys are ignored."""
         self._ckptr.wait_until_finished()  # join an in-flight async save
         if step is None:
             step = _latest_step(self.directory)
@@ -130,9 +232,27 @@ class CheckpointManager:
             else x,
             template,
         )
-        state = self._ckptr.restore(path, abstract)
+        try:
+            state = self._ckptr.restore(path, abstract)
+        except Exception as exc:  # structure mismatch: older/newer format
+            logger.warning(
+                "Strict restore failed (%s: %s); retrying with merge-by-"
+                "name (fields missing from the checkpoint keep their "
+                "initialised values)", type(exc).__name__, str(exc)[:200],
+            )
+            raw = self._ckptr.restore(
+                path, _saved_abstract(self._saved_tree(path), template)
+            )
+            state = _merge_into_template(template, raw)
         logger.info("Checkpoint restored: %s", path)
         return state
+
+    def _saved_tree(self, path: str) -> Any:
+        """Structure metadata of a saved checkpoint (dict tree of
+        ArrayMetadata with .shape/.dtype)."""
+        meta = self._ckptr.metadata(path)
+        item = getattr(meta, "item_metadata", meta)
+        return getattr(item, "tree", item)
 
     def latest_step(self) -> Optional[int]:
         return _latest_step(self.directory)

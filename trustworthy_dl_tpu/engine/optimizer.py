@@ -17,10 +17,15 @@ import optax
 from trustworthy_dl_tpu.core.config import TrainingConfig
 
 
-def build_schedule(config: TrainingConfig) -> optax.Schedule:
+def build_schedule(config: TrainingConfig):
     """LR schedule from config: optional linear warmup from 0, then
     constant / cosine / linear decay to ``min_lr_ratio * peak`` over
-    ``lr_decay_steps`` post-warmup steps."""
+    ``lr_decay_steps`` post-warmup steps.
+
+    A genuinely constant schedule (constant with no warmup) returns the
+    bare float: passing a callable makes optax track a
+    ``ScaleByScheduleState`` count leaf, silently changing the opt_state
+    pytree (and thus the checkpoint format) for the default config."""
     peak = config.learning_rate
     name = config.lr_schedule.lower()
     if name not in ("constant", "cosine", "linear"):
@@ -29,6 +34,8 @@ def build_schedule(config: TrainingConfig) -> optax.Schedule:
     decay = max(int(config.lr_decay_steps), 0)
     floor = peak * config.min_lr_ratio
     if name == "constant" or decay == 0:
+        if warmup == 0:
+            return peak
         body = optax.constant_schedule(peak)
     elif name == "cosine":
         body = optax.cosine_decay_schedule(
