@@ -17,6 +17,8 @@ from trustworthy_dl_tpu.engine import DistributedTrainer
 from trustworthy_dl_tpu.elastic.reassignment import compact_train_state
 from trustworthy_dl_tpu.trust.state import NodeStatus
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
                 n_positions=32, seq_len=16)
 

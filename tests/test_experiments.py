@@ -12,6 +12,8 @@ import pytest
 from trustworthy_dl_tpu import ExperimentConfig, ExperimentRunner
 from trustworthy_dl_tpu.experiments import PRESETS, preset_config
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
                 n_positions=32, seq_len=16)
 TINY_DATA = dict(seq_len=16, vocab_size=128, num_examples=64)

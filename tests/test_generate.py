@@ -11,6 +11,8 @@ import jax.numpy as jnp
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.models.generate import generate
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 CFG = gpt2.GPT2Config(vocab_size=97, n_positions=48, n_layer=2, n_embd=32,
                       n_head=4, dtype=jnp.float32)
 

@@ -13,6 +13,8 @@ from trustworthy_dl_tpu.attacks import null_plan
 from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.engine import DistributedTrainer
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
             seq_len=16)
 

@@ -22,6 +22,8 @@ from trustworthy_dl_tpu.models.moe import (
     use_expert_mesh,
 )
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY = dict(vocab_size=128, n_positions=32, n_layer=2, n_embd=32, n_head=4,
             dtype=jnp.float32)
 

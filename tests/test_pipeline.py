@@ -25,6 +25,8 @@ from trustworthy_dl_tpu.parallel.pipeline import (
 )
 from trustworthy_dl_tpu.trust.state import NodeStatus
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY = dict(n_layer=8, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
             seq_len=16)
 

@@ -27,6 +27,8 @@ from trustworthy_dl_tpu.parallel.tensor_parallel import (
     tp_group_size,
 )
 
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
 TINY = dict(
     vocab_size=128, n_positions=32, n_layer=2, n_embd=32, n_head=4,
 )
