@@ -107,3 +107,21 @@ def test_gpt2_flash_end_to_end():
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(r), rtol=2e-3, atol=2e-4
         )
+
+
+def test_auto_attention_dispatch():
+    """attn_impl='auto': XLA path below AUTO_FLASH_MIN_T, flash kernel at
+    long T — numerics match full attention either way."""
+    from trustworthy_dl_tpu.models.gpt2 import AUTO_FLASH_MIN_T, \
+        full_attention, get_attention
+
+    auto = get_attention("auto")
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    for t in (64, AUTO_FLASH_MIN_T):
+        q, k, v = (jax.random.normal(kk, (1, 2, t, 32), jnp.float32)
+                   for kk in ks)
+        np.testing.assert_allclose(
+            np.asarray(auto(q, k, v, True)),
+            np.asarray(full_attention(q, k, v, True)),
+            rtol=2e-4, atol=2e-5,
+        )

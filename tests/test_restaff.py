@@ -149,3 +149,66 @@ def test_restaff_device_column_drop():
     survivors = list(grid[:, keep].reshape(-1))
     assert len(survivors) == 7
     assert grid[0, 5] not in survivors
+
+
+def test_second_restaff_reuses_idle_pool(tmp_path):
+    """Survivors a repartition could not seat park in the idle pool and
+    are candidates at the next restaff: after 8→4 stages (3 idle + 1
+    evicted), a second compromise repartitions again and the pool is
+    consulted — total healthy identities are conserved (never silently
+    discarded)."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        learning_rate=3e-3, num_epochs=1, num_nodes=8, optimizer="adamw",
+        parallelism="model", num_microbatches=4,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+        detector_warmup=4, elastic_resharding=True,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=64)
+    trainer.initialize()
+    attacker = AdversarialAttacker(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[5],
+                     intensity=0.5, start_step=8)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    epoch = 0
+    while trainer.config.num_nodes == 8 and epoch < 4:
+        trainer.train_epoch(dl, epoch)
+        epoch += 1
+    assert trainer.config.num_nodes == 4
+    assert len(trainer._idle_pool) == 3          # 8 - 1 evicted - 4 seated
+    assert 5 not in trainer._idle_pool
+
+    # Second compromise: attack the current coordinate 1.
+    from trustworthy_dl_tpu.attacks.adversarial import plan_from_config
+
+    victim = trainer.node_map[1]
+    plan2 = plan_from_config(
+        AttackConfig(attack_types=["gradient_poisoning"], target_nodes=[1],
+                     intensity=0.5, start_step=0),
+        num_nodes=4, active=True,
+    )
+    trainer.set_attack_plan(plan2)
+    while trainer.config.num_nodes == 4 and epoch < 8:
+        trainer.train_epoch(dl, epoch)
+        epoch += 1
+    records = [r for r in trainer.reassignment_history
+               if "new_num_stages" in r]
+    assert len(records) == 2
+    # Candidates for the second restaff = 3 on-mesh survivors + 3 pooled
+    # → largest divisor of 8 ≤ 6 is 4 again: the pool re-seated someone.
+    assert records[1]["new_num_stages"] == 4
+    assert trainer.config.num_nodes == 4
+    assert victim not in trainer.node_map
+    # Identity conservation: seated + pooled + evicted == original 8.
+    evicted = {nid for r in records for nid in r["evicted_nodes"]}
+    assert evicted == {5, victim}
+    assert set(trainer.node_map) | set(trainer._idle_pool) | evicted == \
+        set(range(8))
+    assert len(trainer.node_map) == 4 and len(trainer._idle_pool) == 2
+    # Training still runs on the restaffed fleet.
+    loss = trainer.train_epoch(dl, epoch)
+    assert np.isfinite(loss)

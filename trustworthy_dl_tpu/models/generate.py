@@ -150,6 +150,9 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
         x, lk, lv = _block_with_cache(block, x, lk, lv, start, cfg)
         return x, (lk, lv)
 
+    # Rolled layer scan: unrolling was measured SLOWER on v5e decode
+    # (1.39 vs 1.24 ms/token b=1) — the rolled body's weight streams
+    # pipeline fine, and the smaller program wins.
     x, (new_k, new_v) = jax.lax.scan(
         scan_fn, x, (params["blocks"], cache.k, cache.v)
     )

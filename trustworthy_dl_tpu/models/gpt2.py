@@ -48,7 +48,10 @@ class GPT2Config:
     n_embd: int = 768
     n_head: int = 12
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "full"   # full | flash | ring | ulysses
+    # full | flash | ring | ulysses | auto ("auto" = flash for T >=
+    # AUTO_FLASH_MIN_T where the kernel's advantage is measured, fused
+    # XLA path below it)
+    attn_impl: str = "full"
     remat: bool = False
     # Remat granularity when ``remat`` is on: "block" rematerialises the
     # whole transformer block (max memory saving, max recompute);
@@ -106,6 +109,27 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 register_attention("full", full_attention)
 
 
+AUTO_FLASH_MIN_T = 1024
+
+
+def _auto_attention(q, k, v, causal=True):
+    """Per-shape dispatch: the Pallas flash kernel where its advantage is
+    real (long sequences — O(T·D) memory AND faster than the XLA path,
+    BASELINE.md long-context rows), the fused XLA path below
+    AUTO_FLASH_MIN_T where the full-step measurements favour it under
+    rematerialisation.  Shapes are static under jit, so the branch
+    resolves at trace time."""
+    from trustworthy_dl_tpu.ops.flash_attention import (
+        flash_attention,
+        supports_flash,
+    )
+
+    t, d = q.shape[-2], q.shape[-1]
+    if t >= AUTO_FLASH_MIN_T and supports_flash(t, d):
+        return flash_attention(q, k, v, causal)
+    return _ATTN_REGISTRY["full"](q, k, v, causal)
+
+
 def get_attention(name: str) -> AttnFn:
     if name not in _ATTN_REGISTRY:
         # Late registration: sequence-parallel impls live in parallel/,
@@ -115,6 +139,8 @@ def get_attention(name: str) -> AttnFn:
         elif name == "flash":
             from trustworthy_dl_tpu.ops.flash_attention import flash_attention
             register_attention("flash", flash_attention)
+        elif name == "auto":
+            register_attention("auto", _auto_attention)
         if name not in _ATTN_REGISTRY:
             raise ValueError(f"unknown attention impl {name!r}")
     return _ATTN_REGISTRY[name]
