@@ -90,6 +90,9 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
     overrides: dict = {}
     if model.startswith("gpt"):
         overrides["seq_len"] = seq_len
+        if seq_len > 1024:
+            # Long-context runs need the position table to match.
+            overrides["n_positions"] = seq_len
         attn = os.environ.get("TDDL_BENCH_ATTN")
         if attn:
             overrides["attn_impl"] = attn

@@ -115,3 +115,62 @@ def test_prepared_corpus_trains(tmp_path):
     losses = [trainer.train_epoch(dl, e) for e in range(3)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_native_bpe_matches_python_tier():
+    """The C++ batch encoder and the Python merge loop are bit-exact on
+    the same merge table (the contract every native routine carries,
+    tests/test_native.py style)."""
+    import trustworthy_dl_tpu.data.tokenizer as T
+    import trustworthy_dl_tpu.native as native
+
+    text = CORPUS + " zyzzyva qwfp unseen-words héllo wörld 123,456!"
+    tok_a = BPETokenizer.train(CORPUS, 400)
+    merges = [m for m, _ in sorted(tok_a.ranks.items(),
+                                   key=lambda kv: kv[1])]
+    tok_b = BPETokenizer(tok_a.vocab, merges)
+
+    ids_native = tok_a.encode(text)
+
+    real_load = native.bpe_load
+    owner = T._NATIVE_TABLE_OWNER
+    native.bpe_load = lambda *a: False  # force the Python tier
+    T._NATIVE_TABLE_OWNER = None
+    try:
+        ids_python = tok_b.encode(text)
+    finally:
+        native.bpe_load = real_load
+        T._NATIVE_TABLE_OWNER = owner
+
+    assert ids_python == ids_native
+    assert tok_a.decode(ids_native) == text
+
+
+def test_two_tokenizers_interleaved_native_table():
+    """The native encoder holds one global merge table; interleaving two
+    tokenizers must transparently re-install the right table (regression
+    for cross-tokenizer contamination)."""
+    tok_a = BPETokenizer.train("aaa bbb aaa bbb " * 50, 280)
+    tok_b = BPETokenizer.train(CORPUS, 400)
+    a1 = tok_a.encode("aaa bbb ccc")
+    b1 = tok_b.encode("the quick brown fox")
+    a2 = tok_a.encode("aaa bbb ccc")
+    b2 = tok_b.encode("the quick brown fox")
+    assert a1 == a2 and b1 == b2
+    assert tok_a.decode(a1) == "aaa bbb ccc"
+    assert tok_b.decode(b1) == "the quick brown fox"
+
+
+def test_cache_cap_does_not_break_encode(monkeypatch):
+    """Regression: with the word cache full, encode() must still resolve
+    every word (per-call overlay) and never insert past the cap."""
+    import trustworthy_dl_tpu.data.tokenizer as T
+
+    monkeypatch.setattr(T, "_CACHE_CAP", 2)
+    tok = BPETokenizer.train(CORPUS, 300)
+    text = "the quick brown fox jumps over the lazy dog"
+    ids1 = tok.encode(text)
+    assert len(tok._cache) <= 2
+    ids2 = tok.encode(text)  # capped cache, mixed hits/misses
+    assert ids1 == ids2
+    assert tok.decode(ids1) == text

@@ -67,6 +67,13 @@ def bytes_to_unicode() -> Dict[int, str]:
 _BYTE_ENCODER = bytes_to_unicode()
 _BYTE_DECODER = {v: k for k, v in _BYTE_ENCODER.items()}
 
+# Which tokenizer's merge table is installed in the native encoder
+# (generation number; None = fallback / not installed).
+_NATIVE_TABLE_OWNER: Optional[int] = None
+_TABLE_GEN = iter(range(1, 1 << 62))
+# Word-cache bound per tokenizer (entries, str -> ids).
+_CACHE_CAP = 262_144
+
 
 def _word_to_units(word: str) -> Tuple[str, ...]:
     """Pre-token → tuple of byte-units in the unicode alphabet."""
@@ -185,28 +192,72 @@ class BPETokenizer:
         self.vocab = dict(vocab)
         self.decoder = {i: t for t, i in self.vocab.items()}
         self.ranks = {tuple(m): r for r, m in enumerate(merges)}
-        self._cache: Dict[str, List[str]] = {}
+        # Id-space merge table: encoding runs over token ids, not strings
+        # (enables the native C++ batch encoder; the Python loop uses the
+        # same table so both tiers are bit-exact).  Merges whose product
+        # is absent from the vocabulary are excluded — they could never
+        # produce an emittable token.
+        self._id_ranks: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        lefts, rights, prods = [], [], []
+        for (a, b), rank in sorted(self.ranks.items(), key=lambda kv: kv[1]):
+            ia, ib, ip = (self.vocab.get(a), self.vocab.get(b),
+                          self.vocab.get(a + b))
+            if ia is None or ib is None or ip is None:
+                continue
+            if (ia, ib) not in self._id_ranks:
+                self._id_ranks[(ia, ib)] = (rank, ip)
+            lefts.append(ia)
+            rights.append(ib)
+            prods.append(ip)
+        self._merge_arrays = (np.asarray(lefts, np.int32),
+                              np.asarray(rights, np.int32),
+                              np.asarray(prods, np.int32))
+        self._table_gen = next(_TABLE_GEN)
+        self._cache: Dict[str, List[int]] = {}  # matched word -> ids
 
-    # -- core BPE ------------------------------------------------------
+    # -- core BPE (id space) -------------------------------------------
 
-    def _bpe(self, word: Tuple[str, ...]) -> List[str]:
-        key = " ".join(word)
-        cached = self._cache.get(key)
-        if cached is not None:
-            return cached
+    def _bpe_ids(self, word: Tuple[int, ...]) -> List[int]:
+        """Python merge loop — bit-exact mirror of the native encoder."""
         parts = list(word)
         while len(parts) > 1:
-            best_rank, best_i = None, None
+            best, best_i = None, None
             for i, pair in enumerate(zip(parts, parts[1:])):
-                r = self.ranks.get(pair)
-                if r is not None and (best_rank is None or r < best_rank):
-                    best_rank, best_i = r, i
+                hit = self._id_ranks.get(pair)
+                if hit is not None and (best is None or hit[0] < best[0]):
+                    best, best_i = hit, i
             if best_i is None:
                 break
-            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
-        if len(self._cache) < 65536:
-            self._cache[key] = parts
+            parts[best_i:best_i + 2] = [best[1]]
         return parts
+
+    def _encode_words(self, words: List[Tuple[int, ...]]
+                      ) -> List[List[int]]:
+        """Batch-encode unit-id words: one native call for the whole batch
+        (the per-word merge loop dominates corpus tokenization in Python),
+        falling back to the Python loop when the native tier is absent."""
+        from trustworthy_dl_tpu import native
+
+        # The native encoder holds ONE merge table; re-install when a
+        # different tokenizer instance was active (cheap: one pass over
+        # the merge list).
+        global _NATIVE_TABLE_OWNER
+        if _NATIVE_TABLE_OWNER != self._table_gen:
+            _NATIVE_TABLE_OWNER = (
+                self._table_gen if native.bpe_load(*self._merge_arrays)
+                else None
+            )
+        if _NATIVE_TABLE_OWNER != self._table_gen:
+            return [self._bpe_ids(w) for w in words]
+        offsets = np.zeros(len(words) + 1, np.int64)
+        for i, w in enumerate(words):
+            offsets[i + 1] = offsets[i] + len(w)
+        flat = np.fromiter(
+            (u for w in words for u in w), np.int32, count=int(offsets[-1])
+        )
+        out, out_offsets = native.bpe_encode(flat, offsets)
+        return [out[out_offsets[i]:out_offsets[i + 1]].tolist()
+                for i in range(len(words))]
 
     # -- public API ----------------------------------------------------
 
@@ -215,18 +266,31 @@ class BPETokenizer:
         return len(self.vocab)
 
     def encode(self, text: str) -> List[int]:
-        ids: List[int] = []
-        for m in _PAT.findall(text):
-            for token in self._bpe(_word_to_units(m)):
-                tid = self.vocab.get(token)
-                if tid is None:
-                    # Unknown merge product (foreign merges file): fall
-                    # back to the token's individual byte units, which are
-                    # always in the vocabulary.
-                    ids.extend(self.vocab[u] for u in token)
-                else:
-                    ids.append(tid)
-        return ids
+        words = _PAT.findall(text)
+        cache = self._cache
+        # Misses keyed on the MATCHED STRING (dict preserves first-seen
+        # order): repeated words skip unit mapping entirely — on natural
+        # text (Zipfian) that is nearly all of them.
+        fresh = [m for m in dict.fromkeys(words) if m not in cache]
+        local: Dict[str, List[int]] = {}
+        if fresh:
+            unit_words = [
+                tuple(self.vocab[u] for u in _word_to_units(m))
+                for m in fresh
+            ]
+            local = dict(zip(fresh, self._encode_words(unit_words)))
+            # Bounded cache: stop inserting at the cap (never evict —
+            # entries resolved earlier in THIS call must stay reachable);
+            # the per-call overlay below serves the overflow.
+            budget = _CACHE_CAP - len(cache)
+            if budget > 0:
+                for m in fresh[:budget]:
+                    cache[m] = local[m]
+        out: List[int] = []
+        for m in words:
+            ids = cache.get(m)
+            out.extend(local[m] if ids is None else ids)
+        return out
 
     def decode(self, ids: Iterable[int]) -> str:
         text = "".join(self.decoder[int(i)] for i in ids)

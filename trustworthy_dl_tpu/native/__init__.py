@@ -111,6 +111,10 @@ def _load() -> Optional[ctypes.CDLL]:
         i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_uint64, i32p, i32p, ctypes.c_int32
     ]
+    lib.tddl_bpe_load.argtypes = [i32p, i32p, i32p, ctypes.c_int64]
+    lib.tddl_bpe_encode.argtypes = [
+        i32p, i64p, ctypes.c_int64, i32p, i64p
+    ]
     _LIB = lib
     return _LIB
 
@@ -267,3 +271,50 @@ __all__ = [
     "synthetic_tokens",
     "window_gather",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE encoder (hot path of data/tokenizer.py)
+# ---------------------------------------------------------------------------
+
+
+def bpe_load(lefts: np.ndarray, rights: np.ndarray, prods: np.ndarray
+             ) -> bool:
+    """Install the merge table (id pairs -> product id, rank = position)
+    into the native encoder.  Returns False when the native tier is
+    unavailable — the tokenizer then runs its bit-exact Python merge
+    loop."""
+    lib = _load()
+    if lib is None:
+        return False
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lefts = np.ascontiguousarray(lefts, np.int32)
+    rights = np.ascontiguousarray(rights, np.int32)
+    prods = np.ascontiguousarray(prods, np.int32)
+    lib.tddl_bpe_load(
+        lefts.ctypes.data_as(i32p), rights.ctypes.data_as(i32p),
+        prods.ctypes.data_as(i32p), len(lefts),
+    )
+    return True
+
+
+def bpe_encode(flat: np.ndarray, offsets: np.ndarray
+               ) -> "tuple[np.ndarray, np.ndarray]":
+    """Encode a flat batch of unit-id words (``offsets`` delimits each
+    word) with the table installed by ``bpe_load``.  Returns
+    (flat_out, out_offsets)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native tier unavailable; call bpe_load first")
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    flat = np.ascontiguousarray(flat, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = np.empty(max(len(flat), 1), np.int32)
+    out_offsets = np.empty(len(offsets), np.int64)
+    lib.tddl_bpe_encode(
+        flat.ctypes.data_as(i32p), offsets.ctypes.data_as(i64p),
+        len(offsets) - 1, out.ctypes.data_as(i32p),
+        out_offsets.ctypes.data_as(i64p),
+    )
+    return out[: out_offsets[-1]], out_offsets

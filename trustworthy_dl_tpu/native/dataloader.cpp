@@ -13,9 +13,11 @@
 //
 // Build: g++ -O3 -shared -fPIC -o libtddl_native.so dataloader.cpp -lpthread
 
+#include <climits>
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -144,6 +146,70 @@ void tddl_window_gather(const int32_t* stream, int64_t stream_len,
     workers.emplace_back([=]() { work(lo, hi); });
   }
   for (auto& t : workers) t.join();
+}
+
+
+// ---------------------------------------------------------------------------
+// Byte-level BPE encoder (data/tokenizer.py hot path).
+//
+// Works entirely in token-id space: the Python layer maps byte units to
+// their vocabulary ids and hands over (a) the merge table as id pairs with
+// each product's id, (b) a flat batch of pre-tokenized words.  The merge
+// loop (find the lowest-rank adjacent pair, fuse, repeat) is the
+// per-character-quadratic inner loop that dominates corpus tokenization in
+// Python.  Merges whose product is absent from the vocabulary are excluded
+// by the caller — both tiers share that rule, so outputs are bit-exact.
+// ---------------------------------------------------------------------------
+
+static std::unordered_map<uint64_t, int32_t> g_bpe_ranks;
+static std::vector<int32_t> g_bpe_prod;  // rank -> product token id
+
+void tddl_bpe_load(const int32_t* lefts, const int32_t* rights,
+                   const int32_t* prods, int64_t n_merges) {
+  g_bpe_ranks.clear();
+  g_bpe_ranks.reserve((size_t)n_merges * 2);
+  g_bpe_prod.assign((size_t)n_merges, 0);
+  for (int64_t i = 0; i < n_merges; ++i) {
+    uint64_t key =
+        ((uint64_t)(uint32_t)lefts[i] << 32) | (uint32_t)rights[i];
+    // First occurrence wins (lowest rank), matching dict-of-ranks
+    // semantics on duplicate pairs in a merges file.
+    g_bpe_ranks.emplace(key, (int32_t)i);
+    g_bpe_prod[(size_t)i] = prods[i];
+  }
+}
+
+// words: flat unit-id stream; offsets[n_words+1] delimit each word.
+// out must hold offsets[n_words] ids (output never exceeds input);
+// out_offsets[n_words+1] receives the encoded extents.
+void tddl_bpe_encode(const int32_t* flat, const int64_t* offsets,
+                     int64_t n_words, int32_t* out, int64_t* out_offsets) {
+  std::vector<int32_t> buf;
+  int64_t w = 0;
+  out_offsets[0] = 0;
+  for (int64_t i = 0; i < n_words; ++i) {
+    const int32_t* word = flat + offsets[i];
+    const int64_t n = offsets[i + 1] - offsets[i];
+    buf.assign(word, word + n);
+    while (buf.size() > 1) {
+      int32_t best_rank = INT_MAX;
+      int64_t best = -1;
+      for (int64_t j = 0; j + 1 < (int64_t)buf.size(); ++j) {
+        uint64_t key =
+            ((uint64_t)(uint32_t)buf[j] << 32) | (uint32_t)buf[j + 1];
+        auto it = g_bpe_ranks.find(key);
+        if (it != g_bpe_ranks.end() && it->second < best_rank) {
+          best_rank = it->second;
+          best = j;
+        }
+      }
+      if (best < 0) break;
+      buf[(size_t)best] = g_bpe_prod[(size_t)best_rank];
+      buf.erase(buf.begin() + best + 1);
+    }
+    for (int32_t t : buf) out[w++] = t;
+    out_offsets[i + 1] = w;
+  }
 }
 
 }  // extern "C"
