@@ -3,6 +3,7 @@ reference simulated its training step, experiment_runner.py:201-216) and
 produce the full artifact contract — JSON + CSV + 4 PNGs + markdown report
 (experiment_runner.py:325-359, 521-591)."""
 
+import dataclasses
 import json
 import os
 
@@ -111,13 +112,15 @@ def test_report_mentions_real_quality(experiment_run):
 
 
 def test_presets_cover_baseline_matrix():
-    """BASELINE.md's five benchmark configs exist as runnable presets."""
+    """BASELINE.md's five benchmark configs exist as runnable presets
+    (plus the beyond-reference recovery lifecycle preset)."""
     assert set(PRESETS) == {
         "resnet32_cifar10_clean",
         "vgg16_cifar10_poisoning",
         "gpt2_small_pipeline_clean",
         "gpt2_medium_reassignment",
         "resnet101_byzantine",
+        "gpt2_transient_recovery",
     }
     cfg = preset_config("vgg16_cifar10_poisoning", num_epochs=1)
     assert cfg.model_name == "vgg16"
@@ -177,3 +180,60 @@ def test_cli_generate_smoke(tmp_path):
         model_overrides=tiny,
     )
     assert rc == 2
+
+
+def test_transient_recovery_experiment(tmp_path):
+    """The full elastic lifecycle as a measured experiment: transient
+    attack → eviction → attack ends → readmission — the runner records
+    the topology timeline and the summary reports recovery."""
+    config = preset_config(
+        "gpt2_transient_recovery",
+        experiment_name="tiny_recovery",
+        num_epochs=5, batch_size=16, learning_rate=3e-3,
+        steps_per_epoch=6, attack_start_epoch=1, attack_end_epoch=2,
+        readmit_after_steps=8, output_dir=str(tmp_path),
+    )
+    runner = ExperimentRunner(
+        config, model_overrides=dict(TINY_GPT),
+        data_overrides=dict(seq_len=16, vocab_size=128, num_examples=96),
+    )
+    # Small detector warmup so detection lands inside the attack window.
+    runner.training_config = dataclasses.replace(
+        runner.training_config, detector_warmup=4,
+    )
+    results = runner.run_experiment()
+
+    summary = results["experiment_summary"]
+    assert summary["total_evictions"] >= 1
+    assert summary["total_readmissions"] >= 1
+    assert summary["final_live_nodes"] == 8
+    assert summary["recovered_nodes"] == [5]
+    # Topology timeline recorded per epoch: dips to 7, returns to 8.
+    live = [r["live_nodes"] for r in results["epoch_records"]]
+    assert min(live) == 7 and live[-1] == 8
+    assert all(np.isfinite(r["training_loss"])
+               for r in results["epoch_records"])
+    assert (runner.output_dir / "experiment_results.json").exists()
+
+
+def test_cli_generate_text_prompt(tmp_path, capsys):
+    """--prompt-text round-trips through the BPE tokenizer: the prompt is
+    encoded, the continuation decoded back to text."""
+    from trustworthy_dl_tpu.cli import generate_main
+    from trustworthy_dl_tpu.data.tokenizer import BPETokenizer
+
+    tok = BPETokenizer.train("hello world of tokens " * 80, 280)
+    tok_dir = tmp_path / "tok"
+    tok.save(str(tok_dir))
+    tiny = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=512,
+                n_positions=32, seq_len=16)
+    rc = generate_main([
+        "--model", "gpt2", "--checkpoint-dir", str(tmp_path / "none"),
+        "--prompt-text", "hello world", "--tokenizer-dir", str(tok_dir),
+        "--max-new-tokens", "4", "--temperature", "0.8",
+    ], model_overrides=tiny)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hello world" in out
+    # --prompt-text without a tokenizer dir is refused clearly.
+    assert generate_main(["--model", "gpt2", "--prompt-text", "hi"]) == 2

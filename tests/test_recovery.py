@@ -264,3 +264,21 @@ def test_host_detection_stats_reflect_ground_truth(tmp_path):
     assert stats["false_positive_rate"] == 0.0
     assert sum(stats["attack_type_distribution"].values()) == \
         stats["total_detections"]
+
+
+def test_attacker_plan_for_live_topology():
+    """plan_for lays the target mask in COORDINATE space via node_map —
+    an attack on original identity 7 lands wherever 7 currently sits
+    after evictions (fast unit for the runner's post-eviction path)."""
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[7],
+        intensity=0.5, start_step=0,
+    ))
+    attacker.activate_attacks()
+    node_map = [0, 1, 3, 4, 5, 6, 7]  # identity 2 was evicted
+    plan = attacker.plan_for(node_map)
+    mask = np.asarray(plan.target_mask)
+    assert mask.shape == (7,)
+    assert mask[6] and mask.sum() == 1  # identity 7 sits at coordinate 6
+    # plan() (identity == coordinate) would have dropped the target:
+    assert not np.asarray(attacker.plan(7).target_mask)[6]

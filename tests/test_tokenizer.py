@@ -174,3 +174,4 @@ def test_cache_cap_does_not_break_encode(monkeypatch):
     ids2 = tok.encode(text)  # capped cache, mixed hits/misses
     assert ids1 == ids2
     assert tok.decode(ids1) == text
+

@@ -209,8 +209,20 @@ class AdversarialAttacker:
         return self._active
 
     def plan(self, num_nodes: int) -> AttackPlan:
-        """Compile into the in-step schedule."""
+        """Compile into the in-step schedule (identity == coordinate —
+        valid before any elastic topology change)."""
         return plan_from_config(self.config, num_nodes, active=self._active)
+
+    def plan_for(self, node_map: List[int]) -> AttackPlan:
+        """Compile the schedule for a LIVE topology: ``node_map[i]`` is the
+        original identity at mesh coordinate i (the trainer's mapping
+        after evictions/readmissions), so the mask bit lands on the
+        targeted identity wherever it currently sits."""
+        plan = plan_from_config(self.config, len(node_map),
+                                active=self._active)
+        targets = set(self.config.target_nodes)
+        mask = np.array([nid in targets for nid in node_map], bool)
+        return plan._replace(target_mask=jnp.asarray(mask))
 
     def apply_attacks(self, batch: Dict[str, np.ndarray], batch_idx: int
                       ) -> Dict[str, np.ndarray]:

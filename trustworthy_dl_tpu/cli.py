@@ -107,6 +107,12 @@ def build_generate_parser() -> argparse.ArgumentParser:
                              "(falls back to fresh init with a warning)")
     parser.add_argument("--prompt", type=str, default="1,2,3,4",
                         help="comma-separated token ids")
+    parser.add_argument("--prompt-text", type=str, default=None,
+                        help="raw text prompt; needs --tokenizer-dir "
+                             "(output is decoded back to text)")
+    parser.add_argument("--tokenizer-dir", type=str, default=None,
+                        help="vocab.json + merges.txt directory "
+                             "(trustworthy-dl-prepare-data writes one)")
     parser.add_argument("--max-new-tokens", type=int, default=32)
     parser.add_argument("--temperature", type=float, default=0.8)
     parser.add_argument("--top-k", type=int, default=40)
@@ -149,18 +155,39 @@ def generate_main(argv: Optional[List[str]] = None,
             return 2
     # Validate the prompt BEFORE the expensive init/restore: the int parse
     # needs nothing, the vocab bound only needs the (cheap) model config.
-    try:
-        tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
-    except ValueError:
-        print(f"--prompt must be comma-separated token ids, got "
-              f"{args.prompt!r}")
-        return 2
+    tokenizer = None
+    if args.prompt_text is not None:
+        if not args.tokenizer_dir:
+            print("--prompt-text requires --tokenizer-dir")
+            return 2
+        from trustworthy_dl_tpu.data.tokenizer import BPETokenizer
+
+        try:
+            tokenizer = BPETokenizer.load(args.tokenizer_dir)
+        except (OSError, ValueError) as exc:
+            print(f"could not load tokenizer from {args.tokenizer_dir!r}: "
+                  f"{exc}")
+            return 2
+        tokens = tokenizer.encode(args.prompt_text)
+    else:
+        try:
+            tokens = [int(t) for t in args.prompt.split(",") if t.strip()]
+        except ValueError:
+            print(f"--prompt must be comma-separated token ids, got "
+                  f"{args.prompt!r}")
+            return 2
     config = TrainingConfig(model_name=args.model, num_nodes=1, batch_size=1,
                             checkpoint_dir=args.checkpoint_dir)
     trainer = DistributedTrainer(config, model_overrides=model_overrides)
     vocab = trainer.model.config.vocab_size
     if not tokens or any(not 0 <= t < vocab for t in tokens):
-        print(f"--prompt needs at least one token id in [0, {vocab})")
+        if tokenizer is not None:
+            print(f"--prompt-text encoded to {len(tokens)} token id(s); "
+                  f"the model accepts ids in [0, {vocab}) — the tokenizer "
+                  f"(vocab {tokenizer.vocab_size}) and model vocabularies "
+                  "must be compatible and the prompt non-empty")
+        else:
+            print(f"--prompt needs at least one token id in [0, {vocab})")
         return 2
     trainer.initialize()
     try:
@@ -178,8 +205,13 @@ def generate_main(argv: Optional[List[str]] = None,
         top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.PRNGKey(args.seed),
     )
-    print("prompt:    ", tokens)
-    print("generated: ", out[0, len(tokens):].tolist())
+    new_ids = out[0, len(tokens):].tolist()
+    if tokenizer is not None:
+        print("prompt:    ", args.prompt_text)
+        print("generated: ", tokenizer.decode(new_ids))
+    else:
+        print("prompt:    ", tokens)
+        print("generated: ", new_ids)
     trainer.cleanup()
     return 0
 

@@ -204,6 +204,10 @@ class ExperimentConfig:
     learning_rate: float = 5e-5
     attack_enabled: bool = True
     attack_start_epoch: int = 2
+    # Transient attacks: deactivate injection from this epoch on (None =
+    # sustained for the rest of the run) — the vehicle for recovery /
+    # readmission experiments.
+    attack_end_epoch: Optional[int] = None
     attack_intensity: float = 0.5
     trust_threshold: float = 0.7
     save_interval: int = 100
@@ -218,6 +222,11 @@ class ExperimentConfig:
     # The reference hardcodes nodes [1, 3] (experiment_runner.py:93).
     target_nodes: List[int] = field(default_factory=lambda: [1, 3])
     num_microbatches: int = 4
+    # Elastic / recovery knobs forwarded to the trainer (recovery
+    # experiments: transient attack -> eviction -> readmission).
+    elastic_resharding: bool = False
+    readmit_after_steps: int = 0
+    recovery_probation_steps: int = 25
 
     def to_training_config(self) -> TrainingConfig:
         """Build the trainer config the way the reference runner does
@@ -233,6 +242,9 @@ class ExperimentConfig:
             parallelism=self.parallelism,
             num_microbatches=self.num_microbatches,
             seed=self.seed,
+            elastic_resharding=self.elastic_resharding,
+            readmit_after_steps=self.readmit_after_steps,
+            recovery_probation_steps=self.recovery_probation_steps,
         )
 
 

@@ -293,8 +293,17 @@ class BPETokenizer:
         return out
 
     def decode(self, ids: Iterable[int]) -> str:
-        text = "".join(self.decoder[int(i)] for i in ids)
-        data = bytes(_BYTE_DECODER[c] for c in text)
+        """Ids -> text.  Ids outside the vocabulary (e.g. sampled from a
+        model whose embedding table is larger than this tokenizer) decode
+        to U+FFFD instead of raising — decode must never crash on model
+        output."""
+        data = bytearray()
+        for i in ids:
+            token = self.decoder.get(int(i))
+            if token is None:
+                data += b"\xef\xbf\xbd"  # U+FFFD replacement character
+            else:
+                data += bytes(_BYTE_DECODER[c] for c in token)
         return data.decode("utf-8", errors="replace")
 
     # -- persistence (GPT-2 file format) -------------------------------

@@ -19,7 +19,7 @@ from __future__ import annotations
 import enum
 import logging
 import time
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -338,14 +338,28 @@ class DistributedTrainer:
         )
         return state._replace(**placed, **shared, **scalars)
 
-    def set_attack_plan(self, plan: AttackPlan) -> None:
-        """Install the experiment's fault-injection schedule."""
+    def set_attack_plan(self, plan: AttackPlan,
+                        target_ids: Optional[Sequence[int]] = None) -> None:
+        """Install the experiment's fault-injection schedule.
+
+        ``target_ids`` optionally names the targeted ORIGINAL identities —
+        pass it when identities may be off-mesh at install time (evicted
+        before activation): the coordinate-space mask cannot carry their
+        bit, and without it a later readmission would wrongly re-enter
+        them as clean."""
         self.attack_plan = plan
-        mask = np.asarray(plan.target_mask)
-        self._plan_bits = {
-            self.node_map[i]: bool(mask[i])
-            for i in range(min(len(mask), len(self.node_map)))
-        }
+        if target_ids is not None:
+            targets = {int(i) for i in target_ids}
+            self._plan_bits = {
+                nid: nid in targets
+                for nid in set(self.node_map) | targets
+            }
+        else:
+            mask = np.asarray(plan.target_mask)
+            self._plan_bits = {
+                self.node_map[i]: bool(mask[i])
+                for i in range(min(len(mask), len(self.node_map)))
+            }
 
     # ------------------------------------------------------------------
     # Batch plumbing

@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from trustworthy_dl_tpu.attacks.adversarial import AdversarialAttacker
+from trustworthy_dl_tpu.attacks.adversarial import AdversarialAttacker, \
+    null_plan
 from trustworthy_dl_tpu.core.config import (
     AttackConfig,
     ExperimentConfig,
@@ -144,10 +145,27 @@ class ExperimentRunner:
             epoch_start = time.time()
             if (self.config.attack_enabled and self.attacker
                     and epoch >= self.config.attack_start_epoch
+                    and (self.config.attack_end_epoch is None
+                         or epoch < self.config.attack_end_epoch)
                     and not self.attacker.is_active()):
                 self.attacker.activate_attacks()
+                # plan_for: targets are ORIGINAL identities; a
+                # pre-activation eviction means coordinate != identity.
+                # target_ids carries identities that are currently
+                # off-mesh so a readmission during the attack window
+                # re-attacks them.
                 self.trainer.set_attack_plan(
-                    self.attacker.plan(self.config.num_nodes)
+                    self.attacker.plan_for(self.trainer.node_map),
+                    target_ids=self.attacker.config.target_nodes,
+                )
+            if (self.attacker and self.attacker.is_active()
+                    and self.config.attack_end_epoch is not None
+                    and epoch >= self.config.attack_end_epoch):
+                # Transient attack over: the recovery/readmission story
+                # (probation + elastic readmission) plays out from here.
+                self.attacker.deactivate_attacks()
+                self.trainer.set_attack_plan(
+                    null_plan(self.trainer.config.num_nodes)
                 )
             epoch_loss = self.trainer.train_epoch(self.train_loader, epoch)
             val_loss = (self.trainer.validate(self.val_loader)
@@ -183,6 +201,12 @@ class ExperimentRunner:
             "system_trust": tm.calculate_system_trust(),
             "attacks_detected_so_far": len(self.trainer.attack_history),
             "reassignments_so_far": len(self.trainer.reassignment_history),
+            # Elastic topology timeline: live coordinate count and the
+            # identities they carry (evictions shrink it, readmissions
+            # grow it back).
+            "live_nodes": self.trainer.config.num_nodes,
+            "node_map": list(self.trainer.node_map),
+            "readmissions_so_far": self._count_records("readmitted_nodes"),
             "system_metrics": self._system_metrics(),
         }
         if val_loss is not None:
@@ -190,6 +214,13 @@ class ExperimentRunner:
         if self.attacker is not None:
             snapshot["attack_metrics"] = self.attacker.get_attack_statistics()
         return snapshot
+
+    def _count_records(self, key: str) -> int:
+        """Reassignment-history records of one kind (eviction records
+        carry 'evicted_nodes', readmissions 'readmitted_nodes')."""
+        return sum(
+            1 for r in self.trainer.reassignment_history if key in r
+        )
 
     def _system_metrics(self) -> Dict[str, Any]:
         """Measured system metrics (the reference simulated these,
@@ -277,6 +308,14 @@ class ExperimentRunner:
             ),
             "total_attacks_detected": len(self.trainer.attack_history),
             "total_reassignments": len(self.trainer.reassignment_history),
+            "total_evictions": self._count_records("evicted_nodes"),
+            "total_readmissions": self._count_records("readmitted_nodes"),
+            "final_live_nodes": self.trainer.config.num_nodes,
+            "recovered_nodes": sorted({
+                nid for r in self.trainer.reassignment_history
+                if "readmitted_nodes" in r for nid in r["readmitted_nodes"]
+                if nid in self.trainer.node_map
+            }),
             "detection_quality": self._detection_quality(),
         }
         return {
@@ -624,6 +663,15 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         model_name="resnet101", dataset_name="cifar10", num_nodes=8,
         attack_enabled=True, attack_types=["byzantine"],
         target_nodes=[1, 3], parallelism="data",
+    ),
+    # 6. (beyond-reference) Transient attack -> eviction -> recovery /
+    #    readmission: the full elastic lifecycle as a measured experiment.
+    "gpt2_transient_recovery": dict(
+        model_name="gpt2", dataset_name="openwebtext", num_nodes=8,
+        attack_enabled=True, attack_types=["gradient_poisoning"],
+        target_nodes=[5], attack_start_epoch=1, attack_end_epoch=3,
+        parallelism="data", elastic_resharding=True,
+        readmit_after_steps=60, num_epochs=6,
     ),
 }
 
