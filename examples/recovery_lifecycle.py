@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""The full elastic lifecycle in one run: transient attack → detection →
+eviction → attack ends → probation → readmission.
+
+A node mounts a gradient-poisoning attack for a bounded window.  The
+in-step detector confirms it, its mesh coordinate is evicted (state
+compacted + migrated to the survivors, step re-jitted), and once the
+attack window closes the cool-off elapses and the coordinate is
+readmitted on probation — fresh detector baselines, RECOVERING trust,
+boosted recovery rate.  A false positive costs bounded steps, not 1/n of
+the fleet forever.
+
+Run:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/recovery_lifecycle.py
+"""
+
+import numpy as np
+
+from trustworthy_dl_tpu import (
+    AdversarialAttacker,
+    AttackConfig,
+    DistributedTrainer,
+    TrainingConfig,
+    get_dataloader,
+)
+from trustworthy_dl_tpu.attacks import null_plan
+
+TINY = dict(n_layer=2, n_embd=64, n_head=4, vocab_size=512,
+            n_positions=128, seq_len=64)
+
+config = TrainingConfig(
+    model_name="gpt2", dataset_name="openwebtext",
+    batch_size=16, num_nodes=8, learning_rate=3e-3,
+    detector_warmup=4, checkpoint_interval=10_000,
+    elastic_resharding=True,      # evict confirmed-compromised coordinates
+    readmit_after_steps=10,       # ...and readmit them after a cool-off
+    recovery_probation_steps=5,   # in-step probation for gated nodes
+    checkpoint_dir="/tmp/recovery_example_ckpt",
+)
+trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+dl = get_dataloader("openwebtext", batch_size=16, seq_len=TINY["seq_len"],
+                    vocab_size=TINY["vocab_size"], num_examples=96)
+trainer.initialize()
+
+attacker = AdversarialAttacker(AttackConfig(
+    attack_types=["gradient_poisoning"], target_nodes=[5],
+    intensity=0.5, start_step=8,
+))
+attacker.activate_attacks()
+trainer.set_attack_plan(attacker.plan(8))
+
+print("== attack window ==")
+epoch = 0
+while trainer.config.num_nodes == 8 and epoch < 4:
+    loss = trainer.train_epoch(dl, epoch)
+    print(f"epoch {epoch}: loss {loss:.3f}  live nodes "
+          f"{trainer.config.num_nodes}  map {trainer.node_map}")
+    epoch += 1
+assert trainer.config.num_nodes == 7, "expected an eviction"
+print(f"node 5 evicted at step {trainer._evicted_at[5]}; "
+      f"mesh now {len(list(trainer.mesh.devices.flat))} devices")
+
+print("== attack over: cool-off, then readmission ==")
+trainer.set_attack_plan(null_plan(trainer.config.num_nodes))
+while trainer.config.num_nodes == 7 and epoch < 9:
+    loss = trainer.train_epoch(dl, epoch)
+    print(f"epoch {epoch}: loss {loss:.3f}  live nodes "
+          f"{trainer.config.num_nodes}  map {trainer.node_map}")
+    epoch += 1
+assert trainer.config.num_nodes == 8, "expected readmission"
+
+coord = trainer.node_map.index(5)
+print(f"node 5 readmitted at coordinate {coord}: trust "
+      f"{float(np.asarray(trainer.state.trust.scores)[coord]):.2f}, "
+      f"recovery rate "
+      f"{float(np.asarray(trainer.state.trust.recovery_rate)[coord]):.3f}")
+for rec in trainer.reassignment_history:
+    kind = ("eviction" if "evicted_nodes" in rec
+            else "readmission" if "readmitted_nodes" in rec else "relabel")
+    print(f"  [{kind}] {rec}")
+
+loss = trainer.train_epoch(dl, epoch)
+print(f"full fleet training again: epoch {epoch} loss {loss:.3f}")
+trainer.cleanup()

@@ -111,7 +111,9 @@ def test_gpt2_flash_end_to_end():
 
 def test_auto_attention_dispatch():
     """attn_impl='auto': XLA path below AUTO_FLASH_MIN_T, flash kernel at
-    long T — numerics match full attention either way."""
+    long T on the TPU backend (off-TPU auto always takes the XLA path —
+    interpret-mode Pallas is test-only territory) — numerics match full
+    attention in every case."""
     from trustworthy_dl_tpu.models.gpt2 import AUTO_FLASH_MIN_T, \
         full_attention, get_attention
 

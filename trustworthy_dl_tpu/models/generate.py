@@ -251,9 +251,11 @@ def generate(params: Params, cfg: gpt2.GPT2Config, prompt: jax.Array,
     other seed in this framework) — pass a fresh key per call for variety.
 
     Decode always runs the fused XLA attention over the cache; numerics
-    are pinned token-for-token against the training forward with the
-    default ``attn_impl='full'`` (tests/test_generate.py).  A model
-    *trained* with the Pallas flash kernel agrees to kernel-vs-XLA
+    are pinned token-for-token against an XLA-attention training forward
+    (the default ``attn_impl='auto'`` resolves to that path for contexts
+    below AUTO_FLASH_MIN_T; tests/test_generate.py).  A forward that ran
+    the Pallas flash kernel instead — explicit ``attn_impl='flash'``, or
+    auto at T ≥ AUTO_FLASH_MIN_T on TPU — agrees to kernel-vs-XLA
     epsilon, where near-tie logits can flip under greedy decode."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")

@@ -48,10 +48,13 @@ class GPT2Config:
     n_embd: int = 768
     n_head: int = 12
     dtype: Any = jnp.bfloat16
-    # full | flash | ring | ulysses | auto ("auto" = flash for T >=
-    # AUTO_FLASH_MIN_T where the kernel's advantage is measured, fused
-    # XLA path below it)
-    attn_impl: str = "full"
+    # full | flash | ring | ulysses | auto.  "auto" (the default) picks
+    # the Pallas flash kernel for T >= AUTO_FLASH_MIN_T — where its
+    # advantage is measured (BASELINE.md: 1.29-2.92× fwd+bwd) — and the
+    # fused XLA path below it (measured faster under block-remat at
+    # short T); the branch resolves at trace time from the static shape,
+    # so short-T programs are bit-identical to attn_impl="full".
+    attn_impl: str = "auto"
     remat: bool = False
     # Remat granularity when ``remat`` is on: "block" rematerialises the
     # whole transformer block (max memory saving, max recompute);
@@ -118,14 +121,17 @@ def _auto_attention(q, k, v, causal=True):
     BASELINE.md long-context rows), the fused XLA path below
     AUTO_FLASH_MIN_T where the full-step measurements favour it under
     rematerialisation.  Shapes are static under jit, so the branch
-    resolves at trace time."""
+    resolves at trace time.  Off-TPU the kernel would only run in Pallas
+    interpret mode (orders of magnitude slower — correctness-test
+    territory), so auto picks flash on the TPU backend only."""
     from trustworthy_dl_tpu.ops.flash_attention import (
         flash_attention,
         supports_flash,
     )
 
     t, d = q.shape[-2], q.shape[-1]
-    if t >= AUTO_FLASH_MIN_T and supports_flash(t, d):
+    if (t >= AUTO_FLASH_MIN_T and supports_flash(t, d)
+            and jax.default_backend() == "tpu"):
         return flash_attention(q, k, v, causal)
     return _ATTN_REGISTRY["full"](q, k, v, causal)
 
@@ -233,7 +239,15 @@ def apply_blocks(blocks: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
     block body regardless of depth."""
     body = block_forward
     if cfg.remat:
-        if cfg.remat_policy == "attention" and cfg.attn_impl == "full":
+        # "auto" resolves per shape: below AUTO_FLASH_MIN_T (or off-TPU)
+        # it IS the full XLA path, so the attention policy's tagged names
+        # exist and the cheap policy applies.
+        t = x.shape[-2]
+        effectively_full = cfg.attn_impl == "full" or (
+            cfg.attn_impl == "auto"
+            and (t < AUTO_FLASH_MIN_T or jax.default_backend() != "tpu")
+        )
+        if cfg.remat_policy == "attention" and effectively_full:
             # Save everything except the O(T²) scores/probs: only the
             # attention core recomputes in the backward pass.  Only the
             # "full" impl tags those names — the Pallas/ring paths never
