@@ -214,6 +214,8 @@ def test_transient_recovery_experiment(tmp_path):
     assert all(np.isfinite(r["training_loss"])
                for r in results["epoch_records"])
     assert (runner.output_dir / "experiment_results.json").exists()
+    # Elastic runs additionally get the topology-timeline figure.
+    assert (runner.output_dir / "topology_timeline.png").exists()
 
 
 def test_cli_generate_text_prompt(tmp_path, capsys):

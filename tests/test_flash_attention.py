@@ -109,21 +109,46 @@ def test_gpt2_flash_end_to_end():
         )
 
 
-def test_auto_attention_dispatch():
+def test_auto_attention_dispatch(monkeypatch):
     """attn_impl='auto': XLA path below AUTO_FLASH_MIN_T, flash kernel at
     long T on the TPU backend (off-TPU auto always takes the XLA path —
     interpret-mode Pallas is test-only territory) — numerics match full
-    attention in every case."""
-    from trustworthy_dl_tpu.models.gpt2 import AUTO_FLASH_MIN_T, \
-        full_attention, get_attention
+    attention in every case.  The flash branch is exercised here too by
+    faking the backend check, so a dispatch bug cannot hide until real
+    TPU hardware."""
+    from trustworthy_dl_tpu.models import gpt2 as g
 
-    auto = get_attention("auto")
+    auto = g.get_attention("auto")
     ks = jax.random.split(jax.random.PRNGKey(7), 3)
-    for t in (64, AUTO_FLASH_MIN_T):
+    for t in (64, g.AUTO_FLASH_MIN_T):
         q, k, v = (jax.random.normal(kk, (1, 2, t, 32), jnp.float32)
                    for kk in ks)
         np.testing.assert_allclose(
             np.asarray(auto(q, k, v, True)),
-            np.asarray(full_attention(q, k, v, True)),
+            np.asarray(g.full_attention(q, k, v, True)),
             rtol=2e-4, atol=2e-5,
         )
+    # Predicate truth table on this (CPU) backend, then force "tpu" so the
+    # flash branch really runs and still matches.  The kernel itself must
+    # keep interpret mode (we are still on CPU), so pin _interpret before
+    # faking the backend — both read jax.default_backend.
+    import importlib
+
+    # ops/__init__ re-exports the flash_attention FUNCTION under the
+    # submodule's name, shadowing it as a package attribute — resolve the
+    # module itself.
+    fa = importlib.import_module("trustworthy_dl_tpu.ops.flash_attention")
+
+    assert not g.auto_picks_flash(g.AUTO_FLASH_MIN_T, 32)
+    monkeypatch.setattr(fa, "_interpret", lambda: True)
+    monkeypatch.setattr(g.jax, "default_backend", lambda: "tpu")
+    assert g.auto_picks_flash(g.AUTO_FLASH_MIN_T, 32)
+    assert not g.auto_picks_flash(64, 32)
+    t = g.AUTO_FLASH_MIN_T
+    q, k, v = (jax.random.normal(kk, (1, 2, t, 32), jnp.float32)
+               for kk in ks)
+    np.testing.assert_allclose(
+        np.asarray(auto(q, k, v, True)),
+        np.asarray(g.full_attention(q, k, v, True)),
+        rtol=2e-4, atol=2e-5,
+    )

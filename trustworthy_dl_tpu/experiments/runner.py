@@ -380,7 +380,41 @@ class ExperimentRunner:
         self._plot_trust_evolution()
         self._plot_attack_impact()
         self._plot_system_metrics()
+        if self.trainer.reassignment_history:
+            # Elastic runs only: the topology actually changed (the
+            # history catches even an evict+readmit that reverts within
+            # one epoch, which per-epoch snapshots would miss).
+            self._plot_topology_timeline()
         logger.info("Visualizations saved to %s", self.output_dir)
+
+    def _plot_topology_timeline(self) -> None:
+        """Live-coordinate count per epoch with eviction/readmission
+        markers — the elastic lifecycle at a glance (recovery
+        experiments)."""
+        import matplotlib.pyplot as plt
+
+        epochs = [r["epoch"] for r in self.epoch_records]
+        live = [r["live_nodes"] for r in self.epoch_records]
+        fig, ax = plt.subplots(figsize=(10, 5))
+        ax.step(epochs, live, where="post", linewidth=2)
+        ax.set_ylim(0, self.config.num_nodes + 1)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("live mesh coordinates")
+        ax.set_title("Elastic Topology Timeline")
+        steps_per = max(self.config.steps_per_epoch, 1)
+        for rec in self.trainer.reassignment_history:
+            x = rec.get("step", 0) / steps_per
+            if "evicted_nodes" in rec:
+                ax.axvline(x, color="tab:red", linestyle="--", alpha=0.7)
+                ax.annotate(f"evict {rec['evicted_nodes']}", (x, 0.5),
+                            rotation=90, fontsize=8, color="tab:red")
+            elif "readmitted_nodes" in rec:
+                ax.axvline(x, color="tab:green", linestyle="--", alpha=0.7)
+                ax.annotate(f"readmit {rec['readmitted_nodes']}", (x, 0.5),
+                            rotation=90, fontsize=8, color="tab:green")
+        fig.tight_layout()
+        fig.savefig(self.output_dir / "topology_timeline.png", dpi=120)
+        plt.close(fig)
 
     def _plot_training_loss(self) -> None:
         import matplotlib.pyplot as plt

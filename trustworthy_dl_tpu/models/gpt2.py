@@ -115,23 +115,27 @@ register_attention("full", full_attention)
 AUTO_FLASH_MIN_T = 1024
 
 
-def _auto_attention(q, k, v, causal=True):
-    """Per-shape dispatch: the Pallas flash kernel where its advantage is
-    real (long sequences — O(T·D) memory AND faster than the XLA path,
-    BASELINE.md long-context rows), the fused XLA path below
-    AUTO_FLASH_MIN_T where the full-step measurements favour it under
-    rematerialisation.  Shapes are static under jit, so the branch
-    resolves at trace time.  Off-TPU the kernel would only run in Pallas
-    interpret mode (orders of magnitude slower — correctness-test
-    territory), so auto picks flash on the TPU backend only."""
-    from trustworthy_dl_tpu.ops.flash_attention import (
-        flash_attention,
-        supports_flash,
-    )
+def auto_picks_flash(t: int, d: int) -> bool:
+    """THE attn_impl='auto' dispatch predicate — shared by the attention
+    registry AND the remat-policy classifier (apply_blocks), so 'does auto
+    resolve to the flash kernel here?' has exactly one answer.  Flash is
+    picked for long sequences (where its advantage is measured,
+    BASELINE.md), only for kernel-eligible shapes, and only on the TPU
+    backend (off-TPU the kernel would run in interpret mode — orders of
+    magnitude slower, correctness-test territory)."""
+    from trustworthy_dl_tpu.ops.flash_attention import supports_flash
 
-    t, d = q.shape[-2], q.shape[-1]
-    if (t >= AUTO_FLASH_MIN_T and supports_flash(t, d)
-            and jax.default_backend() == "tpu"):
+    return (t >= AUTO_FLASH_MIN_T and supports_flash(t, d)
+            and jax.default_backend() == "tpu")
+
+
+def _auto_attention(q, k, v, causal=True):
+    """Per-shape dispatch (see auto_picks_flash): the Pallas flash kernel
+    where its advantage is real, the fused XLA path everywhere else —
+    shapes are static under jit, so the branch resolves at trace time."""
+    from trustworthy_dl_tpu.ops.flash_attention import flash_attention
+
+    if auto_picks_flash(q.shape[-2], q.shape[-1]):
         return flash_attention(q, k, v, causal)
     return _ATTN_REGISTRY["full"](q, k, v, causal)
 
@@ -239,13 +243,14 @@ def apply_blocks(blocks: Params, x: jax.Array, cfg: GPT2Config) -> jax.Array:
     block body regardless of depth."""
     body = block_forward
     if cfg.remat:
-        # "auto" resolves per shape: below AUTO_FLASH_MIN_T (or off-TPU)
-        # it IS the full XLA path, so the attention policy's tagged names
-        # exist and the cheap policy applies.
-        t = x.shape[-2]
+        # "auto" resolves per shape: wherever it does NOT pick the flash
+        # kernel it IS the full XLA path, so the attention policy's tagged
+        # names exist and the cheap policy applies (the shared
+        # auto_picks_flash predicate keeps this classification and the
+        # dispatch itself from ever drifting apart).
+        t, d_head = x.shape[-2], cfg.n_embd // cfg.n_head
         effectively_full = cfg.attn_impl == "full" or (
-            cfg.attn_impl == "auto"
-            and (t < AUTO_FLASH_MIN_T or jax.default_backend() != "tpu")
+            cfg.attn_impl == "auto" and not auto_picks_flash(t, d_head)
         )
         if cfg.remat_policy == "attention" and effectively_full:
             # Save everything except the O(T²) scores/probs: only the
