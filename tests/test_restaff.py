@@ -212,3 +212,37 @@ def test_second_restaff_reuses_idle_pool(tmp_path):
     # Training still runs on the restaffed fleet.
     loss = trainer.train_epoch(dl, epoch)
     assert np.isfinite(loss)
+
+
+def test_checkpoint_resume_after_restaff(restaffed_run):
+    """SURVEY §5.4 on the restaff path: a checkpoint written AFTER the
+    repartition (4 stages) restores into a fresh trainer constructed with
+    the original 8-stage config — the saved topology is adopted (mesh,
+    pipeline step, [4, 2, ...] block stacking) and training continues."""
+    import dataclasses
+
+    trainer, _, _ = restaffed_run
+    trainer.save_checkpoint()
+
+    fresh = DistributedTrainer(
+        dataclasses.replace(trainer.config, num_nodes=8),
+        model_overrides=dict(TINY),
+    )
+    fresh.load_checkpoint()
+
+    assert fresh.config.num_nodes == 4
+    assert fresh.node_map == trainer.node_map
+    lead = jax.tree_util.tree_leaves(fresh.state.params["blocks"])[0]
+    assert lead.shape[:2] == (4, 2)
+    np.testing.assert_allclose(
+        np.asarray(fresh.state.trust.scores),
+        np.asarray(trainer.state.trust.scores), rtol=1e-6,
+    )
+    # Weights restored exactly; training continues finite on 4 stages.
+    for a, b in zip(jax.tree_util.tree_leaves(trainer.state.params),
+                    jax.tree_util.tree_leaves(fresh.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=32, seed=11)
+    loss = fresh.train_epoch(dl, epoch=9)
+    assert np.isfinite(loss)

@@ -101,11 +101,13 @@ def build_mesh(
 ) -> Mesh:
     """Build the mesh for a training run.
 
-    For single-axis strategies the node axis gets ``num_nodes`` entries; any
-    leftover devices fold into a leading data axis so all chips stay busy.
-    For "hybrid", ``mesh_shape`` gives the within-slice {axis: size}
-    explicitly and ``dcn_mesh_shape`` the optional across-slice extents
-    (see build_hybrid_mesh).
+    For single-axis strategies the node axis gets ``num_nodes`` entries.
+    Tensor/sequence modes fold leftover devices into each node's TP/seq
+    group; pipeline ("model") uses exactly one device per stage and
+    leaves surplus devices out of the mesh (see the stage branch below
+    for why).  For "hybrid", ``mesh_shape`` gives the within-slice
+    {axis: size} explicitly and ``dcn_mesh_shape`` the optional
+    across-slice extents (see build_hybrid_mesh).
     """
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
@@ -145,9 +147,13 @@ def build_mesh(
     usable = (n_dev // num_nodes) * num_nodes
     group = usable // num_nodes
     if axis == STAGE_AXIS:
-        # Pipeline: the stage axis carries the nodes; leftover devices form
-        # data-parallel pipeline replicas.
-        arr = np.array(devices[:usable]).reshape(group, num_nodes)
+        # Pipeline: the stage axis carries the nodes, one device per
+        # stage; surplus devices stay OUT of the mesh.  (A (group, S)
+        # replica layout was tried and reverted: the trusted step cannot
+        # shard microbatches over it without racing independent subgroup
+        # collectives — deadlocks XLA:CPU's in-process communicator — and
+        # with replicated inputs the extra rows are pure waste.)
+        arr = np.array(devices[:num_nodes]).reshape(1, num_nodes)
         return Mesh(arr, (DATA_AXIS, axis))
     # Tensor / sequence: trust nodes stay data shards; each node owns a
     # TP / sequence group of the remaining devices (SURVEY §2.4 plan — the
