@@ -160,13 +160,15 @@ def test_ring_attention_flash_chunk_path(mesh, causal):
         )
 
 
-def test_trainer_sequence_parallelism_with_attack(eight_devices, tmp_path):
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_trainer_sequence_parallelism_with_attack(eight_devices, tmp_path,
+                                                  impl):
     """VERDICT r2 weak #4: DistributedTrainer(parallelism='sequence') with
     detection enabled and a live attack — the ('data','seq') mesh runs the
-    FULL trusted step (ring attention inside each trust node, detector
-    stats aggregating across sequence shards), detection fires on the
-    poisoned node, clean nodes are untouched (mirror of
-    tests/test_moe.py::test_trainer_expert_parallelism_end_to_end)."""
+    FULL trusted step (seq-parallel attention, ring or Ulysses, inside
+    each trust node; detector stats aggregating across sequence shards),
+    detection fires on the poisoned node, clean nodes are untouched
+    (mirror of tests/test_moe.py::test_trainer_expert_parallelism...)."""
     import numpy as np
 
     from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker
@@ -179,16 +181,16 @@ def test_trainer_sequence_parallelism_with_attack(eight_devices, tmp_path):
         model_name="gpt2", dataset_name="openwebtext", batch_size=8,
         num_nodes=4, optimizer="adamw", learning_rate=3e-3,
         checkpoint_interval=10_000, parallelism="sequence",
-        detector_warmup=4, checkpoint_dir=str(tmp_path / "ck"),
+        detector_warmup=4, checkpoint_dir=str(tmp_path / f"ck_{impl}"),
     )
     trainer = DistributedTrainer(
         config,
         model_overrides=dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
-                             n_positions=32, seq_len=16),
+                             n_positions=32, seq_len=16, attn_impl=impl),
     )
     assert trainer.mesh.axis_names == ("data", "seq")
     assert trainer.mesh.devices.shape == (4, 2)
-    assert trainer.model.config.attn_impl == "ring"
+    assert trainer.model.config.attn_impl == impl
 
     dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
                         vocab_size=128, num_examples=64)
