@@ -66,3 +66,31 @@ def test_leafwise_statistics_with_pallas_path(monkeypatch):
     np.testing.assert_allclose(np.asarray(got_norms), np.asarray(ref_norms),
                                rtol=1e-5)
     assert bool(got_finite) == bool(ref_finite)
+
+
+def test_fused_moments_under_value_and_grad():
+    """Regression: the battery runs on param-dependent activations INSIDE
+    the engine's value_and_grad; pallas_call has no JVP rule, so without
+    the zero-tangent contract the trace asserts (only at sizes that
+    engage the kernel — small inputs fall back to XLA and hid this).
+    Gradients must also be IDENTICALLY zero through the battery on every
+    path (kernel head, XLA tail, small-input fallback), not flip with
+    input size."""
+    # Kernel-engaging size plus a ragged tail exercising the XLA path too.
+    x = jax.random.normal(jax.random.PRNGKey(3),
+                          (BLOCK_ROWS * 128 * 2 + 7,), jnp.float32)
+
+    def f(w):
+        y = x * w
+        return jnp.sum(y ** 2), fused_moments(y)
+
+    (loss, stats), g = jax.value_and_grad(f, has_aux=True)(1.5)
+    ref = _xla_moments(x * 1.5)
+    for a, b in zip(stats, ref):
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+    # Gradient of the actual loss is untouched by the constant battery.
+    np.testing.assert_allclose(float(g), float(2 * 1.5 * jnp.sum(x * x)),
+                               rtol=1e-5)
+    # The battery itself is constant under differentiation on all paths.
+    gm = jax.grad(lambda w: fused_moments(x * w)[0])(1.5)
+    assert float(gm) == 0.0

@@ -59,9 +59,19 @@ def _moments_kernel(x_ref, acc_ref):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _fused_tile_moments(tiles: jax.Array, interpret: bool = False) -> jax.Array:
-    """[R, 128] f32 (R a multiple of BLOCK_ROWS) -> [8, 128] lane partials."""
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _fused_tile_moments_impl(tiles: jax.Array,
+                             interpret: bool = False) -> jax.Array:
+    """[R, 128] f32 (R a multiple of BLOCK_ROWS) -> [8, 128] lane partials.
+
+    custom_jvp with zero tangents: the battery is diagnostics — nothing
+    intentionally differentiates it — but it runs on values INSIDE the
+    engine's value_and_grad (feature activations depend on params), and
+    ``pallas_call`` has no JVP rule (AD through the kernel asserts inside
+    pallas' program_id at trace time).  Treating the statistics as
+    constant under differentiation is both the fix and the correct
+    semantics (fused_moments also stop-gradients its input so the XLA
+    tail/fallback paths share that contract)."""
     grid = tiles.shape[0] // BLOCK_ROWS
     return pl.pallas_call(
         _moments_kernel,
@@ -78,6 +88,17 @@ def _fused_tile_moments(tiles: jax.Array, interpret: bool = False) -> jax.Array:
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(tiles)
+
+
+@_fused_tile_moments_impl.defjvp
+def _fused_tile_moments_jvp(interpret, primals, tangents):
+    (tiles,) = primals
+    out = _fused_tile_moments_impl(tiles, interpret)
+    return out, jnp.zeros_like(out)
+
+
+_fused_tile_moments = jax.jit(_fused_tile_moments_impl,
+                              static_argnames=("interpret",))
 
 
 def _xla_moments(x: jax.Array) -> Tuple[jax.Array, ...]:
@@ -110,8 +131,13 @@ def fused_moments(x: jax.Array,
     """(s1, s2, s3, s4, min, max, l1, linf) of a flattened f32 vector in one
     HBM pass.  The aligned prefix streams through the Pallas kernel; the
     ≤BLOCK_ROWS·LANES-1 element tail and small inputs use XLA (negligible and
-    keeps shapes static)."""
-    x = x.reshape(-1)
+    keeps shapes static).
+
+    Constant under differentiation on EVERY path (stop_gradient here, plus
+    the kernel's zero-tangent custom_jvp): the statistics are diagnostics,
+    and per-path gradient behaviour must not flip with input size or the
+    dispatch env var."""
+    x = jax.lax.stop_gradient(x.reshape(-1))
     if x.dtype != jnp.float32:
         x = x.astype(jnp.float32)
     n = x.shape[0]
