@@ -1,0 +1,130 @@
+"""Attack-type attribution at FIRST detection (VERDICT r3 weak #7).
+
+Round 3 stamped "byzantine" on most first detections: the reference's rule
+classifier (attack_detector.py:350-363) only labels once its fixed z>5/z>4
+thresholds trip, and its default branch is BYZANTINE — so the first
+confirmed incident (usually via the hard cross-sectional or norm-
+verification path, before the temporal z's have grown) recorded the wrong
+type in attack_history and the host type distribution.  The attribution
+ladder (detect/detector.py:attribute_attack) fixes this: reference rules
+where they really fired, explicit consensus checks next, then the
+dominant-signature family.
+
+Ground-truth labels pinned here, with the taxonomy's honest ambiguities
+documented inline:
+
+* ``gradient_poisoning`` (norm inflation) — unambiguous: the gradient-norm
+  signature dominates from the first confirmation.
+* ``byzantine`` (gradients replaced by noise) — IS a gradient corruption;
+  the norm columns inflate ~10x, so the gradient family may label it.  The
+  consensus "byzantine" label applies when the evidence is consensus-only
+  (output divergence without a dominant battery signature), which random
+  gradients do not produce in DP mode.  (Pipeline mode's canary probe
+  labels compute-corruption byzantine directly — tests/test_pipeline.py.)
+* ``data_poisoning`` / ``backdoor`` (batch corruptions) — surface through
+  whichever battery trips first; a label shift inflates the loss and
+  therefore the gradient norms, so the gradient family can win the first
+  attribution (the reference's own z>5 rule behaves identically).  The
+  pinned contract: the right NODE at the first incident, a data/gradient-
+  family label (never a bare default "byzantine"), and stable accounting.
+"""
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.attacks import AttackConfig, AdversarialAttacker
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
+TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+
+EXPECTED_FIRST = {
+    "gradient_poisoning": {"gradient_poisoning"},
+    "byzantine": {"gradient_poisoning", "byzantine"},
+    "data_poisoning": {"data_poisoning", "adversarial_input",
+                       "gradient_poisoning"},
+    "backdoor": {"backdoor", "data_poisoning", "adversarial_input",
+                 "gradient_poisoning"},
+}
+
+
+@pytest.mark.parametrize("kind", sorted(EXPECTED_FIRST))
+def test_first_incident_attribution(tmp_path, kind):
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, learning_rate=3e-3, checkpoint_interval=10 ** 9,
+        detector_warmup=4, checkpoint_dir=str(tmp_path / kind),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    # Batch corruptions (data_poisoning) perturb the statistics far less
+    # per unit intensity than gradient corruptions — a 0.5-intensity token
+    # scramble hides inside early-training variance, so those kinds inject
+    # at full strength.
+    intensity = 1.0 if kind in ("data_poisoning", "backdoor") else 0.5
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=[kind], target_nodes=[3], intensity=intensity,
+        start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    # Loss detachment (the data-poisoning signal) needs the honest fleet
+    # to pull AWAY from the stuck shard — ~2 epochs of training after the
+    # attack starts, vs ~1 step for a gradient-norm inflation.
+    for epoch in range(6):
+        trainer.train_epoch(dl, epoch)
+        if trainer.attack_history:
+            break
+
+    assert trainer.attack_history, f"{kind} was never detected"
+    first = trainer.attack_history[0]
+    # Right node, right label family — at the FIRST incident.
+    assert first["node_id"] == 3
+    assert first["attack_type"] in EXPECTED_FIRST[kind], (
+        kind, trainer.attack_history[:3],
+    )
+    # No clean node was ever implicated.
+    assert {r["node_id"] for r in trainer.attack_history} == {3}
+    # Host accounting is consistent: the type distribution counts exactly
+    # the labels recorded in attack_history (the r3 bug recorded
+    # "byzantine" in the distribution for a gradient_poisoning injection).
+    stats = trainer.attack_detector.get_detection_statistics()
+    dist = stats["attack_type_distribution"]
+    from collections import Counter
+
+    assert dist == dict(Counter(
+        r["attack_type"] for r in trainer.attack_history
+    )), (dist, trainer.attack_history)
+
+
+def test_gradient_poisoning_never_first_labelled_byzantine(tmp_path):
+    """The specific r3 regression (MULTICHIP_r03 DP leg): a
+    gradient_poisoning injection must NOT be first-reported as the
+    classifier's blanket 'byzantine' default."""
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, learning_rate=3e-3, checkpoint_interval=10 ** 9,
+        detector_warmup=4, checkpoint_dir=str(tmp_path / "gp"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96, seed=7)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[1],
+        intensity=0.8, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    for epoch in range(3):
+        trainer.train_epoch(dl, epoch)
+        if trainer.attack_history:
+            break
+    assert trainer.attack_history
+    assert trainer.attack_history[0]["attack_type"] == "gradient_poisoning"

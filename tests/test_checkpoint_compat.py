@@ -53,3 +53,52 @@ def test_merge_handles_namedtuples_and_tuples():
     out = _merge_into_template(tpl, {"0": np.arange(2.0)})
     np.testing.assert_array_equal(np.asarray(out[0]), [0.0, 1.0])
     np.testing.assert_array_equal(np.asarray(out[1]), [1.0])
+
+
+def test_identical_structure_failure_reraises(tmp_path, monkeypatch):
+    """ADVICE r3: the merge fallback is for structure drift ONLY.  A restore
+    failure on a structure-identical checkpoint (transient I/O error,
+    corruption) must re-raise, not silently keep freshly-initialised
+    template values."""
+    import pytest
+
+    mgr = CheckpointManager(str(tmp_path))
+    saved = {"a": jnp.arange(4, dtype=jnp.float32),
+             "nested": {"b": jnp.ones((2, 2))}}
+    mgr.save(saved, step=1)
+    template = {"a": jnp.zeros(4, jnp.float32),
+                "nested": {"b": jnp.zeros((2, 2))}}
+
+    def boom(path, abstract=None):
+        raise RuntimeError("simulated transient I/O failure")
+
+    monkeypatch.setattr(mgr._ckptr, "restore", boom)
+    with pytest.raises(RuntimeError, match="transient"):
+        mgr.restore(template, step=1)
+
+
+def test_structure_path_helpers_agree(tmp_path):
+    """_template_paths (live pytree) and _saved_paths (Orbax metadata)
+    normalise to the same key space, so the drift check compares like with
+    like — including namedtuples (saved as field dicts) and tuples (saved
+    as stringified indices)."""
+    from collections import namedtuple
+
+    from trustworthy_dl_tpu.engine.checkpoint import (
+        _saved_paths,
+        _template_paths,
+    )
+
+    Pair = namedtuple("Pair", ["u", "v"])
+    state = {
+        "p": Pair(u=jnp.zeros(2), v=jnp.ones(3)),
+        "t": (jnp.zeros(1), jnp.ones(2)),
+        "d": {"x": jnp.zeros(4)},
+    }
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, step=1)
+    saved = _saved_paths(mgr._saved_tree(mgr.path_for(1)))
+    assert saved == _template_paths(state)
+    # A drifted template (extra field) no longer matches.
+    drifted = dict(state, extra=jnp.zeros(1))
+    assert saved != _template_paths(drifted)

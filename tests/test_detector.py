@@ -293,3 +293,29 @@ def test_host_byzantine_ragged_outputs():
                                          0) == [3]
     assert det.detect_byzantine_behavior(
         {**honest, 3: np.zeros(0, np.float32)}, 0) == [3]
+
+
+def test_combine_microbatch_stats_order_reducers():
+    """ADVICE r3: under gradient accumulation the per-microbatch batteries
+    combine with per-column reducers — min/max/linf keep extreme-value
+    semantics (a single corrupted microbatch's spike survives at full
+    strength), sum-moments average."""
+    from trustworthy_dl_tpu.detect.stats import (
+        NUM_GRADIENT_STATS,
+        STAT_INDEX,
+        combine_microbatch_stats,
+    )
+
+    lo = np.full(NUM_GRADIENT_STATS, 1.0, np.float32)
+    hi = np.full(NUM_GRADIENT_STATS, 3.0, np.float32)
+    lo[STAT_INDEX["min"]] = -5.0  # one microbatch saw a deep negative
+    hi[STAT_INDEX["max"]] = 40.0  # ... and one a huge positive spike
+    hi[STAT_INDEX["norm_inf"]] = 40.0
+    out = np.asarray(combine_microbatch_stats(jnp.stack(
+        [jnp.asarray(lo), jnp.asarray(hi)]
+    )))
+    assert out[STAT_INDEX["min"]] == -5.0          # min-of-mins
+    assert out[STAT_INDEX["max"]] == 40.0          # max-of-maxes, undiluted
+    assert out[STAT_INDEX["norm_inf"]] == 40.0
+    assert out[STAT_INDEX["mean"]] == pytest.approx(2.0)   # mean elsewhere
+    assert out[STAT_INDEX["norm_l2"]] == pytest.approx(2.0)

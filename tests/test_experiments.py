@@ -239,3 +239,50 @@ def test_cli_generate_text_prompt(tmp_path, capsys):
     assert "hello world" in out
     # --prompt-text without a tokenizer dir is refused clearly.
     assert generate_main(["--model", "gpt2", "--prompt-text", "hi"]) == 2
+
+
+def test_threshold_sweep(tmp_path):
+    """VERDICT r3 weak #6: run_threshold_sweep (BASELINE config 5's leg)
+    over three thresholds — the sweep artifact exists, every leg carries
+    detection quality, recall is threshold-independent (detection is
+    battery-driven, not trust-gated), and the status machine responds:
+    a 0.95 threshold marks settling clean nodes SUSPICIOUS while 0.5
+    keeps them TRUSTED (trust_manager.py:162-181)."""
+    from trustworthy_dl_tpu.experiments.runner import run_threshold_sweep
+
+    base = ExperimentConfig(
+        experiment_name="sweep_base",
+        model_name="gpt2", dataset_name="openwebtext",
+        num_nodes=4, num_epochs=3, batch_size=8, learning_rate=3e-3,
+        attack_enabled=True, attack_start_epoch=1, attack_intensity=0.5,
+        target_nodes=[2], attack_types=["gradient_poisoning"],
+        steps_per_epoch=6, output_dir=str(tmp_path),
+    )
+    sweep = run_threshold_sweep(
+        base, [0.5, 0.7, 0.95],
+        model_overrides=dict(TINY_GPT), data_overrides=dict(TINY_DATA),
+    )
+
+    # Artifact contract.
+    out = os.path.join(str(tmp_path), "sweep_base_sweep",
+                       "sweep_results.json")
+    assert os.path.exists(out)
+    with open(out) as f:
+        on_disk = json.load(f)
+    assert set(on_disk["thresholds"]) == {"0.5", "0.7", "0.95"}
+
+    legs = sweep["thresholds"]
+    for leg in legs.values():
+        quality = leg["summary"]["detection_quality"]
+        # Battery detection is threshold-independent: the injected node is
+        # caught at every trust threshold, with no false positives.
+        assert quality["recall"] == 1.0, quality
+        assert quality["false_positives"] == []
+    # The status machine responds to the threshold: stricter thresholds
+    # hold fewer nodes TRUSTED.
+    trusted = {
+        t: legs[t]["trust_statistics"]["node_status_counts"]["trusted"]
+        for t in legs
+    }
+    assert trusted["0.5"] >= trusted["0.7"] >= trusted["0.95"]
+    assert trusted["0.5"] > trusted["0.95"], trusted

@@ -89,21 +89,30 @@ class Verdicts(NamedTuple):
     evidence_mask: jax.Array  # bool[n, S] z > 3
 
 
+def _rule_hits(z: jax.Array, evidence_mask: jax.Array
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(l2_hit, std_hit, shape_hit) — THE reference rule predicates
+    (attack_detector.py:350-363), shared by classify_attack and the
+    attribution ladder's _rule_fired so their thresholds can never
+    drift apart.  Evidence requires the 3-sigma record first (the
+    reference only inspects stats present in the evidence dict)."""
+    i_l2 = st.STAT_INDEX["norm_l2"]
+    i_std = st.STAT_INDEX["std"]
+    i_skew = st.STAT_INDEX["skewness"]
+    i_kurt = st.STAT_INDEX["kurtosis"]
+    l2_hit = evidence_mask[..., i_l2] & (z[..., i_l2] > 5.0)
+    std_hit = evidence_mask[..., i_std] & (z[..., i_std] > 4.0)
+    shape_hit = evidence_mask[..., i_skew] | evidence_mask[..., i_kurt]
+    return l2_hit, std_hit, shape_hit
+
+
 def classify_attack(z: jax.Array, evidence_mask: jax.Array) -> jax.Array:
     """Rule-based classifier (attack_detector.py:350-363), vectorised.
 
     Branch order: norm_l2 z>5 → GRADIENT_POISONING; std z>4 → DATA_POISONING;
     skew/kurtosis evidence → ADVERSARIAL_INPUT; else BYZANTINE.
     """
-    i_l2 = st.STAT_INDEX["norm_l2"]
-    i_std = st.STAT_INDEX["std"]
-    i_skew = st.STAT_INDEX["skewness"]
-    i_kurt = st.STAT_INDEX["kurtosis"]
-    # Evidence requires the 3-sigma record first (reference only inspects
-    # stats present in the evidence dict).
-    l2_hit = evidence_mask[..., i_l2] & (z[..., i_l2] > 5.0)
-    std_hit = evidence_mask[..., i_std] & (z[..., i_std] > 4.0)
-    shape_hit = evidence_mask[..., i_skew] | evidence_mask[..., i_kurt]
+    l2_hit, std_hit, shape_hit = _rule_hits(z, evidence_mask)
     return jnp.select(
         [l2_hit, std_hit, shape_hit],
         [
@@ -112,6 +121,88 @@ def classify_attack(z: jax.Array, evidence_mask: jax.Array) -> jax.Array:
             jnp.int32(AttackType.ADVERSARIAL_INPUT),
         ],
         default=jnp.int32(AttackType.BYZANTINE),
+    )
+
+
+def _rule_fired(z: jax.Array, evidence_mask: jax.Array) -> jax.Array:
+    """bool[n]: did any of the reference's classification rules
+    (attack_detector.py:350-363) actually trip — as opposed to falling
+    through to the default branch?  Same predicates as classify_attack
+    (shared via _rule_hits)."""
+    l2_hit, std_hit, shape_hit = _rule_hits(z, evidence_mask)
+    return l2_hit | std_hit | shape_hit
+
+
+def attribute_attack(grad_v: "Verdicts", out_v: "Verdicts",
+                     byz: jax.Array, backdoor: jax.Array,
+                     loss_outlier: Optional[jax.Array] = None) -> jax.Array:
+    """i32[n] attack-type attribution ladder (VERDICT r3 weak #7).
+
+    The reference's rule classifier keeps its labels wherever one of its
+    rules actually fired (parity, attack_detector.py:350-363); its
+    *default* branch — which stamped "byzantine" on every confirmation
+    whose fixed z>5/z>4 thresholds hadn't tripped yet, i.e. most FIRST
+    detections — is replaced by the explicit consensus checks, the
+    loss-detachment signature (a node whose shard loss detached from the
+    fleet is training on corrupted DATA), and finally the
+    dominant-signature family (classify_attack_dominant)."""
+    grad_rule = grad_v.is_attack & _rule_fired(grad_v.z,
+                                               grad_v.evidence_mask)
+    out_rule = out_v.is_attack & _rule_fired(out_v.z, out_v.evidence_mask)
+    if loss_outlier is None:
+        loss_outlier = jnp.zeros_like(byz)
+    return jnp.select(
+        [grad_rule, out_rule, backdoor, byz, loss_outlier],
+        [
+            grad_v.attack_type,
+            out_v.attack_type,
+            jnp.full_like(grad_v.attack_type, int(AttackType.BACKDOOR)),
+            jnp.full_like(grad_v.attack_type, int(AttackType.BYZANTINE)),
+            jnp.full_like(grad_v.attack_type,
+                          int(AttackType.DATA_POISONING)),
+        ],
+        default=classify_attack_dominant(grad_v.z, out_v.z),
+    )
+
+
+def classify_attack_dominant(z_grad: jax.Array, z_out: jax.Array
+                             ) -> jax.Array:
+    """Best-effort family attribution for confirmations the rule
+    classifier cannot label (VERDICT r3 weak #7): when NEITHER battery's
+    own verdict fired — the confirmation came from the hard
+    cross-sectional outlier, norm-verification, or consensus checks — the
+    reference's fixed-threshold rules (z>5 / z>4,
+    attack_detector.py:350-363) usually haven't tripped yet, and the
+    default branch mislabelled every first detection "byzantine".  Here
+    the family whose signature columns carry the dominant z wins:
+    gradient-norm columns → GRADIENT_POISONING, dispersion →
+    DATA_POISONING, shape (skew/kurtosis) → ADVERSARIAL_INPUT; BYZANTINE
+    only when no signature stands out (z < 1), i.e. when the evidence
+    genuinely is consensus-only."""
+    idx = st.STAT_INDEX
+    norm_sig = jnp.maximum(
+        jnp.maximum(z_grad[..., idx["norm_l2"]],
+                    z_grad[..., idx["norm_l1"]]),
+        z_grad[..., idx["norm_inf"]],
+    )
+    data_sig = jnp.maximum(z_out[..., idx["std"]], z_grad[..., idx["std"]])
+    shape_sig = jnp.maximum(
+        jnp.maximum(z_out[..., idx["skewness"]],
+                    z_out[..., idx["kurtosis"]]),
+        jnp.maximum(z_grad[..., idx["skewness"]],
+                    z_grad[..., idx["kurtosis"]]),
+    )
+    fams = jnp.stack([norm_sig, data_sig, shape_sig], axis=-1)
+    types = jnp.asarray([
+        int(AttackType.GRADIENT_POISONING),
+        int(AttackType.DATA_POISONING),
+        int(AttackType.ADVERSARIAL_INPUT),
+    ], jnp.int32)
+    best = jnp.argmax(fams, axis=-1)
+    return jnp.where(
+        jnp.max(fams, axis=-1) >= 1.0,
+        types[best],
+        jnp.int32(AttackType.BYZANTINE),
     )
 
 

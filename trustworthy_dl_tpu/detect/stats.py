@@ -249,6 +249,24 @@ def leafwise_statistics(
     return stats, jnp.sqrt(s2_leaf), finite, sample
 
 
+def combine_microbatch_stats(stacked: jax.Array) -> jax.Array:
+    """Combine per-microbatch stat batteries [accum, k] -> f32[k] for
+    gradient accumulation: order statistics keep their own reducers (min
+    for ``min``, max for ``max``/``norm_inf``) so a single corrupted
+    microbatch's extreme values survive the combine — a mean-of-maxes both
+    diverges from full-batch semantics and attenuates exactly the signals
+    most sensitive to a one-microbatch corruption — while the sum-moment
+    columns (mean/std/skew/kurt/l1/l2 and the quantile approximations)
+    average, matching fused_moments' own tail-combine logic."""
+    out = jnp.mean(stacked, axis=0)
+    mins = jnp.min(stacked, axis=0)
+    maxs = jnp.max(stacked, axis=0)
+    out = out.at[STAT_INDEX["min"]].set(mins[STAT_INDEX["min"]])
+    for name in ("max", "norm_inf"):
+        out = out.at[STAT_INDEX[name]].set(maxs[STAT_INDEX[name]])
+    return out
+
+
 def chunked_cosine_mean(flat: jax.Array, chunks: int = 4) -> jax.Array:
     """Mean pairwise cosine similarity among equal chunks of one flattened
     gradient vector — the engine's O(P) stand-in for the reference's

@@ -106,6 +106,49 @@ def _merge_into_template(template: Any, raw: Any) -> Any:
     return template
 
 
+def _template_paths(node: Any, prefix: tuple = ()) -> set:
+    """Key-path set of a live template pytree, normalised to the string
+    keys Orbax serialises with (namedtuples as field dicts, sequences as
+    stringified indices) so it is directly comparable with
+    ``_saved_paths``."""
+    if hasattr(node, "dtype") and not isinstance(node, dict):
+        return {prefix}
+    fields = getattr(node, "_fields", None)
+    if fields is not None:
+        out = set()
+        for f in fields:
+            out |= _template_paths(getattr(node, f), prefix + (f,))
+        return out
+    if isinstance(node, dict):
+        out = set()
+        for k, v in node.items():
+            out |= _template_paths(v, prefix + (str(k),))
+        return out
+    if isinstance(node, (list, tuple)):
+        out = set()
+        for i, v in enumerate(node):
+            out |= _template_paths(v, prefix + (str(i),))
+        return out
+    return {prefix}
+
+
+def _saved_paths(node: Any, prefix: tuple = ()) -> set:
+    """Key-path set of a saved checkpoint's structure metadata (nested
+    dicts/sequences with ArrayMetadata leaves), normalised like
+    ``_template_paths`` (sequence positions as stringified indices)."""
+    if isinstance(node, dict):
+        out = set()
+        for k, v in node.items():
+            out |= _saved_paths(v, prefix + (str(k),))
+        return out
+    if isinstance(node, (list, tuple)):
+        out = set()
+        for i, v in enumerate(node):
+            out |= _saved_paths(v, prefix + (str(i),))
+        return out
+    return {prefix}
+
+
 def _saved_abstract(meta_node: Any, template_node: Any) -> Any:
     """Abstract restore tree mirroring the SAVED structure, with shardings
     grafted from ``template_node`` wherever a same-named leaf of the same
@@ -234,14 +277,30 @@ class CheckpointManager:
         )
         try:
             state = self._ckptr.restore(path, abstract)
-        except Exception as exc:  # structure mismatch: older/newer format
+        except Exception as exc:
+            # The merge fallback exists for STRUCTURE drift only (a
+            # TrainState field added/removed between versions).  Verify via
+            # the saved metadata that the structures genuinely differ before
+            # reinterpreting the failure — a transient I/O error or
+            # corrupted array on a structure-identical checkpoint must stay
+            # loud, not silently keep freshly-initialised template values.
+            try:
+                saved_tree = self._saved_tree(path)
+                drifted = _saved_paths(saved_tree) != _template_paths(
+                    template
+                )
+            except Exception:
+                raise exc  # metadata unreadable: not structure drift
+            if not drifted:
+                raise
             logger.warning(
-                "Strict restore failed (%s: %s); retrying with merge-by-"
-                "name (fields missing from the checkpoint keep their "
+                "Strict restore failed (%s: %s); checkpoint structure "
+                "differs from the template — retrying with merge-by-name "
+                "(fields missing from the checkpoint keep their "
                 "initialised values)", type(exc).__name__, str(exc)[:200],
             )
             raw = self._ckptr.restore(
-                path, _saved_abstract(self._saved_tree(path), template)
+                path, _saved_abstract(saved_tree, template)
             )
             state = _merge_into_template(template, raw)
         logger.info("Checkpoint restored: %s", path)

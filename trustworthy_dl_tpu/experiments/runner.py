@@ -738,6 +738,10 @@ def run_threshold_sweep(base: ExperimentConfig,
         results = ExperimentRunner(config, **runner_kwargs).run_experiment()
         sweep["thresholds"][f"{threshold:g}"] = {
             "summary": results["experiment_summary"],
+            # The threshold's direct lever is the status machine
+            # (trust_manager.py:162-181): per-threshold status counts are
+            # what a sweep consumer compares first.
+            "trust_statistics": results["final_trust_statistics"],
         }
     out_dir = Path(base.output_dir) / f"{base.experiment_name}_sweep"
     out_dir.mkdir(parents=True, exist_ok=True)
