@@ -282,3 +282,55 @@ def test_attacker_plan_for_live_topology():
     assert mask[6] and mask.sum() == 1  # identity 7 sits at coordinate 6
     # plan() (identity == coordinate) would have dropped the target:
     assert not np.asarray(attacker.plan(7).target_mask)[6]
+
+
+def test_readmission_cooloff_survives_resume(tmp_path):
+    """ADVICE r3: a pending readmission cool-off must survive a
+    save/restore round-trip — the sidecar persists _evicted_at and the
+    evicted coordinate's device, and a resumed trainer readmits on
+    schedule instead of making the eviction silently permanent."""
+    trainer = make_trainer(
+        tmp_path, num_nodes=8, elastic_resharding=True,
+        readmit_after_steps=8,
+    )
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=96)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[5],
+        intensity=0.5, start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+
+    epoch = 0
+    while trainer.config.num_nodes == 8 and epoch < 4:
+        trainer.train_epoch(dl, epoch)
+        epoch += 1
+    assert trainer.config.num_nodes == 7
+    assert 5 in trainer._evicted_at
+    evicted_step = trainer._evicted_at[5]
+    trainer.save_checkpoint()
+    saved_step = trainer.global_step
+
+    # Fresh process: a new trainer resumes from the checkpoint.  The
+    # constructor config says 8 nodes; the sidecar adopts the 7-node
+    # post-eviction topology AND the pending cool-off.
+    resumed = make_trainer(
+        tmp_path, num_nodes=8, elastic_resharding=True,
+        readmit_after_steps=8,
+    )
+    resumed.load_checkpoint(saved_step)
+    assert resumed.config.num_nodes == 7
+    assert resumed._evicted_at == {5: evicted_step}
+    assert 5 in resumed._evicted_devices
+
+    # Attack is over in the resumed run: readmission fires on schedule.
+    resumed.set_attack_plan(null_plan(7))
+    epoch = 0
+    while resumed.config.num_nodes == 7 and epoch < 4:
+        loss = resumed.train_epoch(dl, epoch)
+        epoch += 1
+    assert resumed.config.num_nodes == 8
+    assert resumed.node_map[-1] == 5
+    assert np.isfinite(loss)

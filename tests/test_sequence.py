@@ -17,6 +17,7 @@ from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.models.gpt2 import GPT2Config, full_attention
 from trustworthy_dl_tpu.parallel.sequence import (
     ring_attention,
+    set_sequence_mesh,
     ulysses_attention,
     use_sequence_mesh,
 )
@@ -83,6 +84,10 @@ def test_ring_attention_output_is_sequence_sharded(mesh, qkv):
 
 
 def test_ring_attention_no_mesh_falls_back(qkv):
+    # An earlier test in the session may have bound the global sequence
+    # mesh (trainers in 'sequence' mode set it at construction and after
+    # elastic rebuilds); this test is ABOUT the unbound state — reset.
+    set_sequence_mesh(None)
     q, k, v = qkv
     ref = full_attention(q, k, v, True)
     out = ring_attention(q, k, v, True)  # no use_sequence_mesh context

@@ -72,10 +72,18 @@ def row_placer(mesh: jax.sharding.Mesh, axis: str, n: int):
 
 
 def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
-                  n: int, shard_opt: bool) -> TrainState:
+                  n: int, shard_opt: bool,
+                  place_params: bool = True) -> TrainState:
     """Place a (compacted or expanded) TrainState onto ``mesh``: per-node
     rows shard over ``axis``, params/opt/scalars replicate (opt optionally
-    ZeRO-1-sharded over the data axis)."""
+    ZeRO-1-sharded over the data axis).
+
+    ``place_params=False`` skips the params/opt placement entirely —
+    tensor mode passes it because _reapply_mode_shardings immediately
+    re-lays those subtrees with the TP shardings; replicating a large
+    model's full parameter+moment set onto every chip first would be a
+    wasted whole-model transfer AND a transient unsharded-peak-memory
+    spike."""
     place_row, repl = row_placer(mesh, axis, n)
     per_node = {
         k: jax.tree_util.tree_map(place_row, getattr(state, k))
@@ -83,8 +91,12 @@ def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
     }
     shared = jax.tree_util.tree_map(
         lambda leaf: jax.device_put(leaf, repl),
-        {"params": state.params, "step": state.step,
-         "epoch": state.epoch, "rng": state.rng},
+        {"step": state.step, "epoch": state.epoch, "rng": state.rng},
+    )
+    if not place_params:
+        return state._replace(**per_node, **shared)
+    shared["params"] = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, repl), state.params
     )
     if shard_opt:
         from trustworthy_dl_tpu.engine.state import zero1_place_opt_state
@@ -147,18 +159,79 @@ def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
     )
 
 
+# Parallelism modes with mode-agnostic elastic eviction/readmission: the
+# node axis is the data axis (one device — or one device GROUP for
+# tensor/sequence — per node; core/mesh.py build_mesh), so removing a node
+# coordinate removes its whole group.  Pipeline ("model") reshapes instead
+# (elastic/restaff.py); the reference's contract is mode-blind
+# (trust_manager.py:198-206, distributed_trainer.py:324-352).
+ELASTIC_MODES = ("data", "tensor", "sequence")
+
+
+def node_device_group(mesh: jax.sharding.Mesh, num_nodes: int,
+                      coord: int) -> List[jax.Device]:
+    """Devices owned by node ``coord``: its single chip in 1-per-node data
+    mode, its whole TP/sequence group row in group modes, nothing in dev
+    mode (logical nodes vmapped within fewer devices — no device leaves)."""
+    devices = np.asarray(mesh.devices)
+    if devices.size == num_nodes:
+        return [devices.flat[coord]]
+    if devices.ndim >= 1 and devices.shape[0] == num_nodes:
+        return list(devices[coord].flat)
+    return []
+
+
 def surviving_devices(mesh: jax.sharding.Mesh, num_nodes: int,
                       drop: Sequence[int]) -> List[jax.Device]:
     """Device list after evicting node coordinates.
 
-    When the data axis maps one device per node, the evicted node's chip
-    leaves the mesh (true elasticity).  When logical nodes are vmapped
-    within fewer devices (dev mode / small hosts), the device set is
-    unchanged — eviction then only narrows the logical node axis."""
-    devices = list(mesh.devices.flat)
-    if len(devices) == num_nodes:
-        return [d for i, d in enumerate(devices) if i not in set(drop)]
-    return devices
+    When the node axis maps one device (or one device group) per node, the
+    evicted node's chips leave the mesh (true elasticity).  When logical
+    nodes are vmapped within fewer devices (dev mode / small hosts), the
+    device set is unchanged — eviction then only narrows the logical node
+    axis."""
+    devices = np.asarray(mesh.devices)
+    dropped = set(drop)
+    if devices.size == num_nodes:
+        return [d for i, d in enumerate(devices.flat) if i not in dropped]
+    if devices.ndim >= 1 and devices.shape[0] == num_nodes:
+        return [d for i in range(num_nodes) if i not in dropped
+                for d in devices[i].flat]
+    return list(devices.flat)
+
+
+def _reapply_mode_shardings(state: TrainState, mesh: jax.sharding.Mesh,
+                            parallelism: str) -> TrainState:
+    """Mode-specific placement after a mesh rebuild: tensor mode re-lays
+    the TP parameter/optimizer shardings on the new mesh; sequence mode
+    re-binds the ring/Ulysses collectives' mesh.  Data mode needs
+    nothing — migrate_state already placed everything."""
+    if parallelism == "tensor":
+        from trustworthy_dl_tpu.parallel.tensor_parallel import (
+            apply_tp_sharding,
+            apply_tp_sharding_to_opt,
+        )
+
+        params = apply_tp_sharding(state.params, mesh)
+        opt = apply_tp_sharding_to_opt(state.opt_state, params, mesh)
+        # migrate_state skipped params/opt (place_params=False), so any
+        # opt leaf apply_tp_sharding_to_opt did not cover (step counts,
+        # schedule state — not params-shaped) still sits on the OLD mesh;
+        # replicate it onto the new one.
+        repl = NamedSharding(mesh, P())
+        opt = jax.tree_util.tree_map(
+            lambda leaf: leaf
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            and leaf.sharding.mesh == mesh
+            else jax.device_put(leaf, repl),
+            opt,
+        )
+        return state._replace(params=params, opt_state=opt)
+    if parallelism == "sequence":
+        from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
+
+        set_sequence_mesh(mesh)
+    return state
 
 
 def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
@@ -169,11 +242,10 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         build_train_step
 
     config = trainer.config
-    if config.parallelism != "data":
+    if config.parallelism not in ELASTIC_MODES:
         raise NotImplementedError(
-            "elastic resharding currently supports data parallelism; a "
-            "compromised pipeline stage is frozen in-step instead "
-            "(parallel/pipeline.py trust gate)"
+            f"elastic resharding supports {ELASTIC_MODES}; a compromised "
+            "pipeline stage restaffs instead (elastic/restaff.py)"
         )
     n = config.num_nodes
     drop = sorted(set(int(d) for d in drop))
@@ -182,29 +254,35 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         raise ValueError("cannot evict every node")
 
     t0 = time.perf_counter()
-    # Remember each evicted coordinate's device so a later readmission
-    # (readmit_and_reshard) can restore it to the mesh.  In dev mode
-    # (logical nodes vmapped within fewer devices) no device leaves.
-    old_devices = list(trainer.mesh.devices.flat)
+    # Remember each evicted coordinate's device group so a later
+    # readmission (readmit_and_reshard) can restore it to the mesh.  In
+    # dev mode (logical nodes vmapped within fewer devices) no device
+    # leaves and the group is empty.
     for i in drop:
-        trainer._evicted_devices[trainer.node_map[i]] = (
-            old_devices[i] if len(old_devices) == n else None
+        trainer._evicted_devices[trainer.node_map[i]] = node_device_group(
+            trainer.mesh, n, i
         )
     new_devices = surviving_devices(trainer.mesh, n, drop)
-    new_mesh = build_mesh(len(keep), "data", devices=new_devices)
+    new_mesh = build_mesh(len(keep), config.parallelism,
+                          devices=new_devices)
     new_config = dataclasses.replace(config, num_nodes=len(keep))
 
     compact = compact_train_state(trainer.state, keep)
 
     # Migrate onto the new mesh: per-node arrays shard over the surviving
-    # data axis; everything else replicates.  This is the device_put
-    # migration the reference's no-op claimed to do.
+    # data axis; everything else replicates (then tensor mode re-lays its
+    # TP shardings).  This is the device_put migration the reference's
+    # no-op claimed to do.
     data_size = dict(zip(new_mesh.axis_names,
                          new_mesh.devices.shape)).get(DATA_AXIS, 1)
     new_state = migrate_state(
         compact, new_mesh, DATA_AXIS, len(keep),
-        shard_opt=config.shard_opt_state and data_size > 1,
+        shard_opt=config.shard_opt_state and data_size > 1
+        and config.parallelism == "data",
+        place_params=config.parallelism != "tensor",
     )
+    new_state = _reapply_mode_shardings(new_state, new_mesh,
+                                        config.parallelism)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
 
@@ -326,9 +404,10 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         build_train_step
 
     config = trainer.config
-    if config.parallelism != "data":
+    if config.parallelism not in ELASTIC_MODES:
         raise NotImplementedError(
-            "elastic readmission follows eviction: data parallelism only"
+            f"elastic readmission follows eviction: {ELASTIC_MODES} only "
+            "(model-parallel stages re-enter via the restaff idle pool)"
         )
     node_ids = [int(i) for i in node_ids]
     unknown = [i for i in node_ids if i not in trainer._evicted_devices]
@@ -340,10 +419,10 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
     t0 = time.perf_counter()
     devices = list(trainer.mesh.devices.flat)
     for nid in node_ids:
-        dev = trainer._evicted_devices[nid]
-        if dev is not None:
-            devices.append(dev)
-    new_mesh = build_mesh(n_new, "data", devices=devices)
+        # The node's whole device group returns (its single chip in
+        # 1-per-node data mode; empty in dev mode — no device ever left).
+        devices.extend(trainer._evicted_devices.get(nid) or [])
+    new_mesh = build_mesh(n_new, config.parallelism, devices=devices)
     new_config = dataclasses.replace(config, num_nodes=n_new)
 
     now = float(trainer.state.step) * config.time_per_step
@@ -356,8 +435,12 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
                          new_mesh.devices.shape)).get(DATA_AXIS, 1)
     new_state = migrate_state(
         expanded, new_mesh, DATA_AXIS, n_new,
-        shard_opt=config.shard_opt_state and data_size > 1,
+        shard_opt=config.shard_opt_state and data_size > 1
+        and config.parallelism == "data",
+        place_params=config.parallelism != "tensor",
     )
+    new_state = _reapply_mode_shardings(new_state, new_mesh,
+                                        config.parallelism)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
 

@@ -165,45 +165,68 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         new_devices = old_devices
         for _, nid, coord in idle_entries:
             new_pool[nid] = []
+    # Park the evicted stages' device columns so a cooled-off identity can
+    # bring them back through the idle pool (_readmit_stages) — the
+    # model-mode return path; without this an evicted column's hardware
+    # would be lost to the run forever.
+    for i in drop:
+        trainer._evicted_devices[trainer.node_map[i]] = (
+            list(mesh.devices.reshape(-1, S)[:, i]) if multi_device else []
+        )
     new_mesh = build_mesh(new_S, "model", devices=new_devices)
     new_config = dataclasses.replace(config, num_nodes=new_S)
 
     # --- trust rows: on-mesh rows carry over; pool rows synthesise from
-    # the host TrustManager's standing (probation-free — they were never
-    # compromised, just unseated by the stage-count arithmetic) ----------
+    # the host TrustManager's standing — TRUSTED for a healthy survivor a
+    # previous restaff could not seat, RECOVERING with the boosted rate
+    # for a cooled-off evicted identity re-entering on probation
+    # (begin_probation; the reference's mode-blind recovery ladder,
+    # trust_manager.py:198-206) ------------------------------------------
     from trustworthy_dl_tpu.trust.state import METRIC_DEFAULTS
 
     now = float(state.step) * config.time_per_step
+    host = trainer.trust_manager.state
+
+    def host_row(attr, nid, default):
+        arr = np.asarray(getattr(host, attr))
+        return arr[nid] if nid < arr.shape[0] else default
 
     def gather_rows(field, synth):
         rows = []
         arr = np.asarray(field)
         for score, nid, coord in chosen:
-            rows.append(arr[coord] if coord is not None else synth(score))
+            rows.append(arr[coord] if coord is not None
+                        else synth(score, nid))
         return jnp.asarray(np.stack(rows))
 
     trust = state.trust._replace(
         scores=gather_rows(state.trust.scores,
-                           lambda s: np.float32(s)),
+                           lambda s, nid: np.float32(s)),
         status=gather_rows(
             state.trust.status,
-            lambda s: np.int32(0 if s >= float(state.trust.threshold)
-                               else 1),
+            lambda s, nid: np.int32(
+                int(trainer.trust_manager.get_node_status(nid))
+            ),
         ),
         update_count=gather_rows(state.trust.update_count,
-                                 lambda s: np.int32(0)),
+                                 lambda s, nid: np.int32(0)),
         last_updated=gather_rows(state.trust.last_updated,
-                                 lambda s: np.float32(now)),
+                                 lambda s, nid: np.float32(now)),
         decay_rate=gather_rows(state.trust.decay_rate,
-                               lambda s: np.float32(
+                               lambda s, nid: np.float32(
                                    config.trust_decay_rate)),
-        recovery_rate=gather_rows(state.trust.recovery_rate,
-                                  lambda s: np.float32(
-                                      config.trust_recovery_rate)),
+        recovery_rate=gather_rows(
+            state.trust.recovery_rate,
+            lambda s, nid: np.float32(host_row(
+                "recovery_rate", nid, config.trust_recovery_rate
+            )),
+        ),
         metrics=gather_rows(state.trust.metrics,
-                            lambda s: np.asarray(METRIC_DEFAULTS)),
-        attack_count=gather_rows(state.trust.attack_count,
-                                 lambda s: np.int32(0)),
+                            lambda s, nid: np.asarray(METRIC_DEFAULTS)),
+        attack_count=gather_rows(
+            state.trust.attack_count,
+            lambda s, nid: np.int32(host_row("attack_count", nid, 0)),
+        ),
     )
 
     # --- the layer migration: restack blocks + their moments ------------

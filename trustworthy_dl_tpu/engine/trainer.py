@@ -157,7 +157,9 @@ class DistributedTrainer:
             "gpt"
         ):
             model_overrides.setdefault("attn_impl", "ring")
-        if config.lm_head_chunk and config.model_name.startswith("gpt"):
+        if config.lm_head_chunk >= 0 and config.model_name.startswith("gpt"):
+            # -1 = model default ("auto" dispatch); 0 = force materialised;
+            # >0 = force that chunk width.
             model_overrides.setdefault("lm_head_chunk", config.lm_head_chunk)
         if config.model_name.startswith("gpt"):
             if config.remat:
@@ -375,12 +377,18 @@ class DistributedTrainer:
         caller skips it)."""
         if self.config.parallelism == "model":
             m = self.config.num_microbatches
+            # DP pipeline replica rows (TPU (group, S) mesh) additionally
+            # shard each microbatch over the data axis, so mb must divide
+            # by the row count.
+            dp = self.mesh.shape.get(DATA_AXIS, 1)
+            quantum = m * dp
             out = {}
             for key, arr in batch.items():
-                b = (arr.shape[0] // m) * m
+                b = (arr.shape[0] // quantum) * quantum
                 if b == 0:
                     raise ValueError(
-                        f"batch size {arr.shape[0]} < num_microbatches {m}"
+                        f"batch size {arr.shape[0]} < num_microbatches x "
+                        f"dp rows = {quantum}"
                     )
                 out[key] = jnp.asarray(np.asarray(arr[:b]))
             return out
@@ -610,8 +618,10 @@ class DistributedTrainer:
                     exclude=flagged_ids,
                 )
                 evict_coords.append(int(coord))
+        from trustworthy_dl_tpu.elastic.reassignment import ELASTIC_MODES
+
         if (evict_coords and self.config.elastic_resharding
-                and self.config.parallelism == "data"
+                and self.config.parallelism in ELASTIC_MODES
                 and len(evict_coords) < self.config.num_nodes):
             from trustworthy_dl_tpu.elastic.reassignment import (
                 evict_and_reshard,
@@ -635,15 +645,23 @@ class DistributedTrainer:
             record = restaff_pipeline(self, evict_coords)
             record["step"] = self.global_step
             self.reassignment_history.append(record)
+            for orig in record["evicted_nodes"]:
+                # Start the cool-off clock: a cooled-off stage identity
+                # re-enters the restaff candidate pool (_maybe_readmit).
+                self._evicted_at[int(orig)] = self.global_step
 
     def _maybe_readmit(self) -> None:
         """Re-admit evicted coordinates whose cool-off has elapsed
         (config.readmit_after_steps) — the elastic counterpart of the
         in-step probation: without it a false-positive eviction costs 1/n
-        of the fleet for the rest of the run."""
+        of the fleet for the rest of the run.  Mode-agnostic like the
+        reference's recovery ladder (trust_manager.py:198-206):
+        data/tensor/sequence restore the coordinate (and its device
+        group); model mode returns the identity to the restaff candidate
+        pool and regrows the stage count when the arithmetic allows."""
         cfg = self.config
         if not (cfg.elastic_resharding and cfg.readmit_after_steps > 0
-                and cfg.parallelism == "data" and self._evicted_at):
+                and self._evicted_at):
             return
         due = sorted(
             nid for nid, when in self._evicted_at.items()
@@ -652,13 +670,44 @@ class DistributedTrainer:
         if not due:
             return
         from trustworthy_dl_tpu.elastic.reassignment import (
+            ELASTIC_MODES,
             readmit_and_reshard,
         )
 
-        record = readmit_and_reshard(self, due)
-        record["step"] = self.global_step
-        self.reassignment_history.append(record)
-        self._resize_loader()
+        if cfg.parallelism in ELASTIC_MODES:
+            record = readmit_and_reshard(self, due)
+            record["step"] = self.global_step
+            self.reassignment_history.append(record)
+            self._resize_loader()
+        elif cfg.parallelism == "model":
+            self._readmit_stages(due)
+
+    def _readmit_stages(self, due: Sequence[int]) -> None:
+        """Model-mode return path: cooled-off evicted stage identities
+        re-enter the restaff candidate pool on probation (RECOVERING with
+        the 0.5 readmission trust floor), and an immediate restaff
+        re-expands S' -> S when the layer arithmetic allows; otherwise the
+        identity waits in the idle pool for the next restaff."""
+        from trustworthy_dl_tpu.elastic.restaff import (
+            choose_stage_count,
+            restaff_pipeline,
+        )
+
+        for nid in due:
+            self._idle_pool[nid] = self._evicted_devices.pop(nid, []) or []
+            self._evicted_at.pop(nid, None)
+            self._open_incidents.discard(nid)
+            self.trust_manager.begin_probation(nid)
+        blocks = self.state.params["blocks"]
+        lead = jax.tree_util.tree_leaves(blocks)[0]
+        num_layers = lead.shape[0] * lead.shape[1]
+        grown = choose_stage_count(
+            num_layers, self.config.num_nodes + len(self._idle_pool)
+        )
+        if grown > self.config.num_nodes:
+            record = restaff_pipeline(self, [])
+            record["step"] = self.global_step
+            self.reassignment_history.append(record)
 
     def _resize_loader(self) -> None:
         """Re-size the live data pipeline after a topology change so batch
@@ -731,11 +780,13 @@ class DistributedTrainer:
             }
         )
         self.trust_manager.mark_compromised(node_id, attack_type)
+        from trustworthy_dl_tpu.elastic.reassignment import ELASTIC_MODES
+
         if not (self.config.elastic_resharding
-                and self.config.parallelism in ("data", "model")):
+                and self.config.parallelism in ELASTIC_MODES + ("model",)):
             # Legacy greedy handoff (relabel) — elastic mode replaces it
-            # with the real eviction (data) or stage restaff (model) in
-            # _record_batch.
+            # with the real group eviction (ELASTIC_MODES) or stage
+            # restaff (model) in _record_batch.
             self.reassign_node_tasks(node_id, exclude=exclude)
         self.training_state = TrainingState.UNDER_ATTACK
 
@@ -911,11 +962,34 @@ class DistributedTrainer:
             "num_nodes": self.config.num_nodes,
             "node_map": list(self.node_map),
             "parallelism": self.config.parallelism,
+            # The live mesh's device ids: after an eviction the mesh is NOT
+            # "the first n devices" (the evicted chip is missing from the
+            # middle), and a resume that guessed would collide with the
+            # evicted device on readmission.
+            "mesh_devices": [d.id for d in self.mesh.devices.flat],
             # Evicted identities have no device row anymore; their
             # compromised standing must survive the resume on the host.
             "compromised_nodes": sorted(
                 int(i) for i in self.trust_manager.get_compromised_nodes()
             ),
+            # Elastic bookkeeping: a pending readmission cool-off and
+            # idle-pool identities must survive a resume — without them an
+            # eviction silently becomes permanent despite
+            # readmit_after_steps>0, and parked restaff survivors can never
+            # re-enter.  Devices persist by id and re-resolve on the
+            # resumed host.
+            "evicted_at": {
+                str(nid): int(step)
+                for nid, step in self._evicted_at.items()
+            },
+            "evicted_devices": {
+                str(nid): [d.id for d in (devs or [])]
+                for nid, devs in self._evicted_devices.items()
+            },
+            "idle_pool": {
+                str(nid): [d.id for d in devs]
+                for nid, devs in self._idle_pool.items()
+            },
         })
         return path
 
@@ -924,11 +998,14 @@ class DistributedTrainer:
         (post-eviction) node count — SURVEY §5.4's resume requirement."""
         import dataclasses
 
-        if self.config.parallelism not in ("data", "model"):
+        from trustworthy_dl_tpu.elastic.reassignment import ELASTIC_MODES
+
+        if self.config.parallelism not in ELASTIC_MODES + ("model",):
             raise NotImplementedError(
                 "post-eviction resume onto a different node count is only "
-                "defined for data and model parallelism (eviction itself "
-                "is, elastic/reassignment.py + elastic/restaff.py)"
+                "defined for the modes eviction itself supports "
+                "(elastic/reassignment.py ELASTIC_MODES + "
+                "elastic/restaff.py)"
             )
         n = int(meta["num_nodes"])
         logger.info(
@@ -936,8 +1013,25 @@ class DistributedTrainer:
             "the saved topology for resume", n, self.config.num_nodes,
         )
         self.config = dataclasses.replace(self.config, num_nodes=n)
+        # Rebuild the SAVED device set when the sidecar has it: post-
+        # eviction the live mesh is missing a chip from the middle, and a
+        # first-n guess would seat the evicted device twice once it is
+        # readmitted.
+        devices = None
+        ids = meta.get("mesh_devices")
+        if ids is not None:
+            by_id = {d.id: d for d in jax.devices()}
+            devs = [by_id[i] for i in ids if i in by_id]
+            if len(devs) == len(ids):
+                devices = devs
         self.mesh = build_mesh(n, self.config.parallelism,
-                               self.config.mesh_shape)
+                               self.config.mesh_shape, devices=devices)
+        if self.config.parallelism == "sequence":
+            from trustworthy_dl_tpu.parallel.sequence import (
+                set_sequence_mesh,
+            )
+
+            set_sequence_mesh(self.mesh)
         if self.config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
@@ -1007,6 +1101,24 @@ class DistributedTrainer:
                     self.trust_manager.mark_compromised(
                         node_id, attack_type="restored_from_checkpoint"
                     )
+            # Rehydrate elastic bookkeeping so pending readmission
+            # cool-offs and parked idle-pool identities survive the resume
+            # (devices re-resolve by id; one lost to a host change degrades
+            # to the dev-mode no-device path rather than dropping the
+            # identity).
+            by_id = {d.id: d for d in jax.devices()}
+            self._evicted_at = {
+                int(k): int(v)
+                for k, v in meta.get("evicted_at", {}).items()
+            }
+            self._evicted_devices = {
+                int(k): [by_id[i] for i in ids if i in by_id]
+                for k, ids in meta.get("evicted_devices", {}).items()
+            }
+            self._idle_pool = {
+                int(k): [by_id[i] for i in ids if i in by_id]
+                for k, ids in meta.get("idle_pool", {}).items()
+            }
         self.global_step = int(self.state.step)
         self.sync_host_state()
         return self.state

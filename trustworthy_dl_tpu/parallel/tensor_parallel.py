@@ -103,6 +103,41 @@ def apply_tp_sharding(params: Params, mesh: Mesh) -> Params:
     )
 
 
+def apply_tp_sharding_to_opt(opt_state: Any, params: Params,
+                             mesh: Mesh) -> Any:
+    """Re-place optimizer-moment mirrors with the params' TP layout.
+
+    Adam's mu/nu are params-structured subtrees inside the optax state;
+    after an elastic mesh rebuild (eviction/readmission in tensor mode)
+    they must follow their weights back onto the TP shardings — structure
+    matching (treedef equality with ``params``) finds them exactly, and
+    every other leaf (step counts, schedule state) is left as placed."""
+    if MODEL_AXIS not in mesh.axis_names:
+        return opt_state
+    specs = _spec_tree_for(params)
+    pdef = jax.tree_util.tree_structure(params)
+
+    def params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == pdef
+        except Exception:
+            return False
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        opt_state, is_leaf=params_like
+    )
+    placed = [
+        jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(mesh, spec)
+            ),
+            node, specs,
+        ) if params_like(node) else node
+        for node in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
 def tp_group_size(mesh: Mesh) -> int:
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     return sizes.get(MODEL_AXIS, 1)

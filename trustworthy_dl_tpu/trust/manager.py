@@ -235,6 +235,19 @@ class TrustManager:
     def initiate_recovery(self, node_id: int) -> None:
         self._state = ts.initiate_recovery(self._state, self._one_hot(node_id))
 
+    def begin_probation(self, node_id: int, trust: float = 0.5) -> None:
+        """Probation re-entry for a readmitted identity (elastic):
+        ``initiate_recovery`` semantics (RECOVERING + boosted recovery
+        rate, trust_manager.py:198-206) plus the readmission trust floor —
+        the same 0.5 starting score expand_train_state gives a
+        data-parallel readmitted coordinate."""
+        self.initiate_recovery(node_id)
+        one = self._one_hot(node_id)
+        s = self._state
+        self._state = s._replace(
+            scores=jnp.where(one, jnp.maximum(s.scores, trust), s.scores)
+        )
+
     def reset_node_trust(self, node_id: int) -> None:
         self.initialize_node(node_id)
         logger.info("trust: node %d reset", node_id)
