@@ -147,13 +147,20 @@ def build_mesh(
     usable = (n_dev // num_nodes) * num_nodes
     group = usable // num_nodes
     if axis == STAGE_AXIS:
-        # Pipeline: the stage axis carries the nodes, one device per
-        # stage; surplus devices stay OUT of the mesh.  (A (group, S)
-        # replica layout was tried and reverted: the trusted step cannot
-        # shard microbatches over it without racing independent subgroup
-        # collectives — deadlocks XLA:CPU's in-process communicator — and
-        # with replicated inputs the extra rows are pure waste.)
-        arr = np.array(devices[:num_nodes]).reshape(1, num_nodes)
+        # Pipeline: the stage axis carries the nodes.  On TPU, surplus
+        # devices form DP pipeline replica rows — a (group, S) mesh whose
+        # data axis shards the microbatches (parallel/pipeline.py), so
+        # adding chips beyond S scales batch throughput.  On CPU the mesh
+        # stays exactly (1, S): the DP×PP composition races independent
+        # subgroup collectives (stage-row psum vs GSPMD-inserted data
+        # all-reduces), which nondeterministically aborts XLA:CPU's
+        # in-process communicator — a backend bug TPU's compiled
+        # collectives don't have.  (Verified r3: the bare pipe matched
+        # sequential grads under the (2, 4) mesh; only XLA:CPU crashed.)
+        if group >= 2 and devices[0].platform == "tpu":
+            arr = np.array(devices[:usable]).reshape(group, num_nodes)
+        else:
+            arr = np.array(devices[:num_nodes]).reshape(1, num_nodes)
         return Mesh(arr, (DATA_AXIS, axis))
     # Tensor / sequence: trust nodes stay data shards; each node owns a
     # TP / sequence group of the remaining devices (SURVEY §2.4 plan — the

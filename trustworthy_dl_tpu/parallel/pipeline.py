@@ -40,7 +40,7 @@ from jax import shard_map
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, \
     corrupt_stage_compute, poison_gradients
 from trustworthy_dl_tpu.core.config import TrainingConfig
-from trustworthy_dl_tpu.core.mesh import STAGE_AXIS
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.detect import stats as st
 from trustworthy_dl_tpu.detect.detector import AttackType, anomaly_verdicts
@@ -84,6 +84,16 @@ def _right_rotation(axis: str, size: int):
     return [(i, (i + 1) % size) for i in range(size)]
 
 
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """GPipe pipeline bubble: the idle fraction of the M + S - 1 tick
+    schedule, (S-1)/(M+S-1).  The backward schedule is the AD transpose of
+    the same ``ppermute`` ring, so it mirrors the forward bubble — raising
+    ``num_microbatches`` is the schedule-level lever (M=4,S=4 → 43 %;
+    M=32,S=4 → 8.6 %), and DP pipeline replica rows (the TPU (group, S)
+    mesh) scale batch throughput without touching it."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
 def build_pipeline_apply(
     cfg: gpt2.GPT2Config,
     mesh: Mesh,
@@ -95,12 +105,17 @@ def build_pipeline_apply(
     (y_microbatches, stage_stats[S,17], act_mean[S], act_std[S]).
 
     ``stage_blocks`` leaves are [S, L/S, ...] (sharded P('stage')),
-    ``x_microbatches`` is [M, mb, T, D] (replicated).  The schedule runs
-    M + S - 1 ticks; each tick every stage applies its layer slice to its
-    current activation and passes it right around the ring.
+    ``x_microbatches`` is [M, mb, T, D] — its mb dim shards over the
+    mesh's data axis when the mesh carries DP pipeline replica rows (the
+    TPU (group, S) layout, core/mesh.py), so surplus chips beyond S scale
+    batch throughput.  The schedule runs M + S - 1 ticks; each tick every
+    stage applies its layer slice to its current activation and passes it
+    right around the ring (per data row — shard_map scopes the ppermute
+    to each row's stage subgroup).
     """
     S, M = num_stages, num_microbatches
     total_ticks = M + S - 1
+    dp = mesh.shape.get(DATA_AXIS, 1)
 
     def apply_local(local_blocks, x):
         def body(h, block):
@@ -163,6 +178,14 @@ def build_pipeline_apply(
         stage_stats = (stats_sum / denom)[None, :]           # [1, 17] local
         act_mean = (mean_sum / denom)[None]
         act_std = (std_sum / denom)[None]
+        if dp > 1:
+            # DP replica rows each saw a different microbatch shard:
+            # average the boundary batteries across rows so the per-stage
+            # baseline describes the whole batch (consistent with the
+            # tick-average above).
+            stage_stats = jax.lax.psum(stage_stats, DATA_AXIS) / dp
+            act_mean = jax.lax.psum(act_mean, DATA_AXIS) / dp
+            act_std = jax.lax.psum(act_std, DATA_AXIS) / dp
         # Completed outputs live only on the last stage; psum replicates
         # them (other stages contribute zeros) so unembed/loss is SPMD.
         outputs = jax.lax.psum(outputs, STAGE_AXIS)
@@ -171,8 +194,11 @@ def build_pipeline_apply(
     pipe = shard_map(
         pipe_local,
         mesh=mesh,
-        in_specs=(P(STAGE_AXIS), P()),
-        out_specs=(P(), P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS)),
+        # mb (dim 1 of x_mb / outputs) shards over the DP replica rows; on
+        # the (1, S) mesh the spec degenerates to full replication.
+        in_specs=(P(STAGE_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(None, DATA_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
+                   P(STAGE_AXIS)),
         check_vma=False,
     )
     return pipe
@@ -296,18 +322,41 @@ def build_pipeline_train_step(
     pipe_apply = build_pipeline_apply(cfg, mesh, S, M, max_sort)
     canary_const = make_canary(cfg, config.canary_tokens)
 
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    logger_msg = (
+        "pipeline schedule: S=%d stages, M=%d microbatches, %d DP replica "
+        "row(s); GPipe bubble fraction %.1f%%" % (
+            S, M, dp, 100.0 * bubble_fraction(S, M))
+    )
+    import logging as _logging
+
+    _logging.getLogger(__name__).info(logger_msg)
+
     def loss_fn(params, batch):
         x = gpt2.embed(params, batch["input"], cfg)
         b, t, d = x.shape
         mb = b // M
         x_mb = x.reshape(M, mb, t, d)
         y_mb, stage_stats, act_mean, act_std = pipe_apply(params["blocks"], x_mb)
-        y = y_mb.reshape(b, t, d)
+        if dp > 1:
+            # Merge with mb leading so the data-sharded dim stays the
+            # (contiguous) row dim of the merged batch — a plain
+            # [M, mb] → [b] merge would need a strided sharding and
+            # GSPMD would all-gather the activations instead.  Targets
+            # take the identical permutation; the loss is a mean over
+            # all positions, so the reorder changes nothing but
+            # summation order.
+            y = y_mb.transpose(1, 0, 2, 3).reshape(b, t, d)
+            targets = batch["target"].reshape(M, mb, t).transpose(
+                1, 0, 2
+            ).reshape(b, t)
+        else:
+            y = y_mb.reshape(b, t, d)
+            targets = batch["target"]
         # Head via the shared helper: honours cfg.lm_head_chunk (fused
         # vocab-chunked CE — the logits never materialise), identical to
         # the data-parallel loss path so the modes cannot drift.
-        loss, _ = gpt2.head_loss_and_signature(params, y, batch["target"],
-                                               cfg)
+        loss, _ = gpt2.head_loss_and_signature(params, y, targets, cfg)
         return loss, (stage_stats, act_mean, act_std)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -571,22 +620,37 @@ def build_pipeline_eval_step(bundle, config: TrainingConfig, mesh: Mesh
     pipe_apply = build_pipeline_apply(cfg, mesh, config.num_nodes,
                                       config.num_microbatches)
 
+    dp = mesh.shape.get(DATA_AXIS, 1)
+
     def eval_step(params, batch):
         tokens = batch["input"]
         x = gpt2.embed(params, tokens, cfg)
         b, t, d = x.shape
-        mb = b // config.num_microbatches
-        x_mb = x.reshape(config.num_microbatches, mb, t, d)
+        M = config.num_microbatches
+        mb = b // M
+        x_mb = x.reshape(M, mb, t, d)
         y_mb, _, _, _ = pipe_apply(params["blocks"], x_mb)
-        y = y_mb.reshape(b, t, d)
-        if cfg.lm_head_chunk:
+        if dp > 1:
+            # Same sharding-preserving merge + target permutation as the
+            # train loss (see build_pipeline_train_step.loss_fn).
+            y = y_mb.transpose(1, 0, 2, 3).reshape(b, t, d)
+            batch = dict(
+                batch,
+                target=batch["target"].reshape(M, mb, t).transpose(
+                    1, 0, 2
+                ).reshape(b, t),
+            )
+        else:
+            y = y_mb.reshape(b, t, d)
+        chunk = gpt2.resolve_lm_head_chunk(cfg, int(batch["target"].size))
+        if chunk:
             # Same memory contract as training: the fused eval never
             # materialises the [B, T, V] logits (ops/fused_ce.py).
             from trustworthy_dl_tpu.ops.fused_ce import fused_lm_eval
 
             normed = L.layernorm(params["ln_f"], y)
             loss, acc = fused_lm_eval(normed, params["wte"],
-                                      batch["target"], cfg.lm_head_chunk,
+                                      batch["target"], chunk,
                                       cfg.dtype)
             return {"loss": loss, "accuracy": acc}
         logits = gpt2.unembed(params, y, cfg)
