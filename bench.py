@@ -18,13 +18,15 @@ Diagnostics go to stderr.
 Env overrides: TDDL_BENCH_MODEL (gpt2), TDDL_BENCH_NODES (4),
 TDDL_BENCH_BATCH (per-node, 16), TDDL_BENCH_SEQ (512),
 TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3), TDDL_BENCH_REMAT (1),
-TDDL_BENCH_CHUNK (0 = materialised-logits CE; >0 = fused vocab-chunked
-head), TDDL_BENCH_ATTN (model default), TDDL_BENCH_ACCUM (grad
-accumulation microbatches, 1).
+TDDL_BENCH_CHUNK (unset = model default "auto"; 0 forces the
+materialised-logits CE; >0 forces the fused vocab-chunked head),
+TDDL_BENCH_ATTN (model default), TDDL_BENCH_ACCUM (grad accumulation
+microbatches, 1).
 
-Default config is the measured single-v5e sweet spot: per-node batch 16
-(64 x 512 tokens/step) with block rematerialisation — larger batches fit
-only via TDDL_BENCH_CHUNK and are compute-bound slightly below it.
+``--config <preset>`` selects a BASELINE.md benchmark-matrix shape
+(`--config list` prints them); env overrides still apply on top.  The
+default preset is the measured single-v5e sweet spot: per-node batch 16
+(64 x 512 tokens/step) with block rematerialisation.
 """
 
 from __future__ import annotations
@@ -37,6 +39,39 @@ import time
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# BASELINE.md benchmark-matrix presets (configs 1-4 shapes + extras), so
+# driver BENCH_r*.json runs can capture any row reproducibly instead of
+# builder-transcribed tables.  Values are defaults; TDDL_BENCH_* env
+# overrides still win.
+PRESETS = {
+    # The headline row: GPT-2 small, 4 nodes x b16 x T512, remat.
+    "default": {},
+    # BASELINE config 1 shape: ResNet-32 / CIFAR-10.
+    "resnet32": dict(model="resnet32", batch=64),
+    # BASELINE config 2 shape: VGG-16 / CIFAR-10 (the conv-battery row).
+    "vgg16": dict(model="vgg16", batch=64),
+    "resnet50": dict(model="resnet50", batch=64),
+    # BASELINE config 4 shape: GPT-2 medium.
+    "gpt2-medium": dict(model="gpt2-medium", batch=8),
+    # Long-context row: GPT-2 medium at T=1024, auto attention.
+    "longctx": dict(model="gpt2-medium", batch=4, seq=1024),
+}
+
+
+def apply_preset(name: str) -> None:
+    """Materialise a preset as TDDL_BENCH_* defaults (env wins)."""
+    if name == "list":
+        log("available presets: " + ", ".join(sorted(PRESETS)))
+        sys.exit(0)
+    if name not in PRESETS:
+        log(f"unknown preset {name!r}; available: {sorted(PRESETS)}")
+        sys.exit(2)
+    keymap = {"model": "TDDL_BENCH_MODEL", "nodes": "TDDL_BENCH_NODES",
+              "batch": "TDDL_BENCH_BATCH", "seq": "TDDL_BENCH_SEQ"}
+    for key, value in PRESETS[name].items():
+        os.environ.setdefault(keymap[key], str(value))
 
 
 def bench_mode(detection: bool, model: str, num_nodes: int,
@@ -84,11 +119,15 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
         attack_detection_enabled=detection,
         gradient_verification_enabled=detection,
         parallelism="data",
-        lm_head_chunk=int(os.environ.get("TDDL_BENCH_CHUNK", "0")),
         grad_accum_steps=int(os.environ.get("TDDL_BENCH_ACCUM", "1")),
     )
     overrides: dict = {}
     if model.startswith("gpt"):
+        # Unset -> the model's lm_head_chunk="auto" dispatch; an explicit
+        # value (including 0 = force materialised) overrides it.
+        chunk_env = os.environ.get("TDDL_BENCH_CHUNK", "")
+        if chunk_env != "":
+            overrides["lm_head_chunk"] = int(chunk_env)
         overrides["seq_len"] = seq_len
         if seq_len > 1024:
             # Long-context runs need the position table to match.
@@ -209,6 +248,13 @@ def bench_generate() -> None:
 
 
 def main() -> None:
+    if "--config" in sys.argv:
+        idx = sys.argv.index("--config") + 1
+        if idx >= len(sys.argv):
+            log("usage: bench.py --config <preset>  (--config list to "
+                "enumerate)")
+            sys.exit(2)
+        apply_preset(sys.argv[idx])
     model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
     num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
     per_node_batch = int(os.environ.get("TDDL_BENCH_BATCH", "16"))

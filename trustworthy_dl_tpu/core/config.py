@@ -119,8 +119,11 @@ class TrainingConfig:
     # Vocab-chunked fused lm-head+cross-entropy (ops/fused_ce.py): the LM
     # loss never materialises the [B, T, V] logits — removes the dominant
     # HBM tensor of the loss step and unlocks larger per-chip batches.
-    # 0 disables; typical value 8192 (multiple of 128 for MXU tiling).
-    lm_head_chunk: int = 0
+    # -1 (default) leaves the model's "auto" per-shape dispatch in charge
+    # (gpt2.resolve_lm_head_chunk); 0 forces the materialised-logits CE;
+    # >0 forces chunking at that width (multiple of 128 for MXU tiling,
+    # typical 8192).
+    lm_head_chunk: int = -1
     # ZeRO-1-style optimizer-state sharding over the data axis (data
     # parallelism only).  Pure GSPMD annotation: the Adam moments shard
     # across the data devices, XLA partitions the update computation and

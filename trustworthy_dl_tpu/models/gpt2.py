@@ -64,8 +64,13 @@ class GPT2Config:
     # memory).
     remat_policy: str = "block"
     # Vocab-chunked fused lm-head+CE (ops/fused_ce.py): the loss never
-    # materialises the [B, T, V] logits.  0 disables (full logits path).
-    lm_head_chunk: int = 0
+    # materialises the [B, T, V] logits.  0 forces the materialised-logits
+    # path, an int > 0 forces chunking with that width, and "auto" (the
+    # default, mirroring attn_impl) resolves per shape at trace time:
+    # chunked only where the materialised logits would pressure HBM
+    # (auto_picks_chunked_ce) — below that the materialised path is
+    # measured faster (BASELINE.md: chunked is −8 % at the default batch).
+    lm_head_chunk: Any = "auto"
 
     @staticmethod
     def from_name(name: str, **overrides: Any) -> "GPT2Config":
@@ -138,6 +143,41 @@ def _auto_attention(q, k, v, causal=True):
     if auto_picks_flash(q.shape[-2], q.shape[-1]):
         return flash_attention(q, k, v, causal)
     return _ATTN_REGISTRY["full"](q, k, v, causal)
+
+
+# lm_head_chunk="auto": chunk width used when the predicate picks the
+# fused path (the bench-swept sweet spot), and the per-node materialised-
+# logits budget above which it engages.  The budget is calibrated on the
+# measured crossover (BASELINE.md): 4 nodes × b16 × T512 × V50257 bf16
+# logits are ~0.82 GiB/node and the materialised path wins by 8 %; at
+# b32/node (~1.65 GiB/node) the materialised program exceeds HBM and only
+# the chunked path runs.  1 GiB/node splits the two.
+AUTO_CE_CHUNK = 8192
+AUTO_CE_MAX_LOGITS_BYTES = 1 << 30
+
+
+def auto_picks_chunked_ce(num_tokens: int, vocab: int,
+                          itemsize: int = 2) -> bool:
+    """THE lm_head_chunk='auto' dispatch predicate — one answer to 'does
+    auto use the vocab-chunked fused CE here?', shared by the train loss,
+    both eval steps, and the tests.  Picks chunked exactly when this
+    node's materialised [tokens, vocab] logits would exceed
+    AUTO_CE_MAX_LOGITS_BYTES."""
+    return num_tokens * vocab * itemsize > AUTO_CE_MAX_LOGITS_BYTES
+
+
+def resolve_lm_head_chunk(cfg: "GPT2Config", num_tokens: int) -> int:
+    """Trace-time resolution of ``cfg.lm_head_chunk`` for a loss over
+    ``num_tokens`` target positions: explicit settings pass through
+    ("auto" is the only non-int value), auto applies the predicate.
+    Shapes are static under jit, so the branch costs nothing."""
+    chunk = cfg.lm_head_chunk
+    if chunk == "auto":
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        if auto_picks_chunked_ce(num_tokens, cfg.vocab_size, itemsize):
+            return AUTO_CE_CHUNK
+        return 0
+    return int(chunk or 0)
 
 
 def get_attention(name: str) -> AttnFn:
@@ -331,7 +371,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: GPT2Config
             ) -> jax.Array:
     """Next-token cross entropy on {'input','target'} batches (targets are
     the shifted stream, produced by data/loader.py)."""
-    if cfg.lm_head_chunk:
+    if resolve_lm_head_chunk(cfg, int(batch["target"].size)):
         loss, _, _ = loss_with_monitor(params, batch, cfg)
         return loss
     logits = forward(params, batch["input"], cfg)
@@ -353,11 +393,12 @@ def head_loss_and_signature(params: Params, x: jax.Array,
     normed = L.layernorm(params["ln_f"], x)
     mean_normed = jnp.mean(normed, axis=tuple(range(normed.ndim - 1)))
     mean_logits = project_logits(params, mean_normed, cfg)
-    if cfg.lm_head_chunk:
+    chunk = resolve_lm_head_chunk(cfg, int(targets.size))
+    if chunk:
         from trustworthy_dl_tpu.ops.fused_ce import fused_lm_loss
 
         loss = fused_lm_loss(normed, params["wte"], targets,
-                             cfg.lm_head_chunk, cfg.dtype)
+                             chunk, cfg.dtype)
     else:
         logits = project_logits(params, normed, cfg)
         loss = L.cross_entropy_loss(logits, targets)
