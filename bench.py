@@ -212,9 +212,18 @@ def bench_longctx() -> None:
 
 def bench_generate() -> None:
     """Optional decode benchmark (TDDL_BENCH_GEN=1): KV-cache generation
-    throughput on the full GPT-2, batch x new-token grid.  Diagnostics
-    only — stderr."""
+    steady-state cost on the full GPT-2.  Diagnostics only — stderr.
+
+    Measurement notes (hard-won): on the axon remote-TPU tunnel,
+    ``block_until_ready`` does NOT wait for remote execution — only host
+    materialisation (np.asarray) does, so every call round-trips the
+    result.  The per-call RPC constant (~130-160 ms, NOT a property of
+    the decode program) is removed by differencing two generation
+    lengths: slope = (t(N2) - t(N1)) / (N2 - N1) is the steady-state
+    per-token cost.  Calls chain (output tail feeds the next prompt) so
+    nothing can be served from a cache."""
     import jax
+    import numpy as np
 
     from trustworthy_dl_tpu.models import gpt2
     from trustworthy_dl_tpu.models.generate import generate
@@ -223,28 +232,41 @@ def bench_generate() -> None:
         os.environ.get("TDDL_BENCH_GEN_MODEL", "gpt2")
     )
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
-    prompt_len, new = 32, int(os.environ.get("TDDL_BENCH_GEN_NEW", "128"))
-    reps = 4
-    for batch in (1, 8, 32):
+    prompt_len = 32
+    n1, n2 = 16, int(os.environ.get("TDDL_BENCH_GEN_NEW", "128"))
+    if n2 <= n1:
+        # TDDL_BENCH_GEN_NEW is the slope's LONG length; keep the
+        # difference positive for small values instead of dividing by <=0.
+        n1 = max(1, n2 // 2)
+    reps = int(os.environ.get("TDDL_BENCH_GEN_REPS", "12"))
+
+    def median_call(batch, new, **kw):
         prompt = jax.random.randint(jax.random.PRNGKey(1),
                                     (batch, prompt_len), 0, cfg.vocab_size)
-        out = generate(params, cfg, prompt, new, temperature=0.8, top_k=40)
-        out.block_until_ready()  # compile
-        # Chain: each call's prompt is the previous call's tail, so the
-        # remote tunnel cannot serve cached/overlapped executions (the
-        # same trick bench_longctx uses — unchained timings here once
-        # read 1000x too fast).
         cur = prompt
-        t0 = time.perf_counter()
+        full = generate(params, cfg, cur, new, **kw)
+        np.asarray(full)  # compile + first execution
+        cur = full[:, -prompt_len:]
+        ts = []
         for i in range(reps):
-            full = generate(params, cfg, cur, new, temperature=0.8,
-                            top_k=40, rng=jax.random.PRNGKey(i))
+            t0 = time.perf_counter()
+            full = generate(params, cfg, cur, new,
+                            rng=jax.random.PRNGKey(i), **kw)
+            np.asarray(full)  # host materialisation = real execution
+            ts.append(time.perf_counter() - t0)
             cur = full[:, -prompt_len:]
-        cur.block_until_ready()
-        dt = (time.perf_counter() - t0) / reps
-        log(f"generate b={batch:3d}: {new} new tokens in {dt * 1e3:7.1f} ms "
-            f"({batch * new / dt:,.0f} tok/s, "
-            f"{dt / new * 1e3:.2f} ms/token)")
+        return float(np.median(ts))
+
+    for batch in (1, 32):
+        for name, kw in (("greedy", {}),
+                         ("top_k=40", dict(temperature=0.8, top_k=40))):
+            t1 = median_call(batch, n1, **kw)
+            t2 = median_call(batch, n2, **kw)
+            slope = (t2 - t1) / (n2 - n1)
+            log(f"generate b={batch:3d} {name:9s}: "
+                f"{slope * 1e3:6.3f} ms/token steady-state "
+                f"({batch / slope:,.0f} tok/s; RPC+prefill constant "
+                f"{(t1 - n1 * slope) * 1e3:.0f} ms/call excluded)")
 
 
 def main() -> None:
