@@ -167,6 +167,77 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
     return steps / elapsed, n_params
 
 
+def bench_overhead_interleaved(model: str, num_nodes: int,
+                               per_node_batch: int, seq_len: int,
+                               block_steps: int, rounds: int,
+                               warmup: int) -> "tuple[float, float, int]":
+    """(steps/sec detection-ON, ON/OFF ratio, param count), measured as
+    INTERLEAVED paired blocks: both step functions are compiled up front,
+    then each round times one OFF block and one ON block back-to-back and
+    the ratio is the median of per-round ratios.
+
+    Rationale: the remote-TPU tunnel's throughput drifts by ±15 % across
+    multi-second windows, so the sequential all-OFF-then-all-ON design
+    reads anything from −1 % to +26 % overhead for short-step (vision)
+    configs.  Pairing blocks a few hundred ms apart cancels the drift;
+    the remaining per-round scatter is reported to stderr."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    def build(detection: bool):
+        config = TrainingConfig(
+            model_name=model, dataset_name="openwebtext",
+            batch_size=num_nodes * per_node_batch, num_nodes=num_nodes,
+            optimizer="adamw", learning_rate=1e-4,
+            checkpoint_interval=10 ** 9,
+            attack_detection_enabled=detection,
+            gradient_verification_enabled=detection,
+            parallelism="data",
+            grad_accum_steps=int(os.environ.get("TDDL_BENCH_ACCUM", "1")),
+        )
+        overrides: dict = {}
+        if model.startswith("gpt"):
+            overrides["seq_len"] = seq_len
+        trainer = DistributedTrainer(config, model_overrides=overrides)
+        trainer.initialize()
+        batch = trainer._node_batch(jax.tree_util.tree_map(
+            np.asarray,
+            trainer.model.example_batch(num_nodes * per_node_batch,
+                                        jax.random.PRNGKey(0)),
+        ))
+        return trainer, trainer.state, batch
+
+    tr_on, st_on, b_on = build(True)
+    tr_off, st_off, b_off = build(False)
+    n_params = tr_on.model.num_params(st_on.params)
+
+    def block(trainer, state, batch, steps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = trainer._train_step(state, batch,
+                                           trainer.attack_plan)
+        loss = float(np.asarray(m.loss))  # host close: real execution
+        assert np.isfinite(loss)
+        return state, time.perf_counter() - t0
+
+    for _ in range(max(warmup, 1)):
+        st_on, _ = block(tr_on, st_on, b_on, 1)
+        st_off, _ = block(tr_off, st_off, b_off, 1)
+
+    ratios, on_rates = [], []
+    for r in range(rounds):
+        st_off, t_off = block(tr_off, st_off, b_off, block_steps)
+        st_on, t_on = block(tr_on, st_on, b_on, block_steps)
+        ratios.append(t_off / t_on)
+        on_rates.append(block_steps / t_on)
+        log(f"  round {r}: OFF {block_steps / t_off:7.2f} ON "
+            f"{block_steps / t_on:7.2f} steps/s (ratio {t_off / t_on:.4f})")
+    return (float(np.median(on_rates)), float(np.median(ratios)), n_params)
+
+
 def bench_longctx() -> None:
     """Optional long-context A/B (TDDL_BENCH_LONGCTX=1): flash-kernel vs
     XLA full attention, fwd+bwd, at sequence lengths where the [T, T]
@@ -296,28 +367,49 @@ def main() -> None:
     tokens_per_step = num_nodes * per_node_batch * (seq_len if is_lm else 1)
     unit = "tokens/sec/chip" if is_lm else "samples/sec/chip"
 
-    sps_off, n_params = bench_mode(False, model, num_nodes, per_node_batch,
-                                   seq_len, steps, warmup)
-    log(f"detection OFF: {sps_off:.3f} steps/s "
-        f"({sps_off * tokens_per_step / n_chips:,.0f} {unit})")
-    sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch, seq_len,
-                           steps, warmup)
-    log(f"detection ON:  {sps_on:.3f} steps/s "
-        f"({sps_on * tokens_per_step / n_chips:,.0f} {unit})")
-    if not 0.3 <= sps_on / sps_off <= 1.2:
-        # Implausible ratio — seen once on the remote-TPU tunnel where a
-        # timed loop returned ~1000x too fast (execution caching artifact).
-        # Detection adds bounded work, so ON/OFF far outside [0.3, 1.2]
-        # means a bogus measurement: redo both once and trust the rerun.
-        log(f"implausible ON/OFF ratio {sps_on / sps_off:.3f}; remeasuring")
-        sps_off, _ = bench_mode(False, model, num_nodes, per_node_batch,
-                                seq_len, steps, warmup)
+    # Vision steps are ~20 ms — far below the remote tunnel's multi-second
+    # throughput drift, so the sequential all-OFF-then-all-ON comparison
+    # reads garbage there; interleaved paired blocks cancel the drift.
+    # LM steps are 100s of ms and the sequential design is stable (and
+    # keeps the single-trainer memory footprint for big models).
+    interleave_env = os.environ.get("TDDL_BENCH_INTERLEAVE")
+    interleave = (interleave_env == "1") if interleave_env else not is_lm
+    if interleave:
+        # Blocks must dwarf the ~140 ms host-close RPC constant (vision
+        # steps are ~20 ms, so >=50 steps/block ≈ >=1 s).
+        block_steps = max(50, steps)
+        sps_on, ratio, n_params = bench_overhead_interleaved(
+            model, num_nodes, per_node_batch, seq_len, block_steps,
+            rounds=int(os.environ.get("TDDL_BENCH_ROUNDS", "7")),
+            warmup=warmup,
+        )
+        log(f"interleaved: detection ON {sps_on:.3f} steps/s, "
+            f"median ON/OFF ratio {ratio:.4f}")
+    else:
+        sps_off, n_params = bench_mode(False, model, num_nodes,
+                                       per_node_batch, seq_len, steps,
+                                       warmup)
+        log(f"detection OFF: {sps_off:.3f} steps/s "
+            f"({sps_off * tokens_per_step / n_chips:,.0f} {unit})")
         sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch,
                                seq_len, steps, warmup)
-        log(f"remeasured OFF {sps_off:.3f} / ON {sps_on:.3f} steps/s")
+        log(f"detection ON:  {sps_on:.3f} steps/s "
+            f"({sps_on * tokens_per_step / n_chips:,.0f} {unit})")
+        if not 0.3 <= sps_on / sps_off <= 1.2:
+            # Implausible ratio — seen on the remote-TPU tunnel (execution
+            # caching artifact).  Detection adds bounded work, so ON/OFF
+            # far outside [0.3, 1.2] means a bogus measurement: redo both
+            # once and trust the rerun.
+            log(f"implausible ON/OFF ratio {sps_on / sps_off:.3f}; "
+                "remeasuring")
+            sps_off, _ = bench_mode(False, model, num_nodes,
+                                    per_node_batch, seq_len, steps, warmup)
+            sps_on, _ = bench_mode(True, model, num_nodes, per_node_batch,
+                                   seq_len, steps, warmup)
+            log(f"remeasured OFF {sps_off:.3f} / ON {sps_on:.3f} steps/s")
+        ratio = sps_on / sps_off
 
     tps_on = sps_on * tokens_per_step / n_chips
-    ratio = sps_on / sps_off
     overhead_pct = (1.0 - ratio) * 100.0
     log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
     tflops = None
