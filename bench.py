@@ -242,40 +242,56 @@ def bench_longctx() -> None:
     """Optional long-context A/B (TDDL_BENCH_LONGCTX=1): flash-kernel vs
     XLA full attention, fwd+bwd, at sequence lengths where the [T, T]
     score matrix starts to dominate HBM.  Iterations chain (q feeds back)
-    inside one jitted fori_loop so remote-execution caching or dispatch
-    overhead cannot fake the timing.  Diagnostics only — stderr."""
+    inside one jitted fori_loop, the close is a HOST MATERIALISATION
+    (``block_until_ready`` does not wait on the remote tunnel — measured
+    r4), and the per-call RPC constant is removed with a two-iteration-
+    count slope.  Diagnostics only — stderr."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from trustworthy_dl_tpu.models.gpt2 import full_attention
     from trustworthy_dl_tpu.ops.flash_attention import flash_attention
 
     b, h, d = 1, 12, 64
-    iters = int(os.environ.get("TDDL_BENCH_LONGCTX_ITERS", "10"))
+    i1 = int(os.environ.get("TDDL_BENCH_LONGCTX_ITERS", "4"))
+    i2 = 4 * i1
     for t in (4096, 8192, 16384):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
                    for kk in ks)
 
-        def run(attn, q):
+        def make(attn, iters):
             def loss(q):
                 return jnp.sum(attn(q, k, v, True).astype(jnp.float32) ** 2)
 
             def body(_, q):
                 return q + 1e-3 * jax.grad(loss)(q)
 
-            return jax.lax.fori_loop(0, iters, body, q)
+            @jax.jit
+            def run(q):
+                out = jax.lax.fori_loop(0, iters, body, q)
+                return jnp.sum(out.astype(jnp.float32))
+
+            return run
 
         for name, attn in (("flash", flash_attention),
                            ("full", full_attention)):
             try:
-                fn = jax.jit(lambda q, _attn=attn: run(_attn, q))
-                fn(q).block_until_ready()  # compile
-                t0 = time.perf_counter()
-                fn(q).block_until_ready()
-                ms = (time.perf_counter() - t0) / iters * 1e3
+                f1, f2 = make(attn, i1), make(attn, i2)
+                np.asarray(f1(q)); np.asarray(f2(q))  # compile + settle
+
+                def timed(fn):
+                    t0 = time.perf_counter()
+                    np.asarray(fn(q))  # host close: real execution
+                    return time.perf_counter() - t0
+
+                t_1 = min(timed(f1) for _ in range(3))
+                t_2 = min(timed(f2) for _ in range(3))
+                ms = (t_2 - t_1) / (i2 - i1) * 1e3
                 log(f"longctx T={t:5d} {name:5s} fwd+bwd "
-                    f"{ms:8.2f} ms/iter ({b * t / ms * 1e3:,.0f} tok/s)")
+                    f"{ms:8.2f} ms/iter ({b * t / ms * 1e3:,.0f} tok/s; "
+                    f"slope over {i2}-{i1} iters)")
             except Exception as exc:  # OOM on the full path is the point
                 log(f"longctx T={t:5d} {name:5s} failed: "
                     f"{type(exc).__name__}: {str(exc)[:120]}")
