@@ -4,7 +4,8 @@ The reference's recovery/reassignment ladder is mode-blind
 (trust_manager.py:198-206; distributed_trainer.py:324-352 never asks which
 parallelism strategy is active).  Round 3 gated elastic eviction/readmission
 to data parallelism; here the same trust-driven topology changes run in
-'tensor' and 'sequence' modes — the node axis is the data axis with a
+'tensor', 'sequence' and 'expert' modes — every single-axis
+non-pipeline mode; the node axis is the data axis with a
 device GROUP per node (core/mesh.py), so evicting node k drops its whole
 group — and 'model' mode gets the return path: a cooled-off evicted stage
 identity re-enters the restaff candidate pool and the stage count grows
@@ -30,16 +31,19 @@ TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
             seq_len=16)
 
 
-def make_trainer(tmp_path, parallelism, num_nodes=4, **kw):
+def make_trainer(tmp_path, parallelism, num_nodes=4, model_name="gpt2",
+                 model_overrides=None, **kw):
     kw.setdefault("detector_warmup", 4)
     config = TrainingConfig(
-        model_name="gpt2", dataset_name="openwebtext",
+        model_name=model_name, dataset_name="openwebtext",
         batch_size=2 * num_nodes, num_nodes=num_nodes,
         parallelism=parallelism, learning_rate=3e-3,
         checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
         elastic_resharding=True, **kw,
     )
-    return DistributedTrainer(config, model_overrides=dict(TINY))
+    return DistributedTrainer(
+        config, model_overrides=dict(TINY, **(model_overrides or {}))
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -104,14 +108,21 @@ def test_tp_opt_sharding_follows_params(eight_devices):
 
 # ---------------------------------------------------------------------------
 # Integration tier: transient attack -> group eviction -> readmission,
-# in tensor and sequence modes (mirror of test_recovery.py's DP tests)
+# in every group mode (mirror of test_recovery.py's DP tests).  Expert
+# mode runs the MoE model (the 'expert' axis carries its dispatch).
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("parallelism", ["tensor", "sequence"])
+@pytest.mark.parametrize("parallelism", ["tensor", "sequence", "expert"])
 def test_group_eviction_and_readmission(tmp_path, parallelism,
                                         eight_devices):
-    trainer = make_trainer(tmp_path / parallelism, parallelism,
-                           num_nodes=4, readmit_after_steps=8)
+    moe = parallelism == "expert"
+    trainer = make_trainer(
+        tmp_path / parallelism, parallelism, num_nodes=4,
+        readmit_after_steps=8,
+        model_name="gpt2-moe" if moe else "gpt2",
+        model_overrides=dict(n_experts=4, dtype=jnp.float32) if moe
+        else None,
+    )
     assert trainer.mesh.devices.shape == (4, 2)
     dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
                         vocab_size=128, num_examples=64)

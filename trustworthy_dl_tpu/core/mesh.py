@@ -169,6 +169,24 @@ def build_mesh(
     return Mesh(arr, (DATA_AXIS, axis))
 
 
+def bind_mode_mesh(mesh: Mesh, parallelism: str) -> None:
+    """Bind the global collectives mesh for the modes whose forwards read
+    one (sequence ring/Ulysses, MoE expert dispatch); no-op otherwise.
+
+    The ONE binding ladder — shared by trainer construction, elastic mesh
+    rebuilds (eviction/readmission) and checkpoint topology adoption, so
+    a new rebuild site (or a new mode) cannot silently miss a binding.
+    Imports are lazy to keep core/ free of parallel/models dependencies."""
+    if parallelism == "sequence":
+        from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
+
+        set_sequence_mesh(mesh)
+    elif parallelism == "expert":
+        from trustworthy_dl_tpu.models.moe import set_expert_mesh
+
+        set_expert_mesh(mesh)
+
+
 def node_sharding(mesh: Mesh, axis: str) -> NamedSharding:
     """Sharding for a per-node leading-axis array (e.g. [num_nodes, ...])."""
     return NamedSharding(mesh, P(axis))

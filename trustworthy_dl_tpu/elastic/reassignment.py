@@ -161,11 +161,11 @@ def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
 
 # Parallelism modes with mode-agnostic elastic eviction/readmission: the
 # node axis is the data axis (one device — or one device GROUP for
-# tensor/sequence — per node; core/mesh.py build_mesh), so removing a node
-# coordinate removes its whole group.  Pipeline ("model") reshapes instead
-# (elastic/restaff.py); the reference's contract is mode-blind
-# (trust_manager.py:198-206, distributed_trainer.py:324-352).
-ELASTIC_MODES = ("data", "tensor", "sequence")
+# tensor/sequence/expert — per node; core/mesh.py build_mesh), so
+# removing a node coordinate removes its whole group.  Pipeline ("model")
+# reshapes instead (elastic/restaff.py); the reference's contract is
+# mode-blind (trust_manager.py:198-206, distributed_trainer.py:324-352).
+ELASTIC_MODES = ("data", "tensor", "sequence", "expert")
 
 
 def node_device_group(mesh: jax.sharding.Mesh, num_nodes: int,
@@ -227,10 +227,9 @@ def _reapply_mode_shardings(state: TrainState, mesh: jax.sharding.Mesh,
             opt,
         )
         return state._replace(params=params, opt_state=opt)
-    if parallelism == "sequence":
-        from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
+    from trustworthy_dl_tpu.core.mesh import bind_mode_mesh
 
-        set_sequence_mesh(mesh)
+    bind_mode_mesh(mesh, parallelism)
     return state
 
 

@@ -28,7 +28,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, null_plan
 from trustworthy_dl_tpu.core.config import NodeConfig, TrainingConfig
-from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, build_mesh
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, \
+    bind_mode_mesh, build_mesh
 from trustworthy_dl_tpu.data.loader import PrefetchLoader
 from trustworthy_dl_tpu.detect.detector import AttackDetector, AttackType
 from trustworthy_dl_tpu.detect.stats import (
@@ -174,20 +175,14 @@ class DistributedTrainer:
             config.num_nodes, config.parallelism, config.mesh_shape,
             dcn_mesh_shape=config.dcn_mesh_shape,
         )
-        if config.parallelism == "sequence":
-            from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
-
-            set_sequence_mesh(self.mesh)
-        if config.parallelism == "expert":
-            from trustworthy_dl_tpu.models.moe import set_expert_mesh
-
-            set_expert_mesh(self.mesh)
-            if "-moe" not in self.config.model_name:
-                logger.warning(
-                    "parallelism='expert' with non-MoE model %r: the "
-                    "'expert' mesh axis will carry no sharded computation",
-                    self.config.model_name,
-                )
+        bind_mode_mesh(self.mesh, config.parallelism)
+        if config.parallelism == "expert" and \
+                "-moe" not in self.config.model_name:
+            logger.warning(
+                "parallelism='expert' with non-MoE model %r: the "
+                "'expert' mesh axis will carry no sharded computation",
+                self.config.model_name,
+            )
         if config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
@@ -1026,12 +1021,7 @@ class DistributedTrainer:
                 devices = devs
         self.mesh = build_mesh(n, self.config.parallelism,
                                self.config.mesh_shape, devices=devices)
-        if self.config.parallelism == "sequence":
-            from trustworthy_dl_tpu.parallel.sequence import (
-                set_sequence_mesh,
-            )
-
-            set_sequence_mesh(self.mesh)
+        bind_mode_mesh(self.mesh, self.config.parallelism)
         if self.config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
