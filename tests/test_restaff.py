@@ -232,6 +232,12 @@ def test_checkpoint_resume_after_restaff(restaffed_run):
 
     assert fresh.config.num_nodes == 4
     assert fresh.node_map == trainer.node_map
+    # ADVICE r3: parked idle-pool identities survive the resume (their
+    # devices re-resolve by id), so a future restaff can still seat them.
+    assert set(fresh._idle_pool) == set(trainer._idle_pool)
+    for nid, devs in trainer._idle_pool.items():
+        assert [d.id for d in fresh._idle_pool[nid]] == \
+            [d.id for d in devs]
     lead = jax.tree_util.tree_leaves(fresh.state.params["blocks"])[0]
     assert lead.shape[:2] == (4, 2)
     np.testing.assert_allclose(
