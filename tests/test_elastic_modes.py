@@ -4,7 +4,7 @@ The reference's recovery/reassignment ladder is mode-blind
 (trust_manager.py:198-206; distributed_trainer.py:324-352 never asks which
 parallelism strategy is active).  Round 3 gated elastic eviction/readmission
 to data parallelism; here the same trust-driven topology changes run in
-'tensor', 'sequence' and 'expert' modes — every single-axis
+'tensor', 'sequence', 'expert' and 'hybrid' modes — every
 non-pipeline mode; the node axis is the data axis with a
 device GROUP per node (core/mesh.py), so evicting node k drops its whole
 group — and 'model' mode gets the return path: a cooled-off evicted stage
@@ -77,6 +77,37 @@ def test_node_device_group_and_survivors(eight_devices):
     assert len(surviving_devices(small, 4, [1])) == 2
 
 
+def test_elastic_supported_predicate():
+    """The trainer's elastic gates use elastic_supported, so an
+    INELIGIBLE hybrid layout (multi-slice, stage axis, or a data extent
+    that does not carry the trust nodes) falls back to the legacy
+    gating/reassignment mitigation instead of crashing the loop with
+    NotImplementedError on its first confirmed incident."""
+    from trustworthy_dl_tpu.elastic.reassignment import elastic_supported
+
+    ok = TrainingConfig(model_name="gpt2", num_nodes=4,
+                        parallelism="hybrid",
+                        mesh_shape={"data": 4, "model": 2})
+    assert elastic_supported(ok)
+    for bad in (
+        dict(mesh_shape={"data": 2, "model": 2}),          # nodes != data
+        dict(mesh_shape={"data": 4, "stage": 2}),          # stage axis
+        dict(mesh_shape={"data": 4, "model": 2},
+             dcn_mesh_shape={"data": 2}),                  # multi-slice
+    ):
+        cfg = TrainingConfig(model_name="gpt2", num_nodes=4,
+                             parallelism="hybrid", **bad)
+        assert not elastic_supported(cfg), bad
+    for mode in ("data", "tensor", "sequence", "expert"):
+        assert elastic_supported(
+            TrainingConfig(model_name="gpt2", num_nodes=4,
+                           parallelism=mode)
+        )
+    assert not elastic_supported(
+        TrainingConfig(model_name="gpt2", num_nodes=4, parallelism="model")
+    )
+
+
 def test_tp_opt_sharding_follows_params(eight_devices):
     """apply_tp_sharding_to_opt finds the params-structured moment mirrors
     inside the optax state and re-lays them with the TP specs; scalar
@@ -112,16 +143,22 @@ def test_tp_opt_sharding_follows_params(eight_devices):
 # mode runs the MoE model (the 'expert' axis carries its dispatch).
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("parallelism", ["tensor", "sequence", "expert"])
+@pytest.mark.parametrize("parallelism",
+                         ["tensor", "sequence", "expert", "hybrid"])
 def test_group_eviction_and_readmission(tmp_path, parallelism,
                                         eight_devices):
     moe = parallelism == "expert"
+    extra = {}
+    if parallelism == "hybrid":
+        # Hybrid spelling of the tensor layout: explicit (4 data, 2 TP).
+        extra["mesh_shape"] = {"data": 4, "model": 2}
     trainer = make_trainer(
         tmp_path / parallelism, parallelism, num_nodes=4,
         readmit_after_steps=8,
         model_name="gpt2-moe" if moe else "gpt2",
         model_overrides=dict(n_experts=4, dtype=jnp.float32) if moe
         else None,
+        **extra,
     )
     assert trainer.mesh.devices.shape == (4, 2)
     dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
@@ -145,11 +182,13 @@ def test_group_eviction_and_readmission(tmp_path, parallelism,
     assert len(trainer._evicted_devices[1]) == 2
     assert trainer.node_map == [0, 2, 3]
     assert trainer.state.trust.scores.shape == (3,)
-    if parallelism == "tensor":
+    if parallelism in ("tensor", "hybrid"):
         # TP layout survives the rebuild: qkv still column-sharded 2-way.
         qkv = trainer.state.params["blocks"]["attn"]["qkv"]["w"]
         assert qkv.addressable_shards[0].data.shape[-1] == \
             qkv.shape[-1] // 2
+    if parallelism == "hybrid":
+        assert trainer.config.mesh_shape == {"data": 3, "model": 2}
 
     # Attack over; cool-off elapses -> the group is readmitted.
     trainer.set_attack_plan(null_plan(3))

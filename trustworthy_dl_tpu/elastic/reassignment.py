@@ -161,11 +161,52 @@ def compact_train_state(state: TrainState, keep: Sequence[int]) -> TrainState:
 
 # Parallelism modes with mode-agnostic elastic eviction/readmission: the
 # node axis is the data axis (one device — or one device GROUP for
-# tensor/sequence/expert — per node; core/mesh.py build_mesh), so
+# tensor/sequence/expert/hybrid — per node; core/mesh.py build_mesh), so
 # removing a node coordinate removes its whole group.  Pipeline ("model")
 # reshapes instead (elastic/restaff.py); the reference's contract is
 # mode-blind (trust_manager.py:198-206, distributed_trainer.py:324-352).
-ELASTIC_MODES = ("data", "tensor", "sequence", "expert")
+# Hybrid qualifies when its data axis carries the trust nodes within one
+# slice (see _check_hybrid_elastic).
+ELASTIC_MODES = ("data", "tensor", "sequence", "expert", "hybrid")
+
+
+def _check_hybrid_elastic(config) -> None:
+    """Hybrid elasticity preconditions: the mesh_shape's data extent IS
+    the node count (group modes' invariant), within a single slice, and
+    no stage axis (stage repartition is restaff's job)."""
+    ms = config.mesh_shape or {}
+    if (config.dcn_mesh_shape or ms.get("stage", 1) > 1
+            or ms.get("data", 1) != config.num_nodes):
+        raise NotImplementedError(
+            "hybrid elasticity requires mesh_shape['data'] == num_nodes "
+            "within one slice (no dcn_mesh_shape, no stage axis); got "
+            f"mesh_shape={ms}, dcn={config.dcn_mesh_shape}"
+        )
+
+
+def elastic_supported(config) -> bool:
+    """Can evict_and_reshard handle this config?  The trainer's gates use
+    THIS (not bare ELASTIC_MODES membership) so an ineligible hybrid
+    layout (multi-slice, stage axis, data extent != node count) falls
+    back to the in-step gating + legacy reassignment mitigation instead
+    of crashing the training loop on its first confirmed incident."""
+    if config.parallelism not in ELASTIC_MODES:
+        return False
+    if config.parallelism == "hybrid":
+        try:
+            _check_hybrid_elastic(config)
+        except NotImplementedError:
+            return False
+    return True
+
+
+def elastic_mesh_shape(config, n: int):
+    """mesh_shape for a rebuilt mesh whose data axis now carries ``n``
+    nodes (hybrid keeps its other extents; single-axis modes pass their
+    shape through untouched — build_mesh derives groups itself)."""
+    if config.parallelism != "hybrid":
+        return config.mesh_shape
+    return {**(config.mesh_shape or {}), "data": n}
 
 
 def node_device_group(mesh: jax.sharding.Mesh, num_nodes: int,
@@ -200,13 +241,26 @@ def surviving_devices(mesh: jax.sharding.Mesh, num_nodes: int,
     return list(devices.flat)
 
 
+def _tp_placement_owns_params(parallelism: str,
+                              mesh: jax.sharding.Mesh) -> bool:
+    """True when _reapply_mode_shardings will place the params/opt
+    subtrees itself (TP layout covers EVERY param leaf — unspecified
+    leaves get P() replication), so migrate_state can skip its redundant
+    replicate-first pass."""
+    from trustworthy_dl_tpu.core.mesh import MODEL_AXIS
+
+    return parallelism == "tensor" or (
+        parallelism == "hybrid" and MODEL_AXIS in mesh.axis_names
+    )
+
+
 def _reapply_mode_shardings(state: TrainState, mesh: jax.sharding.Mesh,
                             parallelism: str) -> TrainState:
-    """Mode-specific placement after a mesh rebuild: tensor mode re-lays
-    the TP parameter/optimizer shardings on the new mesh; sequence mode
-    re-binds the ring/Ulysses collectives' mesh.  Data mode needs
-    nothing — migrate_state already placed everything."""
-    if parallelism == "tensor":
+    """Mode-specific placement after a mesh rebuild: tensor (and hybrid
+    with a 'model' axis) re-lays the TP parameter/optimizer shardings on
+    the new mesh; sequence/expert re-bind their global collectives mesh.
+    Data mode needs nothing — migrate_state already placed everything."""
+    if _tp_placement_owns_params(parallelism, mesh):
         from trustworthy_dl_tpu.parallel.tensor_parallel import (
             apply_tp_sharding,
             apply_tp_sharding_to_opt,
@@ -246,6 +300,8 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
             f"elastic resharding supports {ELASTIC_MODES}; a compromised "
             "pipeline stage restaffs instead (elastic/restaff.py)"
         )
+    if config.parallelism == "hybrid":
+        _check_hybrid_elastic(config)
     n = config.num_nodes
     drop = sorted(set(int(d) for d in drop))
     keep = [i for i in range(n) if i not in drop]
@@ -262,23 +318,26 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
             trainer.mesh, n, i
         )
     new_devices = surviving_devices(trainer.mesh, n, drop)
-    new_mesh = build_mesh(len(keep), config.parallelism,
+    new_shape = elastic_mesh_shape(config, len(keep))
+    new_mesh = build_mesh(len(keep), config.parallelism, new_shape,
                           devices=new_devices)
-    new_config = dataclasses.replace(config, num_nodes=len(keep))
+    new_config = dataclasses.replace(config, num_nodes=len(keep),
+                                     mesh_shape=new_shape)
 
     compact = compact_train_state(trainer.state, keep)
 
     # Migrate onto the new mesh: per-node arrays shard over the surviving
-    # data axis; everything else replicates (then tensor mode re-lays its
-    # TP shardings).  This is the device_put migration the reference's
-    # no-op claimed to do.
+    # data axis; everything else replicates (then the TP modes re-lay
+    # their param/opt shardings).  This is the device_put migration the
+    # reference's no-op claimed to do.
     data_size = dict(zip(new_mesh.axis_names,
                          new_mesh.devices.shape)).get(DATA_AXIS, 1)
     new_state = migrate_state(
         compact, new_mesh, DATA_AXIS, len(keep),
         shard_opt=config.shard_opt_state and data_size > 1
         and config.parallelism == "data",
-        place_params=config.parallelism != "tensor",
+        place_params=not _tp_placement_owns_params(config.parallelism,
+                                                   new_mesh),
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)
@@ -408,6 +467,8 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
             f"elastic readmission follows eviction: {ELASTIC_MODES} only "
             "(model-parallel stages re-enter via the restaff idle pool)"
         )
+    if config.parallelism == "hybrid":
+        _check_hybrid_elastic(config)
     node_ids = [int(i) for i in node_ids]
     unknown = [i for i in node_ids if i not in trainer._evicted_devices]
     if unknown:
@@ -421,8 +482,11 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         # The node's whole device group returns (its single chip in
         # 1-per-node data mode; empty in dev mode — no device ever left).
         devices.extend(trainer._evicted_devices.get(nid) or [])
-    new_mesh = build_mesh(n_new, config.parallelism, devices=devices)
-    new_config = dataclasses.replace(config, num_nodes=n_new)
+    new_shape = elastic_mesh_shape(config, n_new)
+    new_mesh = build_mesh(n_new, config.parallelism, new_shape,
+                          devices=devices)
+    new_config = dataclasses.replace(config, num_nodes=n_new,
+                                     mesh_shape=new_shape)
 
     now = float(trainer.state.step) * config.time_per_step
     expanded = expand_train_state(
@@ -436,7 +500,8 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         expanded, new_mesh, DATA_AXIS, n_new,
         shard_opt=config.shard_opt_state and data_size > 1
         and config.parallelism == "data",
-        place_params=config.parallelism != "tensor",
+        place_params=not _tp_placement_owns_params(config.parallelism,
+                                                   new_mesh),
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)

@@ -613,10 +613,12 @@ class DistributedTrainer:
                     exclude=flagged_ids,
                 )
                 evict_coords.append(int(coord))
-        from trustworthy_dl_tpu.elastic.reassignment import ELASTIC_MODES
+        from trustworthy_dl_tpu.elastic.reassignment import (
+            elastic_supported,
+        )
 
         if (evict_coords and self.config.elastic_resharding
-                and self.config.parallelism in ELASTIC_MODES
+                and elastic_supported(self.config)
                 and len(evict_coords) < self.config.num_nodes):
             from trustworthy_dl_tpu.elastic.reassignment import (
                 evict_and_reshard,
@@ -665,11 +667,11 @@ class DistributedTrainer:
         if not due:
             return
         from trustworthy_dl_tpu.elastic.reassignment import (
-            ELASTIC_MODES,
+            elastic_supported,
             readmit_and_reshard,
         )
 
-        if cfg.parallelism in ELASTIC_MODES:
+        if elastic_supported(cfg):
             record = readmit_and_reshard(self, due)
             record["step"] = self.global_step
             self.reassignment_history.append(record)
@@ -775,10 +777,13 @@ class DistributedTrainer:
             }
         )
         self.trust_manager.mark_compromised(node_id, attack_type)
-        from trustworthy_dl_tpu.elastic.reassignment import ELASTIC_MODES
+        from trustworthy_dl_tpu.elastic.reassignment import (
+            elastic_supported,
+        )
 
         if not (self.config.elastic_resharding
-                and self.config.parallelism in ELASTIC_MODES + ("model",)):
+                and (elastic_supported(self.config)
+                     or self.config.parallelism == "model")):
             # Legacy greedy handoff (relabel) — elastic mode replaces it
             # with the real group eviction (ELASTIC_MODES) or stage
             # restaff (model) in _record_batch.
@@ -1007,7 +1012,21 @@ class DistributedTrainer:
             "Checkpoint topology has %d node(s) (config says %d): adopting "
             "the saved topology for resume", n, self.config.num_nodes,
         )
-        self.config = dataclasses.replace(self.config, num_nodes=n)
+        from trustworthy_dl_tpu.elastic.reassignment import (
+            _check_hybrid_elastic,
+            elastic_mesh_shape,
+        )
+
+        self.config = dataclasses.replace(
+            self.config, num_nodes=n,
+            mesh_shape=elastic_mesh_shape(self.config, n),
+        )
+        if self.config.parallelism == "hybrid":
+            # Only elastic-eligible hybrid layouts can have produced a
+            # different-topology checkpoint; a multi-slice/stage hybrid
+            # must fail loudly here rather than silently rebuild a
+            # single-slice mesh without its DCN extents.
+            _check_hybrid_elastic(self.config)
         # Rebuild the SAVED device set when the sidecar has it: post-
         # eviction the live mesh is missing a chip from the middle, and a
         # first-n guess would seat the evicted device twice once it is
@@ -1020,7 +1039,8 @@ class DistributedTrainer:
             if len(devs) == len(ids):
                 devices = devs
         self.mesh = build_mesh(n, self.config.parallelism,
-                               self.config.mesh_shape, devices=devices)
+                               self.config.mesh_shape, devices=devices,
+                               dcn_mesh_shape=self.config.dcn_mesh_shape)
         bind_mode_mesh(self.mesh, self.config.parallelism)
         if self.config.parallelism == "model":
             from trustworthy_dl_tpu.parallel.pipeline import (
