@@ -58,3 +58,18 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices[:8]
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mode_meshes():
+    """Trainers in 'sequence'/'expert' (and elastic rebuilds) bind global
+    collectives meshes that would otherwise leak across tests — a test
+    expecting the unbound state (ring fallback, dense-MLP equivalence)
+    fails depending on execution order.  Reset BEFORE each test; bindings
+    made within a test stay live for its own duration."""
+    from trustworthy_dl_tpu.models.moe import set_expert_mesh
+    from trustworthy_dl_tpu.parallel.sequence import set_sequence_mesh
+
+    set_sequence_mesh(None)
+    set_expert_mesh(None)
+    yield
