@@ -53,6 +53,9 @@ PRESETS = {
     # BASELINE config 2 shape: VGG-16 / CIFAR-10 (the conv-battery row).
     "vgg16": dict(model="vgg16", batch=64),
     "resnet50": dict(model="resnet50", batch=64),
+    # BASELINE config 5's model (the sweep itself is an experiments
+    # preset; this row gives its throughput baseline).
+    "resnet101": dict(model="resnet101", batch=32),
     # BASELINE config 4 shape: GPT-2 medium.
     "gpt2-medium": dict(model="gpt2-medium", batch=8),
     # Long-context row: GPT-2 medium at T=1024, auto attention.
