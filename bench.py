@@ -102,9 +102,11 @@ def bench_mode(detection: bool, model: str, num_nodes: int,
         raise
 
 
-def _bench_mode(detection: bool, model: str, num_nodes: int,
-                per_node_batch: int, seq_len: int, steps: int,
-                warmup: int) -> "tuple[float, int]":
+def _build_bench_trainer(detection: bool, model: str, num_nodes: int,
+                         per_node_batch: int, seq_len: int):
+    """(trainer, initial state, node batch) — ONE construction shared by
+    the sequential and interleaved measurement paths so their model
+    overrides (remat / attention / lm-head chunk) can never diverge."""
     import jax
     import numpy as np
 
@@ -145,18 +147,26 @@ def _bench_mode(detection: bool, model: str, num_nodes: int,
             )
     trainer = DistributedTrainer(config, model_overrides=overrides)
     trainer.initialize()
-    n_params = trainer.model.num_params(trainer.state.params)
-
-    import jax.random as jrandom
-
     batch = trainer._node_batch(jax.tree_util.tree_map(
         np.asarray,
         trainer.model.example_batch(num_nodes * per_node_batch,
-                                    jrandom.PRNGKey(0)),
+                                    jax.random.PRNGKey(0)),
     ))
+    return trainer, trainer.state, batch
+
+
+def _bench_mode(detection: bool, model: str, num_nodes: int,
+                per_node_batch: int, seq_len: int, steps: int,
+                warmup: int) -> "tuple[float, int]":
+    import jax
+    import numpy as np
+
+    trainer, state, batch = _build_bench_trainer(
+        detection, model, num_nodes, per_node_batch, seq_len
+    )
+    n_params = trainer.model.num_params(state.params)
     plan = trainer.attack_plan
 
-    state = trainer.state
     for _ in range(max(warmup, 1)):
         state, metrics = trainer._train_step(state, batch, plan)
     jax.block_until_ready(metrics.loss)
@@ -184,37 +194,14 @@ def bench_overhead_interleaved(model: str, num_nodes: int,
     reads anything from −1 % to +26 % overhead for short-step (vision)
     configs.  Pairing blocks a few hundred ms apart cancels the drift;
     the remaining per-round scatter is reported to stderr."""
-    import jax
     import numpy as np
 
-    from trustworthy_dl_tpu.core.config import TrainingConfig
-    from trustworthy_dl_tpu.engine import DistributedTrainer
-
-    def build(detection: bool):
-        config = TrainingConfig(
-            model_name=model, dataset_name="openwebtext",
-            batch_size=num_nodes * per_node_batch, num_nodes=num_nodes,
-            optimizer="adamw", learning_rate=1e-4,
-            checkpoint_interval=10 ** 9,
-            attack_detection_enabled=detection,
-            gradient_verification_enabled=detection,
-            parallelism="data",
-            grad_accum_steps=int(os.environ.get("TDDL_BENCH_ACCUM", "1")),
-        )
-        overrides: dict = {}
-        if model.startswith("gpt"):
-            overrides["seq_len"] = seq_len
-        trainer = DistributedTrainer(config, model_overrides=overrides)
-        trainer.initialize()
-        batch = trainer._node_batch(jax.tree_util.tree_map(
-            np.asarray,
-            trainer.model.example_batch(num_nodes * per_node_batch,
-                                        jax.random.PRNGKey(0)),
-        ))
-        return trainer, trainer.state, batch
-
-    tr_on, st_on, b_on = build(True)
-    tr_off, st_off, b_off = build(False)
+    tr_on, st_on, b_on = _build_bench_trainer(
+        True, model, num_nodes, per_node_batch, seq_len
+    )
+    tr_off, st_off, b_off = _build_bench_trainer(
+        False, model, num_nodes, per_node_batch, seq_len
+    )
     n_params = tr_on.model.num_params(st_on.params)
 
     def block(trainer, state, batch, steps):

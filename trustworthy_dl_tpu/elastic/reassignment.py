@@ -57,8 +57,7 @@ def row_placer(mesh: jax.sharding.Mesh, axis: str, n: int):
     stage restaff: a leaf whose leading axis is the node count shards over
     ``axis`` (when the mesh carries it evenly), everything else
     replicates.  Returns (place_row, replicated_sharding)."""
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    axis_size = sizes.get(axis, 1)
+    axis_size = mesh.shape.get(axis, 1)
     repl = NamedSharding(mesh, P())
 
     def place_row(leaf):
@@ -330,8 +329,7 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     # data axis; everything else replicates (then the TP modes re-lay
     # their param/opt shardings).  This is the device_put migration the
     # reference's no-op claimed to do.
-    data_size = dict(zip(new_mesh.axis_names,
-                         new_mesh.devices.shape)).get(DATA_AXIS, 1)
+    data_size = new_mesh.shape.get(DATA_AXIS, 1)
     new_state = migrate_state(
         compact, new_mesh, DATA_AXIS, len(keep),
         shard_opt=config.shard_opt_state and data_size > 1
@@ -494,8 +492,7 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         decay_rate=config.trust_decay_rate,
     )
 
-    data_size = dict(zip(new_mesh.axis_names,
-                         new_mesh.devices.shape)).get(DATA_AXIS, 1)
+    data_size = new_mesh.shape.get(DATA_AXIS, 1)
     new_state = migrate_state(
         expanded, new_mesh, DATA_AXIS, n_new,
         shard_opt=config.shard_opt_state and data_size > 1

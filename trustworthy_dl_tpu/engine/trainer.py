@@ -613,39 +613,38 @@ class DistributedTrainer:
                     exclude=flagged_ids,
                 )
                 evict_coords.append(int(coord))
-        from trustworthy_dl_tpu.elastic.reassignment import (
-            elastic_supported,
-        )
-
         if (evict_coords and self.config.elastic_resharding
-                and elastic_supported(self.config)
                 and len(evict_coords) < self.config.num_nodes):
             from trustworthy_dl_tpu.elastic.reassignment import (
+                elastic_supported,
                 evict_and_reshard,
             )
 
-            record = evict_and_reshard(self, evict_coords)
-            record["step"] = self.global_step
-            self.reassignment_history.append(record)
-            for orig in record["evicted_nodes"]:
-                self._evicted_at[int(orig)] = self.global_step
-            self._resize_loader()
-        elif (evict_coords and self.config.elastic_resharding
-                and self.config.parallelism == "model"
-                and len(evict_coords) < self.config.num_nodes):
-            # Model-parallel restaff: the compromised stage's layer shard
-            # migrates to trusted hardware and the model repartitions —
-            # ALL layers keep training (elastic/restaff.py), not the
-            # freeze+relabel the reference ships.
-            from trustworthy_dl_tpu.elastic.restaff import restaff_pipeline
+            if elastic_supported(self.config):
+                record = evict_and_reshard(self, evict_coords)
+                record["step"] = self.global_step
+                self.reassignment_history.append(record)
+                for orig in record["evicted_nodes"]:
+                    self._evicted_at[int(orig)] = self.global_step
+                self._resize_loader()
+            elif self.config.parallelism == "model":
+                # Model-parallel restaff: the compromised stage's layer
+                # shard migrates to trusted hardware and the model
+                # repartitions — ALL layers keep training
+                # (elastic/restaff.py), not the freeze+relabel the
+                # reference ships.
+                from trustworthy_dl_tpu.elastic.restaff import (
+                    restaff_pipeline,
+                )
 
-            record = restaff_pipeline(self, evict_coords)
-            record["step"] = self.global_step
-            self.reassignment_history.append(record)
-            for orig in record["evicted_nodes"]:
-                # Start the cool-off clock: a cooled-off stage identity
-                # re-enters the restaff candidate pool (_maybe_readmit).
-                self._evicted_at[int(orig)] = self.global_step
+                record = restaff_pipeline(self, evict_coords)
+                record["step"] = self.global_step
+                self.reassignment_history.append(record)
+                for orig in record["evicted_nodes"]:
+                    # Start the cool-off clock: a cooled-off stage
+                    # identity re-enters the restaff candidate pool
+                    # (_maybe_readmit).
+                    self._evicted_at[int(orig)] = self.global_step
 
     def _maybe_readmit(self) -> None:
         """Re-admit evicted coordinates whose cool-off has elapsed
