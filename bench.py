@@ -118,13 +118,14 @@ def _build_bench_trainer(detection: bool, model: str, num_nodes: int,
         dataset_name="openwebtext",
         batch_size=num_nodes * per_node_batch,
         num_nodes=num_nodes,
-        optimizer="adamw",
+        optimizer=os.environ.get("TDDL_BENCH_OPT", "adamw"),
         learning_rate=1e-4,
         checkpoint_interval=10 ** 9,
         attack_detection_enabled=detection,
         gradient_verification_enabled=detection,
         parallelism="data",
         grad_accum_steps=int(os.environ.get("TDDL_BENCH_ACCUM", "1")),
+        moment_dtype=os.environ.get("TDDL_BENCH_MU_DTYPE") or None,
     )
     overrides: dict = {}
     if model.startswith("gpt"):

@@ -110,3 +110,72 @@ def test_validate_metrics_surface(tmp_path):
     assert np.isfinite(m["loss"]) and 0.0 <= m["accuracy"] <= 1.0
     assert np.isclose(m["perplexity"], np.exp(m["loss"]), rtol=1e-5)
     assert np.isclose(trainer.validate(dl), m["loss"], rtol=1e-6)
+
+
+def test_bf16_first_moment_storage():
+    """moment_dtype='bfloat16' stores Adam's mu (and SGD's momentum) in
+    bf16 — 4 bytes/param freed, the lever that fits GPT-2-large on one
+    16 GB chip — while nu stays f32 and the training trajectory stays
+    within bf16-rounding distance of the f32-moment run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine.optimizer import build_optimizer
+
+    params = {"w": jnp.ones((64, 64)), "b": jnp.zeros((64,))}
+    cfg16 = TrainingConfig(model_name="gpt2", optimizer="adamw",
+                           learning_rate=1e-3, moment_dtype="bfloat16")
+    cfg32 = TrainingConfig(model_name="gpt2", optimizer="adamw",
+                           learning_rate=1e-3)
+    opt16, opt32 = build_optimizer(cfg16), build_optimizer(cfg32)
+    s16, s32 = opt16.init(params), opt32.init(params)
+
+    adam16 = next(s for s in jax.tree_util.tree_leaves(
+        s16, is_leaf=lambda x: hasattr(x, "mu")) if hasattr(s, "mu"))
+    adam32 = next(s for s in jax.tree_util.tree_leaves(
+        s32, is_leaf=lambda x: hasattr(x, "mu")) if hasattr(s, "mu"))
+    assert adam16.mu["w"].dtype == jnp.bfloat16
+    assert adam16.nu["w"].dtype == jnp.float32   # second moment stays f32
+    assert adam32.mu["w"].dtype == jnp.float32
+
+    grads = jax.tree_util.tree_map(
+        lambda p: 0.01 * jnp.ones_like(p), params)
+    p16, p32 = params, params
+    for _ in range(5):
+        u16, s16 = opt16.update(grads, s16, p16)
+        p16 = jax.tree_util.tree_map(lambda p, u: p + u, p16, u16)
+        u32, s32 = opt32.update(grads, s32, p32)
+        p32 = jax.tree_util.tree_map(lambda p, u: p + u, p32, u32)
+    np.testing.assert_allclose(np.asarray(p16["w"]), np.asarray(p32["w"]),
+                               rtol=1e-2, atol=1e-4)
+
+
+def test_adafactor_option_trains():
+    """optimizer='adafactor' (factored second moment — the large-model
+    memory lever) plugs into the trusted step end-to-end."""
+    import numpy as np
+
+    from trustworthy_dl_tpu.attacks import null_plan
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=8,
+        num_nodes=4, optimizer="adafactor", learning_rate=1e-2,
+        checkpoint_interval=10 ** 9, checkpoint_dir="/tmp/af_ck",
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(
+        n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+        seq_len=16,
+    ))
+    trainer.initialize()
+    batch = trainer._node_batch(trainer.model.example_batch(8))
+    state = trainer.state
+    losses = []
+    for _ in range(6):
+        state, m = trainer._train_step(state, batch, null_plan(4))
+        losses.append(float(m.loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
