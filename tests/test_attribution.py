@@ -128,3 +128,36 @@ def test_gradient_poisoning_never_first_labelled_byzantine(tmp_path):
             break
     assert trainer.attack_history
     assert trainer.attack_history[0]["attack_type"] == "gradient_poisoning"
+
+
+def test_vision_data_poisoning_detected(tmp_path):
+    """Data poisoning on a VISION model (BASELINE config 2's family):
+    noised images + shifted labels are statistically invisible to the
+    batteries early on, but once the honest fleet starts fitting, the
+    poisoned shard's loss detaches (measured: z < 1 until the fleet's
+    loss bends at ~step 50, then z > 9 within a few steps) and the
+    loss-detachment check confirms.  Needs the longer horizon that
+    implies."""
+    config = TrainingConfig(
+        model_name="resnet32", dataset_name="cifar10", batch_size=32,
+        num_nodes=8, learning_rate=1e-2, checkpoint_interval=10 ** 9,
+        detector_warmup=4, checkpoint_dir=str(tmp_path / "vp"),
+    )
+    trainer = DistributedTrainer(config)
+    dl = get_dataloader("cifar10", batch_size=32, num_examples=128)
+    trainer.initialize()
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["data_poisoning"], target_nodes=[3], intensity=1.0,
+        start_step=8,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    for epoch in range(25):
+        trainer.train_epoch(dl, epoch)
+        if trainer.attack_history:
+            break
+    assert trainer.attack_history, "vision data poisoning never detected"
+    first = trainer.attack_history[0]
+    assert first["node_id"] == 3
+    assert first["attack_type"] in EXPECTED_FIRST["data_poisoning"], first
+    assert {r["node_id"] for r in trainer.attack_history} == {3}

@@ -310,3 +310,32 @@ def test_still_hostile_readmitted_group_re_evicted(tmp_path):
     assert len(evictions) >= 2, trainer.reassignment_history
     assert len(readmits) >= 1
     assert trainer.config.num_nodes == 3
+
+
+def test_tp_opt_sharding_skips_factored_adafactor_stats(eight_devices):
+    """Adafactor's factored statistics share the params STRUCTURE but not
+    the params shapes (v_row/v_col drop a dim; unfactored slots are
+    placeholders) — the TP re-placement must replicate those instead of
+    crashing on a rank-mismatched spec."""
+    import optax
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.parallel.tensor_parallel import (
+        apply_tp_sharding,
+        apply_tp_sharding_to_opt,
+    )
+
+    mesh = build_mesh(4, "tensor", devices=eight_devices)
+    cfg = gpt2.GPT2Config(dtype=jnp.float32, **{
+        k: v for k, v in TINY.items() if k != "seq_len"
+    })
+    params = apply_tp_sharding(
+        gpt2.init_params(jax.random.PRNGKey(0), cfg), mesh
+    )
+    opt_state = optax.adafactor(learning_rate=1e-3).init(params)
+    placed = apply_tp_sharding_to_opt(opt_state, params, mesh)  # no crash
+    # Every placed leaf lives on the new mesh.
+    for leaf in jax.tree_util.tree_leaves(placed):
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and hasattr(sh, "mesh"):
+            assert sh.mesh == mesh

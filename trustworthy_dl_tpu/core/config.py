@@ -132,8 +132,8 @@ class TrainingConfig:
     shard_opt_state: bool = False
     # Storage dtype for the optimizer's FIRST moment (optax mu_dtype;
     # SGD's momentum accumulator).  None keeps the parameter dtype (f32);
-    # "bfloat16" frees 4 bytes/param — what lets GPT-2-large (774M) train
-    # on a single 16 GB v5e.  The second moment stays f32.
+    # "bfloat16" frees 2 bytes/param.  The second moment stays f32; for
+    # the big second-moment saving use optimizer="adafactor".
     moment_dtype: Optional[str] = None
     checkpoint_dir: str = "checkpoints"
     # Async checkpointing: save() returns after the device→host snapshot;

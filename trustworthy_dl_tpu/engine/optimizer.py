@@ -56,11 +56,9 @@ def build_optimizer(config: TrainingConfig) -> optax.GradientTransformation:
     schedule = build_schedule(config)
     name = config.optimizer.lower()
     # Optional reduced-precision FIRST moment (optax mu_dtype): bf16 mu
-    # frees 4 bytes/param — with f32 params+nu+grads that is the
-    # difference between GPT-2-large fitting one 16 GB v5e or not.  The
-    # second moment stays f32 (nu's dynamic range drives the update
-    # scale; bf16 there measurably hurts, bf16 mu does not — standard
-    # large-model practice).
+    # frees 2 bytes/param.  The second moment stays f32 (nu's dynamic
+    # range drives the update scale; bf16 there measurably hurts, bf16
+    # mu does not — standard large-model practice).
     mu_dtype = None
     if config.moment_dtype:
         import jax.numpy as jnp
@@ -79,9 +77,16 @@ def build_optimizer(config: TrainingConfig) -> optax.GradientTransformation:
     elif name == "adafactor":
         # Factored second moment (row+column statistics instead of a full
         # per-parameter nu) — the standard large-model memory answer:
-        # optimizer state drops from 2x params to ~zero, which is what
-        # puts GPT-2-large within a single 16 GB chip's budget.
-        chain.append(optax.adafactor(learning_rate=schedule))
+        # optimizer state drops from 2x params to near zero.  Honours the
+        # same weight_decay and moment_dtype knobs as the other branches
+        # (adafactor's momentum is OFF by default; moment_dtype only
+        # applies if momentum is enabled via its own default behaviour).
+        af_kwargs: dict = {"learning_rate": schedule}
+        if config.weight_decay:
+            af_kwargs["weight_decay_rate"] = config.weight_decay
+        if mu_dtype is not None:
+            af_kwargs["dtype_momentum"] = mu_dtype
+        chain.append(optax.adafactor(**af_kwargs))
     else:
         raise ValueError(f"unknown optimizer {config.optimizer!r}")
     return optax.chain(*chain)

@@ -111,11 +111,17 @@ def apply_tp_sharding_to_opt(opt_state: Any, params: Params,
     after an elastic mesh rebuild (eviction/readmission in tensor mode)
     they must follow their weights back onto the TP shardings — structure
     matching (treedef equality with ``params``) finds them exactly, and
-    every other leaf (step counts, schedule state) is left as placed."""
+    every other leaf (step counts, schedule state) is left as placed.
+
+    Leaves that share the params STRUCTURE but not the params SHAPES
+    (adafactor's factored v_row/v_col statistics, its (1,)-placeholder
+    slots) replicate instead — a full-rank TP spec cannot apply to a
+    reduced-rank statistic."""
     if MODEL_AXIS not in mesh.axis_names:
         return opt_state
     specs = _spec_tree_for(params)
     pdef = jax.tree_util.tree_structure(params)
+    repl = NamedSharding(mesh, P())
 
     def params_like(node):
         try:
@@ -123,16 +129,17 @@ def apply_tp_sharding_to_opt(opt_state: Any, params: Params,
         except Exception:
             return False
 
+    def place(leaf, param, spec):
+        if getattr(leaf, "shape", None) == param.shape:
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(leaf, repl)
+
     leaves, treedef = jax.tree_util.tree_flatten(
         opt_state, is_leaf=params_like
     )
     placed = [
-        jax.tree_util.tree_map(
-            lambda leaf, spec: jax.device_put(
-                leaf, NamedSharding(mesh, spec)
-            ),
-            node, specs,
-        ) if params_like(node) else node
+        jax.tree_util.tree_map(place, node, params, specs)
+        if params_like(node) else node
         for node in leaves
     ]
     return jax.tree_util.tree_unflatten(treedef, placed)
