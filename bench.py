@@ -364,8 +364,54 @@ def main() -> None:
 
     import jax
 
-    n_chips = max(jax.device_count(), 1)
-    platform = jax.devices()[0].platform
+    # Evidence-proofing: the axon remote-TPU tunnel is documented-flaky
+    # (BASELINE.md methodology notes).  A dead backend must still produce
+    # the driver's one-line JSON — bounded retry, then a skip record at
+    # rc 0, never a raw traceback (round-4 lost its perf row to exactly
+    # that: jax.device_count() crashed with UNAVAILABLE at startup).
+    import subprocess
+
+    def _probe_backend():
+        # The tunnel has a documented total-wedge mode where backend init
+        # hangs >10 min inside native code — a SIGALRM can't interrupt
+        # that, so the probe runs in a SUBPROCESS with a hard timeout.
+        # Only after the probe proves the backend answers does this
+        # process touch jax itself.
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, json; "
+             "print(json.dumps([jax.device_count(), "
+             "jax.devices()[0].platform]))"],
+            capture_output=True, text=True, timeout=180,
+        )
+        if proc.returncode != 0:
+            tail = proc.stderr.strip().splitlines()
+            raise RuntimeError(tail[-1] if tail else
+                               f"probe rc={proc.returncode}")
+        count, name = json.loads(proc.stdout.strip().splitlines()[-1])
+        return max(int(count), 1), name
+
+    n_chips = platform = None
+    last_err = None
+    for attempt in range(3):
+        try:
+            n_chips, platform = _probe_backend()
+            break
+        except Exception as e:  # probe failure or TimeoutExpired (wedge)
+            last_err = e
+            log(f"backend init failed (attempt {attempt + 1}/3): {e}")
+            if attempt < 2:  # no pointless backoff after the last try
+                time.sleep(
+                    float(os.environ.get("TDDL_BENCH_RETRY_SLEEP", "10"))
+                    * (attempt + 1))
+    if n_chips is None:
+        print(json.dumps({
+            "metric": "skipped", "value": 0, "unit": "none",
+            "vs_baseline": None, "skipped": True,
+            "reason": f"backend unavailable after 3 attempts: "
+                      f"{type(last_err).__name__}: {last_err}",
+        }))
+        sys.exit(0)
     is_lm = model.startswith("gpt")
     log(f"bench: {model} nodes={num_nodes} batch/node={per_node_batch} "
         f"seq={seq_len} steps={steps} on {n_chips} {platform} device(s)")

@@ -1,0 +1,155 @@
+"""Adaptive-adversary boundary characterisation (VERDICT r4 missing #3).
+
+Three scenarios beyond the oblivious fixed-intensity attacker, with the
+detected/undetected boundary pinned as tests (the honest limits are
+documented in README's security section):
+
+(a) SLOW-BOIL: intensity ramps from zero.  Because baseline absorption is
+    clean-only (a suspect step's stats never enter the rolling window)
+    and the cross-sectional median/MAD gate compares nodes *within* a
+    step, the ramp cannot drag its own baseline — every tested ramp rate
+    down to 0.001/step is caught, at effective intensity <= ~0.06.
+(b) COLLUSION / CONTAMINATION: k of 8 nodes poison together.  The
+    honest-majority median/MAD assumption (engine/step.py
+    _cross_sectional_score) holds to its theoretical breakdown point:
+    k <= 3 of 8 detected immediately; k = 4 (50 %) is statistically
+    invisible; k = 5 INVERTS the verdict — the honest minority gets
+    flagged.  The boundary is the CONTAMINATION FRACTION, with or
+    without coordination (a norm-inflation attack moves each attacker's
+    magnitude identically either way).  Calibrated 2026-07-31 on the
+    8-device CPU mesh, seed 0.
+(c) PROBATION RE-ATTACK: a readmitted attacker striking again during its
+    own probation window is re-evicted —
+    tests/test_recovery.py::test_readmitted_attacker_is_re_evicted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.attacks import AdversarialAttacker, AttackConfig
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer
+
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
+TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+START = 8
+STEPS = 40
+
+
+@pytest.fixture(scope="module")
+def shared_trainer(tmp_path_factory, eight_devices):
+    """One compiled trusted step for every scenario cell
+    (reset_for_run isolates them)."""
+    tmp = tmp_path_factory.mktemp("adaptive")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, optimizer="adamw", learning_rate=3e-3,
+        checkpoint_interval=10_000, detector_warmup=4, parallelism="data",
+        elastic_resharding=False, checkpoint_dir=str(tmp / "ck"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=16 * STEPS)
+    return trainer, dl
+
+
+def _cell(shared_trainer, **attack_kwargs):
+    trainer, dl = shared_trainer
+    trainer.reset_for_run(seed=0)
+    attacker = AdversarialAttacker(
+        AttackConfig(start_step=START, **attack_kwargs)
+    )
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    trainer.train_epoch(dl, 0)
+    losses = [m["loss"] for m in trainer.metrics_collector.batch_metrics]
+    assert losses and all(np.isfinite(l) for l in losses)
+    return trainer.attack_history
+
+
+@pytest.mark.parametrize("ramp,max_latency", [(0.001, 30), (0.005, 15)])
+def test_slow_boil_ramp_is_caught(shared_trainer, ramp, max_latency):
+    """A ramp from zero intensity does NOT evade: clean-only absorption
+    keeps the baseline honest and the within-step cross-section needs no
+    history at all.  Caught at effective intensity <= 0.06."""
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"], target_nodes=[3],
+                    intensity=0.0, intensity_ramp=ramp)
+    assert history, f"ramp {ramp}/step was never detected"
+    first = history[0]
+    assert first["node_id"] == 3
+    latency = first["step"] - START
+    assert 0 < latency <= max_latency, latency
+    assert ramp * latency <= 0.08, ("caught too late",
+                                    ramp * latency)
+    # No clean node implicated while the boil was below the surface.
+    assert {r["node_id"] for r in history} == {3}
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_colluding_minority_detected(shared_trainer, k):
+    """k <= 3 of 8 coordinated attackers: the honest majority still owns
+    the median, so the whole group is flagged fast — and no honest node
+    is implicated."""
+    targets = list(range(k))
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"],
+                    target_nodes=targets, intensity=0.5, collude=True)
+    detected = {r["node_id"] for r in history}
+    assert detected == set(targets), (detected, targets)
+    assert min(r["step"] for r in history) - START <= 5
+
+
+def test_colluding_half_is_the_documented_blind_spot(shared_trainer):
+    """k = 4 of 8 (exactly 50 %) colluders: the median itself is
+    contaminated, so the cross-sectional gate reads the poisoned norm as
+    'typical' — NOT detected.  This is the honest-majority assumption's
+    theoretical breakdown point, pinned here as the framework's
+    documented limit (README security section)."""
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"],
+                    target_nodes=[0, 1, 2, 3], intensity=0.5, collude=True)
+    assert history == [], (
+        "4/8 collusion unexpectedly detected — update the documented "
+        "boundary", history,
+    )
+
+
+def test_colluding_majority_inverts_the_verdict(shared_trainer):
+    """k = 5 of 8: the attackers OWN the median — the honest minority is
+    what deviates, and the detector flags honest nodes.  Documented
+    failure mode: past 50 % collusion the defence actively mis-targets;
+    only attackers are in the majority's 'consensus'."""
+    targets = {0, 1, 2, 3, 4}
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"],
+                    target_nodes=sorted(targets), intensity=0.5,
+                    collude=True)
+    detected = {r["node_id"] for r in history}
+    assert detected, "expected the inverted verdict to flag someone"
+    assert detected <= ({0, 1, 2, 3, 4, 5, 6, 7} - targets), (
+        "attackers unexpectedly detected — update the documented "
+        "boundary", detected,
+    )
+
+
+def test_independent_half_breaks_identically(shared_trainer):
+    """Contrast cell: 4/8 attackers WITHOUT coordination are equally
+    invisible.  The cross-sectional gate scores norm MAGNITUDE, and a
+    norm-inflation attack moves every attacker's magnitude the same way
+    whether or not their noise directions agree — so the breakdown point
+    is the CONTAMINATION FRACTION (the median's theoretical 50 %), not
+    coordination.  Documented with the collusion boundary in README."""
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"],
+                    target_nodes=[0, 1, 2, 3], intensity=0.5,
+                    collude=False)
+    assert history == [], (
+        "independent 4/8 unexpectedly detected — update the documented "
+        "boundary", history,
+    )

@@ -123,6 +123,31 @@ def test_post_eviction_batches_resplit(evicted_run):
     assert node_batch["input"].shape == (7, 2, 16)
 
 
+def test_post_eviction_validation_runs(evicted_run):
+    """Validation works on the resharded 7-node fleet: the elastic
+    rebuild must install the NODE-vmapped eval step (a plain eval step
+    would crash on the node-split [n', B/n', ...] batches
+    validate_metrics now always feeds)."""
+    from trustworthy_dl_tpu.data import get_dataloader
+
+    trainer, _ = evicted_run
+    val = get_dataloader("openwebtext", batch_size=14, seq_len=16,
+                         vocab_size=128, num_examples=28)
+    metrics = trainer.validate_metrics(val)
+    assert np.isfinite(metrics["loss"])
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_reset_for_run_refuses_after_eviction(evicted_run):
+    """The compiled step is shaped for the constructor's 8-node fleet;
+    after an eviction (even one that leaves node_map an identity map)
+    reset_for_run must refuse rather than silently reset onto the
+    shrunken topology."""
+    trainer, _ = evicted_run
+    with pytest.raises(RuntimeError, match="topology change"):
+        trainer.reset_for_run()
+
+
 def test_second_eviction(tmp_path):
     """Two sequential evictions: 4 -> 3 -> 2 nodes, training still sane."""
     trainer = make_trainer(tmp_path, num_nodes=4)

@@ -249,6 +249,73 @@ def test_validate_runs(tmp_path):
     assert np.isfinite(loss)
 
 
+def test_validate_shards_over_data_axis(tmp_path, eight_devices):
+    """Validation rides the mesh like training: the eval batch is
+    node-split [n, B/n, ...] with the node axis laid over 'data', so each
+    chip evaluates 1/n of the batch instead of replicating it (VERDICT r4
+    weak #3; the reference replicated, distributed_trainer.py:494-508)."""
+    from jax.sharding import PartitionSpec as P
+
+    trainer = gpt_trainer(tmp_path, num_nodes=8)
+    trainer.initialize()
+
+    seen = []
+    real_eval = trainer._eval_step
+
+    def spy(params, batch):
+        seen.append(batch)
+        return real_eval(params, batch)
+
+    trainer._eval_step = spy
+    metrics = trainer.validate_metrics(gpt_loader(num_nodes=8,
+                                                  num_examples=32))
+    assert np.isfinite(metrics["loss"]) and "perplexity" in metrics
+    assert seen, "eval step never ran"
+    for batch in seen:
+        for arr in batch.values():
+            assert arr.shape[0] == 8  # node-split leading axis
+            spec = arr.sharding.spec
+            assert spec and spec[0] == "data", spec
+
+    # Sharded-eval mean == replicated-eval mean (equal node rows).
+    from trustworthy_dl_tpu.engine.step import build_eval_step
+
+    plain = jax.jit(build_eval_step(trainer.model))
+    flat = {k: np.asarray(v).reshape((-1,) + v.shape[2:])
+            for k, v in seen[0].items()}
+    ref = plain(trainer.state.params, {k: jnp.asarray(v)
+                                       for k, v in flat.items()})
+    got = real_eval(trainer.state.params, seen[0])
+    assert float(got["loss"]) == pytest.approx(float(ref["loss"]), rel=1e-5)
+    assert float(got["accuracy"]) == pytest.approx(float(ref["accuracy"]),
+                                                   rel=1e-5)
+
+
+def test_validate_ragged_final_batch(tmp_path, eight_devices):
+    """A drop_last=False loader's ragged tail (size not divisible by n,
+    even smaller than n) must neither crash nor be dropped: it evaluates
+    as a single replicated node row."""
+    trainer = gpt_trainer(tmp_path, num_nodes=8, grad_accum_steps=2)
+    trainer.initialize()
+    # The built-in loader never emits partial batches, but
+    # validate_metrics accepts any iterable — and the reference's torch
+    # loaders with drop_last=False do (distributed_trainer.py:494-508).
+    rng = np.random.default_rng(0)
+    mk = lambda b: {"input": rng.integers(0, 128, (b, 16)),
+                    "target": rng.integers(0, 128, (b, 16))}
+    val = [mk(16), mk(16), mk(4)]  # ragged tail of 4 < 8 nodes
+    seen = []
+    real_eval = trainer._eval_step
+    trainer._eval_step = lambda p, b: (seen.append(b), real_eval(p, b))[1]
+    metrics = trainer.validate_metrics(val)
+    assert np.isfinite(metrics["loss"])
+    assert len(seen) == 3
+    assert seen[0]["input"].shape[0] == 8
+    assert seen[-1]["input"].shape == (1, 4, 16)  # ragged tail, one row
+    # Eval trims never feed the training-side warning bookkeeping.
+    assert not trainer._warned_trim and not trainer._trimmed_sizes
+
+
 def test_epoch_intelligence_wired(clean_run):
     """The reference defined adaptive thresholds / ML detectors / reliability
     prediction but never called them (SURVEY §7.5).  Our trainer runs them at

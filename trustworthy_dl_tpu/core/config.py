@@ -268,6 +268,11 @@ class AttackConfig:
     intensity: float = 0.5
     start_step: int = 200
     seed: int = 0
+    # Adaptive-adversary knobs: slow-boil intensity ramp (added per
+    # attacked step on top of `intensity`) and colluding coordination
+    # (all attackers submit the same perturbation direction).
+    intensity_ramp: float = 0.0
+    collude: bool = False
 
 
 # ---------------------------------------------------------------------------

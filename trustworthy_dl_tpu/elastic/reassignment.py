@@ -290,7 +290,7 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     """Evict mesh coordinates, migrate state, re-jit; returns the measured
     migration record.  ``drop`` holds CURRENT coordinates (the trainer
     translates original ids before calling)."""
-    from trustworthy_dl_tpu.engine.step import build_eval_step, \
+    from trustworthy_dl_tpu.engine.step import build_node_eval_step, \
         build_train_step
 
     config = trainer.config
@@ -353,7 +353,7 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         build_train_step(trainer.model, new_config, trainer.optimizer),
         donate_argnums=(0,),
     )
-    trainer._eval_step = jax.jit(build_eval_step(trainer.model))
+    trainer._eval_step = jax.jit(build_node_eval_step(trainer.model))
     trainer.state = new_state
     trainer.attack_plan = trainer.attack_plan._replace(
         target_mask=trainer.attack_plan.target_mask[np.asarray(keep)]
@@ -456,7 +456,7 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
     coordinate re-enters RECOVERING with fresh detector baselines; if it is
     still hostile, the cross-sectional checks (which need no history) and
     the post-warmup batteries evict it again."""
-    from trustworthy_dl_tpu.engine.step import build_eval_step, \
+    from trustworthy_dl_tpu.engine.step import build_node_eval_step, \
         build_train_step
 
     config = trainer.config
@@ -511,7 +511,7 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         build_train_step(trainer.model, new_config, trainer.optimizer),
         donate_argnums=(0,),
     )
-    trainer._eval_step = jax.jit(build_eval_step(trainer.model))
+    trainer._eval_step = jax.jit(build_node_eval_step(trainer.model))
     trainer.state = new_state
     trainer.node_map = list(trainer.node_map) + node_ids
     # Rebuild the injection mask from original identities: a readmitted

@@ -216,6 +216,11 @@ class StepMetrics(NamedTuple):
     backdoor: Array           # bool[n]
     out_stats: Array          # f32[n, 17] output stat battery (ML-tier feed)
     grad_stats: Array         # f32[n, 17] gradient stat battery
+    # Model-specific diagnostics averaged over nodes (e.g. MoE
+    # {"moe_drop_fraction"}: share of routed assignments dropped at expert
+    # capacity — invisible in the loss on any single step).  Empty for
+    # models that report none.
+    model_aux: Dict[str, Array] = {}
 
 
 def build_train_step(
@@ -246,12 +251,16 @@ def build_train_step(
         # (what the reference's per-partition hook watched,
         # distributed_trainer.py:160-170).  For LMs these are ~65× smaller
         # than the logits, keeping the battery off the CE-loss fusion path.
+        model_aux = {}
         if bundle.loss_monitor is not None:
             # Loss-bearing path: lets the model fuse head+CE (the vocab-
-            # chunked fused head never materialises logits at all).
-            loss, feats, mean_logits = bundle.loss_monitor(
-                params, node_batch
-            )
+            # chunked fused head never materialises logits at all).  A
+            # 4th element, when present, is a dict of model diagnostics
+            # (MoE capacity-drop fraction) surfaced into StepMetrics.
+            out = bundle.loss_monitor(params, node_batch)
+            loss, feats, mean_logits = out[:3]
+            if len(out) > 3:
+                model_aux = out[3]
         elif bundle.apply_monitor is not None:
             logits, feats, mean_logits = bundle.apply_monitor(
                 params, node_batch["input"]
@@ -264,7 +273,8 @@ def build_train_step(
             mean_logits = jnp.mean(logits.astype(jnp.float32), axis=lead)
             loss = L.cross_entropy_loss(logits, node_batch["target"])
         out_stats = _output_stat_vector(feats, max_sort)
-        aux = (out_stats, jnp.mean(feats), jnp.std(feats), mean_logits)
+        aux = (out_stats, jnp.mean(feats), jnp.std(feats), mean_logits,
+               model_aux)
         return loss, aux
 
     grad_fn = jax.value_and_grad(node_loss, has_aux=True)
@@ -292,13 +302,13 @@ def build_train_step(
             def body(carry, mb):
                 loss_sum, grad_sum, ml_sum = carry
                 (loss, aux), g = base_grad_fn(params, mb)
-                out_stats, f_mean, f_std, ml = aux
+                out_stats, f_mean, f_std, ml, model_aux = aux
                 carry = (
                     loss_sum + loss,
                     jax.tree_util.tree_map(jnp.add, grad_sum, g),
                     ml_sum + ml,
                 )
-                return carry, (out_stats, f_mean, f_std)
+                return carry, (out_stats, f_mean, f_std, model_aux)
 
             init = (
                 jnp.zeros((), jnp.float32),
@@ -314,13 +324,17 @@ def build_train_step(
                 combine_microbatch_stats,
             )
 
-            stacked_stats, f_means, f_stds = stacked
+            stacked_stats, f_means, f_stds, stacked_model_aux = stacked
             out_stats = combine_microbatch_stats(stacked_stats)
             f_mean = jnp.mean(f_means, axis=0)
             f_std = jnp.mean(f_stds, axis=0)
+            # Model diagnostics are per-microbatch means -> average them.
+            model_aux = jax.tree_util.tree_map(
+                lambda v: jnp.mean(v, axis=0), stacked_model_aux
+            )
             inv = 1.0 / accum
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
-            aux = (out_stats, f_mean, f_std, ml_sum * inv)
+            aux = (out_stats, f_mean, f_std, ml_sum * inv, model_aux)
             return (loss_sum * inv, aux), grads
 
     def train_step(state: TrainState, batch: Dict[str, Array],
@@ -344,7 +358,12 @@ def build_train_step(
         (losses, aux), grads = jax.vmap(grad_fn, in_axes=(None, 0))(
             state.params, batch
         )
-        out_stats, out_mean, out_std, mean_logits = aux
+        out_stats, out_mean, out_std, mean_logits, model_aux = aux
+        # Per-node diagnostics -> fleet mean (capacity health, not a
+        # per-node detection signal).
+        model_aux = jax.tree_util.tree_map(
+            lambda v: jnp.mean(v, axis=0), model_aux
+        )
         grads = jax.lax.cond(
             plan.is_live(state.step),
             lambda g: poison_gradients(plan, g, state.step, k_grad),
@@ -604,6 +623,7 @@ def build_train_step(
             backdoor=backdoor,
             out_stats=out_stats,
             grad_stats=grad_stats,
+            model_aux=model_aux,
         )
         return new_state, metrics
 
@@ -649,3 +669,21 @@ def build_eval_step(bundle: ModelBundle
         return {"loss": loss, "accuracy": acc}
 
     return eval_step
+
+
+def build_node_eval_step(bundle: ModelBundle
+                         ) -> Callable[[Any, Dict[str, Array]],
+                                       Dict[str, Array]]:
+    """Validation over the node axis: the batch arrives node-split
+    [n, B/n, ...] with the node axis laid over the mesh's 'data' axis —
+    exactly like training — so on an n-chip mesh each chip evaluates 1/n
+    of the batch instead of replicating the whole thing (the reference
+    replicated: distributed_trainer.py:494-508).  Node rows are equal-
+    sized, so the mean of per-node means is the global mean."""
+    eval_step = build_eval_step(bundle)
+
+    def node_eval_step(params, node_batch):
+        out = jax.vmap(lambda b: eval_step(params, b))(node_batch)
+        return jax.tree_util.tree_map(jnp.mean, out)
+
+    return node_eval_step

@@ -42,7 +42,8 @@ from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
 from trustworthy_dl_tpu.engine.state import TrainState, init_train_state, \
     zero1_place_opt_state
-from trustworthy_dl_tpu.engine.step import StepMetrics, build_eval_step, \
+from trustworthy_dl_tpu.engine.step import StepMetrics, \
+    build_node_eval_step, \
     build_train_step
 from trustworthy_dl_tpu.models.factory import ModelFactory
 from trustworthy_dl_tpu.trust.manager import TrustManager
@@ -82,75 +83,17 @@ class DistributedTrainer:
                  model_overrides: Optional[Dict[str, Any]] = None):
         self.config = config
         self.training_state = TrainingState.INITIALIZING
-        self.current_epoch = 0
-        self.global_step = 0
         if config.debug_nans:
             enable_nan_debugging()
 
-        # Host-facing components (reference: distributed_trainer.py:74-84).
-        self.trust_manager = TrustManager(
-            num_nodes=config.num_nodes,
-            trust_threshold=config.trust_threshold,
-            initial_trust=config.initial_trust,
-            decay_rate=config.trust_decay_rate,
-            recovery_rate=config.trust_recovery_rate,
-            alpha=config.trust_alpha,
-        )
-        self.node_monitor = NodeMonitor()
-        self.gradient_verifier = GradientVerifier()
-        self.attack_detector = AttackDetector(
-            exact_order_stats=config.exact_order_stats
-        )
-        self.metrics_collector = MetricsCollector(
-            tensorboard_dir=config.tensorboard_dir
-        )
-        self._warned_trim = False
-        self._trimmed_sizes: set = set()
-
-        # Node configurations (reference: :85-87).  On TPU, rank == mesh
-        # coordinate along the node axis.
-        self.node_configs: Dict[int, NodeConfig] = {
-            i: NodeConfig(node_id=i, rank=i, world_size=config.num_nodes,
-                          device_id=i, model_partition=f"shard_{i}")
-            for i in range(config.num_nodes)
-        }
-
-        self.attack_history: List[Dict] = []
-        self.reassignment_history: List[Dict] = []
-        # Epoch-cadence ML-tier verdicts (original node id -> bool).  The
-        # tier is gated once here on sklearn availability: without it the
-        # refit is a permanent no-op, so the per-step battery feed
-        # (device->host transfers + dict building on the hot path) would be
-        # pure waste.
-        self.ml_flags: Dict[int, bool] = {}
+        # Epoch-cadence ML tier, gated once on sklearn availability:
+        # without it the refit is a permanent no-op, so the per-step
+        # battery feed (device->host transfers + dict building on the hot
+        # path) would be pure waste.
         self._ml_enabled = config.ml_detectors and _sklearn_available()
-        # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
-        # eviction removes coordinates (elastic/reassignment.py); all host
-        # bookkeeping (trust manager, histories, reports) keys on original
-        # ids so identities survive resharding.
-        self.node_map: List[int] = list(range(config.num_nodes))
-        # Nodes currently in a recorded-compromised episode: a sustained
-        # attack fires the detector every batch, but we record the incident
-        # and trigger reassignment only on the clean→compromised transition
-        # (the reference re-records per batch, which grows history without
-        # bound on long runs).
-        self._open_incidents: set = set()
-        # Elastic-readmission bookkeeping: original id -> eviction step /
-        # the device its coordinate occupied (None in dev mode), and the
-        # per-original-id injection bits so a readmitted node's attack
-        # schedule survives the mask compaction/expansion round-trip.
-        self._evicted_at: Dict[int, int] = {}
-        self._evicted_devices: Dict[int, Any] = {}
-        self._plan_bits: Dict[int, bool] = {}
-        # Pipeline restaff: healthy survivors a stage-count repartition
-        # could not seat (id -> their parked devices); re-staffed by the
-        # next restaff (elastic/restaff.py).
-        self._idle_pool: Dict[int, Any] = {}
-        # Loader auto-resize after topology changes (per-node microbatch
-        # captured lazily from the first batch seen).
-        self._active_loader: Any = None
-        self._per_node_batch: Optional[int] = None
-        self._trim_grace = 0
+        # Fleet size the jitted steps are built for (reset_for_run guard).
+        self._constructed_num_nodes = config.num_nodes
+        self._init_host_state()
 
         # Model / optimizer / mesh / step.
         model_overrides = dict(model_overrides or {})
@@ -202,11 +145,10 @@ class DistributedTrainer:
                 build_train_step(self.model, config, self.optimizer),
                 donate_argnums=(0,),
             )
-            self._eval_step = jax.jit(build_eval_step(self.model))
+            self._eval_step = jax.jit(build_node_eval_step(self.model))
         self.checkpointer = CheckpointManager(config.checkpoint_dir)
 
         self.state: Optional[TrainState] = None
-        self.attack_plan: AttackPlan = null_plan(config.num_nodes)
         logger.info(
             "Initialized DistributedTrainer with %d nodes (%s parallelism, "
             "mesh %s)", config.num_nodes, config.parallelism,
@@ -216,6 +158,76 @@ class DistributedTrainer:
     # ------------------------------------------------------------------
     # Setup
     # ------------------------------------------------------------------
+
+    def _init_host_state(self) -> None:
+        """Per-run host world-view, shared verbatim by the constructor and
+        ``reset_for_run`` so the two can never drift: any host attribute a
+        run mutates MUST be (re)initialised here, or a later
+        ``reset_for_run`` would leak one run's state into the next."""
+        config = self.config
+        self.current_epoch = 0
+        self.global_step = 0
+
+        # Host-facing components (reference: distributed_trainer.py:74-84).
+        self.trust_manager = TrustManager(
+            num_nodes=config.num_nodes,
+            trust_threshold=config.trust_threshold,
+            initial_trust=config.initial_trust,
+            decay_rate=config.trust_decay_rate,
+            recovery_rate=config.trust_recovery_rate,
+            alpha=config.trust_alpha,
+        )
+        self.node_monitor = NodeMonitor()
+        self.gradient_verifier = GradientVerifier()
+        self.attack_detector = AttackDetector(
+            exact_order_stats=config.exact_order_stats
+        )
+        self.metrics_collector = MetricsCollector(
+            tensorboard_dir=config.tensorboard_dir
+        )
+        self._warned_trim = False
+        self._trimmed_sizes: set = set()
+
+        # Node configurations (reference: :85-87).  On TPU, rank == mesh
+        # coordinate along the node axis.
+        self.node_configs: Dict[int, NodeConfig] = {
+            i: NodeConfig(node_id=i, rank=i, world_size=config.num_nodes,
+                          device_id=i, model_partition=f"shard_{i}")
+            for i in range(config.num_nodes)
+        }
+
+        self.attack_history: List[Dict] = []
+        self.reassignment_history: List[Dict] = []
+        # Epoch-cadence ML-tier verdicts (original node id -> bool).
+        self.ml_flags: Dict[int, bool] = {}
+        # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
+        # eviction removes coordinates (elastic/reassignment.py); all host
+        # bookkeeping (trust manager, histories, reports) keys on original
+        # ids so identities survive resharding.
+        self.node_map: List[int] = list(range(config.num_nodes))
+        # Nodes currently in a recorded-compromised episode: a sustained
+        # attack fires the detector every batch, but we record the incident
+        # and trigger reassignment only on the clean→compromised transition
+        # (the reference re-records per batch, which grows history without
+        # bound on long runs).
+        self._open_incidents: set = set()
+        # Elastic-readmission bookkeeping: original id -> eviction step /
+        # the device its coordinate occupied (None in dev mode), and the
+        # per-original-id injection bits so a readmitted node's attack
+        # schedule survives the mask compaction/expansion round-trip.
+        self._evicted_at: Dict[int, int] = {}
+        self._evicted_devices: Dict[int, Any] = {}
+        self._plan_bits: Dict[int, bool] = {}
+        # Pipeline restaff: healthy survivors a stage-count repartition
+        # could not seat (id -> their parked devices); re-staffed by the
+        # next restaff (elastic/restaff.py).
+        self._idle_pool: Dict[int, Any] = {}
+        # Loader auto-resize after topology changes (per-node microbatch
+        # captured lazily from the first batch seen).
+        self._active_loader: Any = None
+        self._per_node_batch: Optional[int] = None
+        self._trim_grace = 0
+        self.attack_plan: AttackPlan = null_plan(config.num_nodes)
 
     def initialize(self, seed: Optional[int] = None) -> TrainState:
         """Init params/optimizer/world-view.  Params are replicated over the
@@ -273,6 +285,29 @@ class DistributedTrainer:
         ))
         self.training_state = TrainingState.TRAINING
         return self.state
+
+    def reset_for_run(self, seed: Optional[int] = None) -> TrainState:
+        """Fresh run on the SAME jitted step: re-initialises device state
+        (params/optimizer/trust/detector baselines) AND the host
+        world-view (trust manager, detector histories, incident records,
+        metrics, step counter) without touching the compiled train/eval
+        steps — repeated experiment cells (e.g. the detection-envelope
+        sweep) pay the XLA compile once instead of per cell.
+
+        Only valid while the topology is unchanged (no eviction in the
+        previous run); it raises otherwise, because the compiled step is
+        shaped for the constructor's node count.  The guard compares
+        against the CONSTRUCTOR's fleet size — an eviction of a trailing
+        node leaves node_map an identity map, so identity alone cannot
+        detect it."""
+        if self.config.num_nodes != self._constructed_num_nodes or \
+                self.node_map != list(range(self._constructed_num_nodes)):
+            raise RuntimeError(
+                "reset_for_run after a topology change; rebuild the "
+                "trainer instead"
+            )
+        self._init_host_state()
+        return self.initialize(seed=seed)
 
     def _place_on_mesh(self, state: TrainState) -> TrainState:
         """Explicit mesh placement of the whole TrainState: per-node rows
@@ -362,14 +397,25 @@ class DistributedTrainer:
     # Batch plumbing
     # ------------------------------------------------------------------
 
-    def _node_batch(self, batch: Dict[str, np.ndarray]
+    def _node_batch(self, batch: Dict[str, np.ndarray],
+                    for_eval: bool = False
                     ) -> Optional[Dict[str, jax.Array]]:
         """[B, ...] -> [n, B//n, ...] with the node axis laid over the
         mesh's data axis — the reference's per-node data split, as sharding.
         Pipeline mode keeps the global batch (microbatching is internal) but
         trims B to a multiple of num_microbatches.  Returns None for a
         stale undersized batch during a topology-growth transition (the
-        caller skips it)."""
+        caller skips it).
+
+        ``for_eval``: validation has no accumulation quantum and must not
+        crash on a ragged final batch (drop_last=False loaders).  In
+        non-pipeline modes a batch whose size doesn't divide by n is
+        evaluated as a single replicated node row (no example dropped);
+        in pipeline mode the stage ring's shapes are fixed, so a tail
+        smaller than the microbatch quantum is SKIPPED (None) and a
+        larger ragged tail is trimmed to the quantum — the closest the
+        pipe can get without a per-tail-shape recompile of all S stages.
+        Eval never feeds the training-side trim warnings."""
         if self.config.parallelism == "model":
             m = self.config.num_microbatches
             # DP pipeline replica rows (TPU (group, S) mesh) additionally
@@ -381,6 +427,8 @@ class DistributedTrainer:
             for key, arr in batch.items():
                 b = (arr.shape[0] // quantum) * quantum
                 if b == 0:
+                    if for_eval:
+                        return None  # sub-quantum tail: skip, don't crash
                     raise ValueError(
                         f"batch size {arr.shape[0]} < num_microbatches x "
                         f"dp rows = {quantum}"
@@ -389,6 +437,20 @@ class DistributedTrainer:
             return out
         n = self.config.num_nodes
         out = {}
+        if for_eval:
+            lead = min(arr.shape[0] for arr in batch.values())
+            if lead == 0:
+                return None
+            # Ragged tail: one replicated node row — every example is
+            # still evaluated (the row count change costs one extra
+            # compile per distinct tail shape, bounded by the loader).
+            n_eval = n if lead % n == 0 else 1
+            for key, arr in batch.items():
+                reshaped = np.asarray(arr[:lead]).reshape(
+                    (n_eval, lead // n_eval) + arr.shape[1:]
+                )
+                out[key] = self._shard_node_rows(reshaped, n_eval)
+            return out
         accum = max(self.config.grad_accum_steps, 1)
         # Trim ragged batches (drop_last=False loaders) to a multiple of
         # nodes × accumulation steps — same trimming contract as the node
@@ -429,17 +491,22 @@ class DistributedTrainer:
                 self._trimmed_sizes.add(lead)
         for key, arr in batch.items():
             reshaped = np.asarray(arr[:b]).reshape((n, b // n) + arr.shape[1:])
-            data_size = dict(
-                zip(self.mesh.axis_names, self.mesh.devices.shape)
-            ).get(DATA_AXIS, 1)
-            if data_size > 1 and n % data_size == 0:
-                sharding = NamedSharding(
-                    self.mesh, P(DATA_AXIS, *([None] * (reshaped.ndim - 1)))
-                )
-                out[key] = jax.device_put(reshaped, sharding)
-            else:
-                out[key] = jnp.asarray(reshaped)
+            out[key] = self._shard_node_rows(reshaped, n)
         return out
+
+    def _shard_node_rows(self, reshaped: np.ndarray, rows: int) -> jax.Array:
+        """Place a node-split [rows, ...] array: leading axis over the
+        mesh's data axis when the row count tiles it, replicated
+        otherwise."""
+        data_size = dict(
+            zip(self.mesh.axis_names, self.mesh.devices.shape)
+        ).get(DATA_AXIS, 1)
+        if data_size > 1 and rows % data_size == 0:
+            sharding = NamedSharding(
+                self.mesh, P(DATA_AXIS, *([None] * (reshaped.ndim - 1)))
+            )
+            return jax.device_put(reshaped, sharding)
+        return jnp.asarray(reshaped)
 
     # ------------------------------------------------------------------
     # Training (distributed_trainer.py:382-433,465-492)
@@ -550,6 +617,9 @@ class DistributedTrainer:
                 "trust_scores": {
                     id_of[i]: float(trust[i]) for i in range(len(trust))
                 },
+                # Model diagnostics (e.g. MoE capacity-drop fraction).
+                **{k: float(v)
+                   for k, v in getattr(metrics, "model_aux", {}).items()},
             }
         )
         # Feed the stat batteries into the host detector's history — the
@@ -882,17 +952,28 @@ class DistributedTrainer:
         """Full validation metrics: loss, accuracy, and (for LMs)
         perplexity — the eval step already computes them; the reference
         only surfaced loss."""
-        total, acc, batches = 0.0, 0.0, 0
+        total, acc, examples = 0.0, 0.0, 0
         for batch in val_dataloader:
-            if self.config.parallelism == "model":
-                batch = self._node_batch(batch)  # trims to microbatch multiple
-            else:
-                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            # Node-split + 'data'-axis sharding exactly like training
+            # (model mode trims to a microbatch multiple instead), so on
+            # an n-chip mesh each chip evaluates 1/n of the batch rather
+            # than replicating the whole thing.
+            batch = self._node_batch(batch, for_eval=True)
+            if batch is None:  # empty / stale batch
+                continue
             out = self._eval_step(self.state.params, batch)
-            total += float(out["loss"])
-            acc += float(out["accuracy"])
-            batches += 1
-        n = max(batches, 1)
+            # Example-weighted mean: a ragged tail batch must count by
+            # its size, not as a full batch.
+            first = next(iter(batch.values()))
+            # Model mode feeds the global batch [B, ...]; other modes the
+            # node split [rows, per_row, ...].
+            weight = int(first.shape[0]) if \
+                self.config.parallelism == "model" else \
+                int(first.shape[0] * first.shape[1])
+            total += float(out["loss"]) * weight
+            acc += float(out["accuracy"]) * weight
+            examples += weight
+        n = max(examples, 1)
         metrics = {"loss": total / n, "accuracy": acc / n}
         if self.model.kind == "lm":
             metrics["perplexity"] = float(np.exp(min(metrics["loss"], 30.0)))
@@ -1060,7 +1141,7 @@ class DistributedTrainer:
                 build_train_step(self.model, self.config, self.optimizer),
                 donate_argnums=(0,),
             )
-            self._eval_step = jax.jit(build_eval_step(self.model))
+            self._eval_step = jax.jit(build_node_eval_step(self.model))
         self.node_map = [int(i) for i in meta["node_map"]]
         # Any attack plan was shaped for the constructor's node count;
         # injection targets are per-run anyway — reset, caller re-plans.

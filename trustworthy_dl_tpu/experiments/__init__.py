@@ -1,5 +1,9 @@
 """Experiment layer (L5) — real-trainer-driven runner + BASELINE presets."""
 
+from trustworthy_dl_tpu.experiments.envelope import (
+    render_table,
+    run_detection_envelope,
+)
 from trustworthy_dl_tpu.experiments.runner import (
     PRESETS,
     ExperimentRunner,
@@ -13,5 +17,7 @@ __all__ = [
     "PRESETS",
     "main",
     "preset_config",
+    "render_table",
+    "run_detection_envelope",
     "run_threshold_sweep",
 ]

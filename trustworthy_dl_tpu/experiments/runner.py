@@ -778,7 +778,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--output-dir", type=str, default=None)
     parser.add_argument("--sweep-thresholds", type=str, default=None,
                         help="comma-separated trust thresholds (preset 5)")
+    parser.add_argument("--envelope", action="store_true",
+                        help="measure the detection envelope (attack type "
+                        "x intensity matrix) instead of a single "
+                        "experiment")
     args = parser.parse_args(argv)
+
+    if args.envelope:
+        from trustworthy_dl_tpu.experiments.envelope import (
+            run_detection_envelope,
+        )
+
+        # Refuse flags the sweep would silently ignore: a user passing
+        # --model/--steps must not publish numbers believing they
+        # measured that configuration.
+        unsupported = {
+            "--config": args.config, "--preset": args.preset,
+            "--name": args.name, "--model": args.model,
+            "--dataset": args.dataset, "--epochs": args.epochs,
+            "--batch-size": args.batch_size,
+            "--parallelism": args.parallelism,
+            "--steps-per-epoch": args.steps_per_epoch,
+            "--attack": args.attack or None,
+            "--sweep-thresholds": args.sweep_thresholds,
+        }
+        rejected = [flag for flag, value in unsupported.items()
+                    if value is not None]
+        if rejected:
+            parser.error(
+                f"--envelope does not take {', '.join(rejected)}; it "
+                "sweeps its own fixed matrix (use "
+                "run_detection_envelope(...) for custom shapes)"
+            )
+        kwargs: Dict[str, Any] = {}
+        if args.output_dir:
+            kwargs["output_dir"] = args.output_dir
+        if args.nodes:
+            kwargs["num_nodes"] = args.nodes
+        results = run_detection_envelope(**kwargs)
+        print(f"Detection envelope: {len(results['cells'])} cells in "
+              f"{results['wall_time_s']:.1f}s")
+        return 0
 
     overrides = {
         k: v for k, v in {
