@@ -71,7 +71,11 @@ class TrainingConfig:
     # Across-slice (DCN) extents for multi-slice pods: {axis: n_slices}.
     # Axes listed here parallelise over DCN; all others stay on ICI.
     dcn_mesh_shape: Optional[Dict[str, int]] = None
-    num_microbatches: int = 4          # pipeline schedule depth
+    # Pipeline schedule depth; 0 = auto (largest M dividing the
+    # per-replica-row batch, capped at 4*S — the measured sweet spot of
+    # experiments/pipeline_schedule_study: bubble (S-1)/(M+S-1) falls
+    # with M, marginal gain < ~6 % past 4*S).
+    num_microbatches: int = 0
     # Gradient accumulation (data-parallel modes): each node's batch is
     # processed in this many sequential microbatches inside the step
     # (lax.scan), averaging the gradients — activation memory shrinks by
@@ -229,7 +233,7 @@ class ExperimentConfig:
     )
     # The reference hardcodes nodes [1, 3] (experiment_runner.py:93).
     target_nodes: List[int] = field(default_factory=lambda: [1, 3])
-    num_microbatches: int = 4
+    num_microbatches: int = 0  # 0 = auto (see TrainingConfig)
     # Elastic / recovery knobs forwarded to the trainer (recovery
     # experiments: transient attack -> eviction -> readmission).
     elastic_resharding: bool = False

@@ -16,6 +16,7 @@ TrainingState machine) and syncs reporting state at epoch cadence.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import logging
 import time
@@ -130,8 +131,21 @@ class DistributedTrainer:
             from trustworthy_dl_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
                 build_pipeline_train_step,
+                choose_num_microbatches,
             )
 
+            if config.num_microbatches == 0:  # auto schedule depth
+                # Resolve into a COPY: the trainer owns (and mutates) its
+                # config, but the caller's object must stay pristine — a
+                # second trainer built from it (different mesh, different
+                # dp) needs the 0 sentinel intact to re-resolve.
+                config = self.config = dataclasses.replace(
+                    config,
+                    num_microbatches=choose_num_microbatches(
+                        config.batch_size, config.num_nodes,
+                        self.mesh.shape.get(DATA_AXIS, 1),
+                    ),
+                )
             self._train_step = jax.jit(
                 build_pipeline_train_step(self.model, config, self.optimizer,
                                           self.mesh),

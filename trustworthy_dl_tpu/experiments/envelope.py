@@ -60,12 +60,18 @@ TINY_GPT = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128,
                 n_positions=32, seq_len=16)
 
 
-def _run_cell(trainer: DistributedTrainer, dl, *, seed: int,
-              attack_type: Optional[str], intensity: float,
+def _run_cell(trainer: DistributedTrainer, dl_kwargs: Dict[str, Any], *,
+              seed: int, attack_type: Optional[str], intensity: float,
               targets: Sequence[int], warmup_steps: int,
               attack_steps: int) -> Dict[str, Any]:
     """One measured cell: reset, run warmup+attack steps, read ground
-    truth out of the trainer's incident records."""
+    truth out of the trainer's incident records.
+
+    The dataloader is built FRESH per cell: a shared loader's internal
+    epoch counter would advance across cells, making each cell's data
+    permutation depend on its position in the sweep — every cell must be
+    reproducible standalone."""
+    dl = get_dataloader(**dl_kwargs)
     trainer.reset_for_run(seed=seed)
     n = trainer.config.num_nodes
     if attack_type is not None:
@@ -189,8 +195,8 @@ def run_detection_envelope(
     )
     trainer = DistributedTrainer(config, model_overrides=overrides)
     total = warmup_steps + attack_steps
-    dl = get_dataloader(
-        "openwebtext", batch_size=config.batch_size,
+    dl_kwargs = dict(
+        dataset_name="openwebtext", batch_size=config.batch_size,
         seq_len=overrides.get("seq_len", 16),
         vocab_size=overrides.get("vocab_size", 128),
         num_examples=config.batch_size * total,
@@ -198,7 +204,7 @@ def run_detection_envelope(
 
     # Clean floor first: FP rate with no attack at all.
     logger.info("envelope: clean floor run")
-    clean = _run_cell(trainer, dl, seed=seed, attack_type=None,
+    clean = _run_cell(trainer, dl_kwargs, seed=seed, attack_type=None,
                       intensity=0.0, targets=(), warmup_steps=warmup_steps,
                       attack_steps=attack_steps)
 
@@ -207,7 +213,7 @@ def run_detection_envelope(
         for intensity in intensities:
             logger.info("envelope: %s @ %.2f", attack_type, intensity)
             cells.append(_run_cell(
-                trainer, dl, seed=seed, attack_type=attack_type,
+                trainer, dl_kwargs, seed=seed, attack_type=attack_type,
                 intensity=float(intensity), targets=targets,
                 warmup_steps=warmup_steps, attack_steps=attack_steps,
             ))

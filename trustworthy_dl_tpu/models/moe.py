@@ -75,6 +75,15 @@ class MoEConfig(gpt2.GPT2Config):
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_weight: float = 0.01
+    # Slot assignment under capacity pressure:
+    #   "positional" — GShard's in-order claim (rank-0 before rank-1,
+    #                  earlier tokens before later); overflow drops are
+    #                  position-biased (late tokens lose).
+    #   "priority"   — per-expert sort by gate probability (one
+    #                  [E, S] top_k, static shapes): overflow drops the
+    #                  LOWEST-prob assignments, minimising dropped gate
+    #                  mass.  The TPU-friendly form of sorted dispatch.
+    dispatch: str = "positional"
 
     @staticmethod
     def from_name(name: str, **overrides: Any) -> "MoEConfig":
@@ -171,6 +180,46 @@ def router_dispatch(
     return combine, aux
 
 
+def router_dispatch_priority(
+    probs: jax.Array, cfg: MoEConfig, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """[S, E] gate probs -> (combine f32[S, E, C], aux f32[]).
+
+    Sorted dispatch: each expert keeps its top-``capacity`` assignments
+    BY GATE PROBABILITY (one ``lax.top_k`` over the [E, S] assignment
+    matrix — the static-shape TPU spelling of sorting assignments within
+    each expert), so capacity overflow sheds the lowest-confidence
+    routes instead of whatever arrived last.  Same contract as
+    ``router_dispatch``; identical result when nothing overflows.
+    """
+    s, e = probs.shape
+    raw_probs, topk_idx = jax.lax.top_k(probs, cfg.top_k)    # [S, k]
+    norm = jnp.sum(raw_probs, axis=-1, keepdims=True)
+    renorm_probs = raw_probs / jnp.maximum(norm, 1e-9)
+
+    # Two assignment matrices over (token, expert): rank by the RAW gate
+    # probability (the router's confidence — renormalisation would make
+    # every top-1 weight 1.0 and destroy the ordering), combine with the
+    # renormalised weight (the usual mixture semantics).
+    rank = jnp.zeros((s, e), jnp.float32)
+    weight = jnp.zeros((s, e), jnp.float32)
+    for r in range(cfg.top_k):
+        onehot = jax.nn.one_hot(topk_idx[:, r], e, dtype=jnp.float32)
+        rank = rank + onehot * raw_probs[:, r, None]
+        weight = weight + onehot * renorm_probs[:, r, None]
+
+    vals, token_idx = jax.lax.top_k(rank.T, capacity)        # [E, C]
+    keep = (vals > 0.0).astype(jnp.float32)                  # real routes
+    w = jnp.take_along_axis(weight.T, token_idx, axis=1)     # [E, C]
+    # combine[s, e, c] = w[e, c] iff token_idx[e, c] == s and kept.
+    sel = jax.nn.one_hot(token_idx, s, dtype=jnp.float32)    # [E, C, S]
+    combine = jnp.einsum("ecs,ec->sec", sel, w * keep)
+
+    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+    return combine, aux
+
+
 def moe_mlp(moe: Params, x: jax.Array, cfg: MoEConfig
             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """[B, T, d] -> ([B, T, d], aux loss [], drop fraction []).  Two
@@ -186,7 +235,9 @@ def moe_mlp(moe: Params, x: jax.Array, cfg: MoEConfig
 
     gate_logits = xf.astype(jnp.float32) @ moe["router"]["w"]
     probs = jax.nn.softmax(gate_logits, axis=-1)
-    combine, aux = router_dispatch(probs, cfg, capacity)      # [S, E, C]
+    dispatch_fn = (router_dispatch_priority if cfg.dispatch == "priority"
+                   else router_dispatch)
+    combine, aux = dispatch_fn(probs, cfg, capacity)          # [S, E, C]
     dispatch = (combine > 0).astype(cfg.dtype)
     kept = jnp.sum((combine > 0).astype(jnp.float32))
     drop = 1.0 - kept / (s * cfg.top_k)

@@ -84,6 +84,27 @@ def _right_rotation(axis: str, size: int):
     return [(i, (i + 1) % size) for i in range(size)]
 
 
+def choose_num_microbatches(batch_size: int, num_stages: int,
+                            dp: int = 1) -> int:
+    """Auto schedule depth (``TrainingConfig.num_microbatches = 0``).
+
+    The bubble fraction (S-1)/(M+S-1) falls with M, so fixed global batch
+    wants M as large as the batch allows — measured on the 8-stage mesh
+    (experiments/pipeline_schedule_study): B=64 step time drops ~2.6x
+    from M=2 to M=16.  Past M ≈ 4·S the marginal bubble gain is < ~6 %
+    while per-tick battery/bookkeeping overhead keeps growing linearly
+    and per-microbatch arithmetic intensity falls (mb shrinks toward 1),
+    so the cap keeps the MXU fed.  M must divide the per-replica-row
+    batch B/dp so every microbatch is full.
+    """
+    per_row = max(batch_size // max(dp, 1), 1)
+    cap = min(per_row, 4 * num_stages)
+    for m in range(cap, 1, -1):
+        if per_row % m == 0:
+            return m
+    return 1
+
+
 def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
     """GPipe pipeline bubble: the idle fraction of the M + S - 1 tick
     schedule, (S-1)/(M+S-1).  The backward schedule is the AD transpose of
