@@ -153,7 +153,13 @@ def test_vision_data_poisoning_detected(tmp_path):
         detector_warmup=4, checkpoint_dir=str(tmp_path / "vp"),
     )
     trainer = DistributedTrainer(config)
-    dl = get_dataloader("cifar10", batch_size=32, num_examples=128)
+    # 16x16 synthetic frames: the detachment dynamics are identical
+    # (class-conditional Gaussians, global pooling) at ~1/4 the conv
+    # compute — this is the suite's single most expensive test
+    # (tests/BUDGET.md).  Measured at 16x16: node 3 detected at step 38
+    # with the data_poisoning label.
+    dl = get_dataloader("cifar10", batch_size=32, num_examples=128,
+                        image_size=16)
     trainer.initialize()
     attacker = AdversarialAttacker(AttackConfig(
         attack_types=["data_poisoning"], target_nodes=[3], intensity=1.0,

@@ -54,6 +54,11 @@ def test_router_dispatch_capacity_drops_tokens():
     np.testing.assert_allclose(kept[4:], 0.0)
 
 
+def test_dispatch_mode_validated():
+    with pytest.raises(ValueError, match="dispatch"):
+        MoEConfig(**TINY, dispatch="sorted")
+
+
 def test_priority_dispatch_matches_positional_without_overflow():
     """With capacity ample, priority dispatch routes exactly the same
     (token, expert, weight) set as GShard's positional claim — slot

@@ -231,12 +231,16 @@ def get_dataloader(
     seed: int = 0,
     data_dir: Optional[str] = None,
     sampling: str = "epoch",
+    image_size: Optional[int] = None,
 ) -> Any:
     """Reference signature (experiment_runner.py:100-110) with TPU-side
     extensions (seq_len/vocab_size for LM synthesis; ``sampling``:
     "epoch" partitions the stream into fixed shuffled windows,
     "windows" draws fresh random windows every batch — the nanoGPT-style
-    sampler via the native gather, better coverage on real corpora)."""
+    sampler via the native gather, better coverage on real corpora;
+    ``image_size``: side length for the SYNTHETIC vision tier — conv
+    models pool globally, so scenario tests can run on smaller frames
+    at a fraction of the compute; ignored for real .npz data)."""
     name = dataset_name.lower()
     if sampling not in ("epoch", "windows"):
         raise ValueError(
@@ -293,7 +297,8 @@ def get_dataloader(
                 "datasets use epoch sampling"
             )
         num_classes = 100 if "100" in name else (1000 if "imagenet" in name else 10)
-        shape = (224, 224, 3) if "imagenet" in name else (32, 32, 3)
+        side = image_size or (224 if "imagenet" in name else 32)
+        shape = (side, side, 3)
         n = num_examples or (2048 if split == "train" else 512)
         npz_path = os.path.join(data_dir, "cifar10", "cifar10.npz") if data_dir else ""
         if name.startswith("cifar10") and npz_path and os.path.exists(npz_path):

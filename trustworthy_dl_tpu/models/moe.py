@@ -85,6 +85,13 @@ class MoEConfig(gpt2.GPT2Config):
     #                  mass.  The TPU-friendly form of sorted dispatch.
     dispatch: str = "positional"
 
+    def __post_init__(self) -> None:
+        if self.dispatch not in ("positional", "priority"):
+            raise ValueError(
+                f"dispatch must be 'positional' or 'priority', got "
+                f"{self.dispatch!r}"
+            )
+
     @staticmethod
     def from_name(name: str, **overrides: Any) -> "MoEConfig":
         key = name.lower().replace("-moe", "")
@@ -145,6 +152,23 @@ def _capacity(num_tokens: int, cfg: MoEConfig) -> int:
     return max(4, min(int(c), num_tokens))
 
 
+def _topk_gating(probs: jax.Array, top_k: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared by both dispatchers: (raw top-k probs [S, k], renormalised
+    combine weights [S, k], expert indices [S, k])."""
+    raw_probs, topk_idx = jax.lax.top_k(probs, top_k)
+    norm = jnp.sum(raw_probs, axis=-1, keepdims=True)
+    return raw_probs, raw_probs / jnp.maximum(norm, 1e-9), topk_idx
+
+
+def _switch_aux_loss(probs: jax.Array, topk_idx: jax.Array) -> jax.Array:
+    """Switch load-balance aux on rank-0 assignments: E · Σ_e f_e · P̄_e
+    (=1 at perfect balance).  Shared so the dispatchers cannot drift."""
+    e = probs.shape[1]
+    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    return e * jnp.sum(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+
+
 def router_dispatch(
     probs: jax.Array, cfg: MoEConfig, capacity: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -155,9 +179,7 @@ def router_dispatch(
     dispatch mask is ``combine > 0``.
     """
     s, e = probs.shape
-    topk_probs, topk_idx = jax.lax.top_k(probs, cfg.top_k)   # [S, k]
-    norm = jnp.sum(topk_probs, axis=-1, keepdims=True)
-    topk_probs = topk_probs / jnp.maximum(norm, 1e-9)
+    _, topk_probs, topk_idx = _topk_gating(probs, cfg.top_k)
 
     combine = jnp.zeros((s, e, capacity), jnp.float32)
     counts = jnp.zeros((e,), jnp.int32)
@@ -172,12 +194,7 @@ def router_dispatch(
             within[..., None].astype(jnp.float32)
         counts = counts + jnp.sum(onehot, axis=0)
 
-    # Switch aux loss on rank-0 assignments: E · Σ_e f_e · P̄_e.
-    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
-    fraction = jnp.mean(top1, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(fraction * mean_prob)
-    return combine, aux
+    return combine, _switch_aux_loss(probs, topk_idx)
 
 
 def router_dispatch_priority(
@@ -193,9 +210,7 @@ def router_dispatch_priority(
     ``router_dispatch``; identical result when nothing overflows.
     """
     s, e = probs.shape
-    raw_probs, topk_idx = jax.lax.top_k(probs, cfg.top_k)    # [S, k]
-    norm = jnp.sum(raw_probs, axis=-1, keepdims=True)
-    renorm_probs = raw_probs / jnp.maximum(norm, 1e-9)
+    raw_probs, renorm_probs, topk_idx = _topk_gating(probs, cfg.top_k)
 
     # Two assignment matrices over (token, expert): rank by the RAW gate
     # probability (the router's confidence — renormalisation would make
@@ -214,10 +229,7 @@ def router_dispatch_priority(
     # combine[s, e, c] = w[e, c] iff token_idx[e, c] == s and kept.
     sel = jax.nn.one_hot(token_idx, s, dtype=jnp.float32)    # [E, C, S]
     combine = jnp.einsum("ecs,ec->sec", sel, w * keep)
-
-    top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
-    aux = e * jnp.sum(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
-    return combine, aux
+    return combine, _switch_aux_loss(probs, topk_idx)
 
 
 def moe_mlp(moe: Params, x: jax.Array, cfg: MoEConfig

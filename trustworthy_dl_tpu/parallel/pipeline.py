@@ -90,7 +90,7 @@ def choose_num_microbatches(batch_size: int, num_stages: int,
 
     The bubble fraction (S-1)/(M+S-1) falls with M, so fixed global batch
     wants M as large as the batch allows — measured on the 8-stage mesh
-    (experiments/pipeline_schedule_study): B=64 step time drops ~2.6x
+    (experiments/pipeline_schedule_study): B=64 step time drops 3.0x
     from M=2 to M=16.  Past M ≈ 4·S the marginal bubble gain is < ~6 %
     while per-tick battery/bookkeeping overhead keeps growing linearly
     and per-microbatch arithmetic intensity falls (mb shrinks toward 1),
