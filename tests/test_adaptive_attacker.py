@@ -138,6 +138,44 @@ def test_colluding_majority_inverts_the_verdict(shared_trainer):
     )
 
 
+def test_majority_collusion_raises_fleet_alarm(shared_trainer):
+    """The backstop for the 50 % blind spot: 4/8 colluders stay invisible
+    to the per-node gate (the median is poisoned), but the fleet MEDIAN
+    log-norm z-scored against its own history sees the surge — the
+    trainer records an UNATTRIBUTED fleet alert (no node evicted, no
+    honest node implicated)."""
+    trainer, _ = shared_trainer
+    history = _cell(shared_trainer,
+                    attack_types=["gradient_poisoning"],
+                    target_nodes=[0, 1, 2, 3], intensity=0.5, collude=True)
+    assert history == [], history  # per-node gate still blind (boundary)
+    assert trainer.fleet_alerts, "fleet alarm did not fire"
+    first = trainer.fleet_alerts[0]
+    assert first["step"] >= START
+    assert trainer.config.num_nodes == 8  # nobody evicted
+    stats = trainer.get_training_stats()
+    assert stats["fleet_alert_count"] == len(trainer.fleet_alerts)
+
+
+def test_fleet_alarm_silent_on_clean_run(shared_trainer):
+    trainer, dl = shared_trainer
+    trainer.reset_for_run(seed=0)
+    trainer.train_epoch(dl, 0)
+    assert trainer.fleet_alerts == []
+    assert trainer.attack_history == []
+
+
+def test_fleet_alarm_also_fires_on_inversion(shared_trainer):
+    """5/8 attackers: the per-node verdict inverts onto honest nodes
+    (documented failure), but the fleet alarm still reports that
+    SOMETHING fleet-wide is wrong — the operator gets a true signal even
+    when attribution is worse than useless."""
+    trainer, _ = shared_trainer
+    _cell(shared_trainer, attack_types=["gradient_poisoning"],
+          target_nodes=[0, 1, 2, 3, 4], intensity=0.5, collude=True)
+    assert trainer.fleet_alerts, "fleet alarm did not fire at 5/8"
+
+
 def test_independent_half_breaks_identically(shared_trainer):
     """Contrast cell: 4/8 attackers WITHOUT coordination are equally
     invisible.  The cross-sectional gate scores norm MAGNITUDE, and a

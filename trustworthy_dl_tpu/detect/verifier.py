@@ -80,6 +80,50 @@ def norm_suspicions(
     return warm & (z >= thr_eff)
 
 
+FLEET_WARMUP = 8
+FLEET_LATCH_LIMIT = 50  # forced absorption after this many raw steps
+
+
+def fleet_surge_update(
+    state: VerifierState,
+    median_norm: jax.Array,
+    raw_streak: jax.Array,
+    norm_z_threshold: float = DEFAULT_NORM_Z,
+) -> Tuple[jax.Array, VerifierState, jax.Array]:
+    """Fleet-level norm-surge verdict + absorption in one place, sharing
+    the per-node verifier's conventions (log-space, m2/count variance,
+    std>0 guard, small-sample threshold widening) so the two z-scores
+    stay comparable.
+
+    ``median_norm`` is f32[1] (the cross-sectional median gradient norm),
+    ``raw_streak`` i32[1] (consecutive raw-surge steps so far).  Returns
+    (raw bool[1], new_state, new_streak).
+
+    ONE-SIDED: only an UPWARD departure counts — attacks inflate norms,
+    while a clean run's norms decay downward as the loss falls, and a
+    two-sided test against a lagging Welford mean would latch on that
+    legitimate drift.
+
+    Absorption is clean-only (a surge must not drag its own baseline) —
+    BUT with an escape hatch: after ``FLEET_LATCH_LIMIT`` consecutive raw
+    steps the sample absorbs anyway, so a *persistent legitimate*
+    fleet-wide shift (LR-schedule bump, batch-regime change) re-baselines
+    after a bounded alarm window instead of freezing the z forever
+    (the starvation failure mode the per-node docstring above warns
+    about; the per-node path escapes via the cross-sectional gate, which
+    the fleet signal by construction cannot use)."""
+    log_m = _log_norm(median_norm)
+    cnt = state.count.astype(jnp.float32)
+    std = jnp.sqrt(state.m2 / jnp.maximum(cnt, 1.0))
+    z = jnp.where(std > 0, (log_m - state.mean) / std, 0.0)  # one-sided
+    thr_eff = norm_z_threshold * (1.0 + 8.0 / jnp.maximum(cnt, 1.0))
+    raw = (state.count >= FLEET_WARMUP) & (z >= thr_eff)
+    new_streak = jnp.where(raw, raw_streak + 1, 0)
+    absorb_mask = ~raw | (raw_streak >= FLEET_LATCH_LIMIT)
+    new_state = absorb_norms(state, median_norm, absorb_mask)
+    return raw, new_state, new_streak
+
+
 def absorb_norms(state: VerifierState, grad_norms: jax.Array,
                  mask: jax.Array) -> VerifierState:
     """Welford-absorb this step's log-norms where ``mask`` holds (the

@@ -34,7 +34,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
-from trustworthy_dl_tpu.engine.state import MonitorState, TrainState
+from trustworthy_dl_tpu.engine.state import MonitorState, TrainState, \
+    fleet_scalar_fields
 
 logger = logging.getLogger(__name__)
 
@@ -90,7 +91,8 @@ def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
     }
     shared = jax.tree_util.tree_map(
         lambda leaf: jax.device_put(leaf, repl),
-        {"step": state.step, "epoch": state.epoch, "rng": state.rng},
+        {"step": state.step, "epoch": state.epoch, "rng": state.rng,
+         **fleet_scalar_fields(state)},
     )
     if not place_params:
         return state._replace(**per_node, **shared)

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from trustworthy_dl_tpu.core.mesh import STAGE_AXIS, build_mesh
-from trustworthy_dl_tpu.engine.state import init_monitor_state
+from trustworthy_dl_tpu.engine.state import fleet_scalar_fields, \
+    init_monitor_state
 
 logger = logging.getLogger(__name__)
 
@@ -281,7 +282,8 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
                  for k, v in per_stage.items()}
     scalars = jax.tree_util.tree_map(
         lambda a: jax.device_put(a, repl),
-        {"step": state.step, "epoch": state.epoch, "rng": state.rng},
+        {"step": state.step, "epoch": state.epoch, "rng": state.rng,
+         **fleet_scalar_fields(state)},
     )
     new_state = state._replace(params=params, opt_state=opt_state,
                                **per_stage, **scalars)

@@ -90,6 +90,17 @@ class TrainState(NamedTuple):
     # COMPROMISED -> RECOVERING probation (trust_manager.py:198-206
     # semantics; config.recovery_probation_steps).
     clean_streak: Any = None
+    # Fleet-level norm-surge alarm (majority-attack backstop): Welford
+    # baseline (VerifierState, 1 row) of the cross-sectional MEDIAN
+    # log-norm, plus the consecutive raw-surge streak (i32[1]) driving
+    # the 2-step debounce AND the bounded-latch escape hatch
+    # (detect/verifier.py:fleet_surge_update).  The per-node median/MAD
+    # gate goes blind at >= 50 % contamination
+    # (tests/test_adaptive_attacker.py boundary); the fleet median's own
+    # temporal z still sees the surge, so the engine can raise an
+    # UNATTRIBUTED alarm instead of staying silent.
+    fleet_norm: Any = None
+    fleet_raw_streak: Any = None
 
 
 def init_train_state(
@@ -131,7 +142,22 @@ def init_train_state(
         rng=rng,
         canary=canary,
         clean_streak=jnp.zeros((num_nodes,), jnp.int32),
+        fleet_norm=init_verifier_state(1),
+        fleet_raw_streak=jnp.zeros((1,), jnp.int32),
     )
+
+
+def fleet_scalar_fields(state: TrainState) -> dict:
+    """The fleet-alarm state leaves that migrate like scalars (replicated)
+    — ONE definition shared by every placement/migration site
+    (trainer._place_on_mesh, elastic migrate_state, restaff) so a new
+    field can never be silently dropped by one of them."""
+    return {
+        k: v for k, v in dict(
+            fleet_norm=state.fleet_norm,
+            fleet_raw_streak=state.fleet_raw_streak,
+        ).items() if v is not None
+    }
 
 
 def zero1_place_opt_state(opt_state: Any, mesh: Any) -> Any:

@@ -35,7 +35,8 @@ from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.detect import stats as st
 from trustworthy_dl_tpu.detect.detector import Verdicts, anomaly_verdicts
-from trustworthy_dl_tpu.detect.verifier import absorb_norms, norm_suspicions
+from trustworthy_dl_tpu.detect.verifier import absorb_norms, \
+    fleet_surge_update, norm_suspicions
 from trustworthy_dl_tpu.engine.state import MonitorState, TrainState, \
     update_monitor
 from trustworthy_dl_tpu.models import layers as L
@@ -221,6 +222,10 @@ class StepMetrics(NamedTuple):
     # capacity — invisible in the loss on any single step).  Empty for
     # models that report none.
     model_aux: Dict[str, Array] = {}
+    # Fleet-level norm-surge alarm (bool[], debounced) — the
+    # majority-attack backstop; None when the step doesn't compute it
+    # (pipeline mode, verification off).
+    fleet_alert: Any = None
 
 
 def build_train_step(
@@ -402,6 +407,29 @@ def build_train_step(
         # the post-gate suspicion so a fleet-wide legitimate shift can
         # never zero every node's weight and stall training.
         verified = finite_b & ~norm_suspect
+
+        # 4b. Fleet-level norm-surge alarm (majority-attack backstop).
+        # The cross-sectional gate above deliberately clears suspicions
+        # every node shares — which also blinds it when >= 50 % of the
+        # fleet inflates norms together (the median itself is poisoned;
+        # boundary measured in tests/test_adaptive_attacker.py).  The
+        # MEDIAN log-norm z-scored against its OWN Welford history sees
+        # exactly that case: a fleet-wide 10x surge is steps, not drift.
+        # The alarm is UNATTRIBUTED (no node is gated or evicted by it —
+        # with a poisoned median there is no trustworthy attribution);
+        # the host surfaces it as a fleet incident for operator action.
+        # Clean-only absorption: surge steps never enter the baseline.
+        if verification and state.fleet_norm is not None:
+            fleet_median = jnp.median(global_norms)[None]        # f32[1]
+            _, new_fleet_norm, new_fleet_streak = fleet_surge_update(
+                state.fleet_norm, fleet_median, state.fleet_raw_streak
+            )
+            # 2-step debounce, same spirit as the per-node verdicts.
+            fleet_alert = (new_fleet_streak >= 2)[0]
+        else:
+            fleet_alert = None
+            new_fleet_norm = state.fleet_norm
+            new_fleet_streak = state.fleet_raw_streak
 
         # 5. Detector verdicts (attack_detector.py:71-141), plus the
         # Byzantine cross-node check (:143-162) and consensus-KL backdoor
@@ -604,6 +632,8 @@ def build_train_step(
             epoch=state.epoch,
             rng=rng,
             clean_streak=clean_streak,
+            fleet_norm=new_fleet_norm,
+            fleet_raw_streak=new_fleet_streak,
         )
         metrics = StepMetrics(
             loss=loss,
@@ -624,6 +654,7 @@ def build_train_step(
             out_stats=out_stats,
             grad_stats=grad_stats,
             model_aux=model_aux,
+            fleet_alert=fleet_alert,
         )
         return new_state, metrics
 

@@ -41,7 +41,8 @@ from trustworthy_dl_tpu.detect.stats import (
 from trustworthy_dl_tpu.detect.verifier import GradientVerifier
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
-from trustworthy_dl_tpu.engine.state import TrainState, init_train_state, \
+from trustworthy_dl_tpu.engine.state import TrainState, \
+    fleet_scalar_fields, init_train_state, \
     zero1_place_opt_state
 from trustworthy_dl_tpu.engine.step import StepMetrics, \
     build_node_eval_step, \
@@ -212,6 +213,11 @@ class DistributedTrainer:
 
         self.attack_history: List[Dict] = []
         self.reassignment_history: List[Dict] = []
+        # Fleet-level norm-surge episodes (unattributed majority-attack
+        # alarms) — separate from attack_history, whose records name a
+        # node and feed per-node precision/recall accounting.
+        self.fleet_alerts: List[Dict] = []
+        self._fleet_alarm_open = False
         # Epoch-cadence ML-tier verdicts (original node id -> bool).
         self.ml_flags: Dict[int, bool] = {}
         # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
@@ -380,7 +386,8 @@ class DistributedTrainer:
         }
         scalars = jax.tree_util.tree_map(
             lambda l: jax.device_put(l, repl),
-            {"step": state.step, "epoch": state.epoch, "rng": state.rng},
+            {"step": state.step, "epoch": state.epoch, "rng": state.rng,
+             **fleet_scalar_fields(state)},
         )
         return state._replace(**placed, **shared, **scalars)
 
@@ -656,6 +663,34 @@ class DistributedTrainer:
                 self.attack_detector.gradient_history[orig].append(
                     {"stats": dict(zip(GRADIENT_STAT_NAMES, grad_stats[coord]))}
                 )
+
+        # Fleet-level norm-surge alarm (majority-attack backstop): the
+        # in-step verdict is unattributed — with >= 50 % of the fleet
+        # poisoning together the median itself lies, so no node is gated
+        # or evicted; the episode is recorded for operator action and the
+        # training-state machine flips to UNDER_ATTACK.
+        fleet_alert = getattr(metrics, "fleet_alert", None)
+        if fleet_alert is not None:
+            if bool(np.asarray(fleet_alert)):
+                if not self._fleet_alarm_open:
+                    self._fleet_alarm_open = True
+                    self.fleet_alerts.append({
+                        "step": self.global_step,
+                        "epoch": epoch,
+                        "median_grad_norm": float(
+                            np.median(np.asarray(metrics.grad_norm))
+                        ),
+                    })
+                    logger.error(
+                        "FLEET-LEVEL norm surge at step %d: the "
+                        "cross-sectional median gradient norm departed "
+                        "its own history — consistent with a "
+                        "majority/coordinated attack the per-node gate "
+                        "cannot attribute", self.global_step,
+                    )
+                    self.training_state = TrainingState.UNDER_ATTACK
+            else:
+                self._fleet_alarm_open = False
 
         # Host incidents fire only on confirmed evidence: debounced verdicts
         # (metrics.attacked already folds in sustained norm-verification
@@ -1017,6 +1052,7 @@ class DistributedTrainer:
             },
             "attack_count": len(self.attack_history),
             "reassignment_count": len(self.reassignment_history),
+            "fleet_alert_count": len(self.fleet_alerts),
             "metrics": self.metrics_collector.get_summary(),
             "trust_threshold": self.trust_manager.trust_threshold,
             "ml_flags": dict(self.ml_flags),

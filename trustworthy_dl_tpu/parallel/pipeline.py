@@ -607,6 +607,12 @@ def build_pipeline_train_step(
             rng=rng,
             canary=canary_state,
             clean_streak=clean_streak,
+            # Fleet norm-surge state passes through untouched: the alarm
+            # is a data-mode construct (pipeline stages compute different
+            # layers, so a cross-stage norm median is meaningless; the
+            # canary probe is this mode's fleet-level check).
+            fleet_norm=state.fleet_norm,
+            fleet_raw_streak=state.fleet_raw_streak,
         )
         metrics = StepMetrics(
             loss=loss,
