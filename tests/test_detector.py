@@ -319,3 +319,55 @@ def test_combine_microbatch_stats_order_reducers():
     assert out[STAT_INDEX["norm_inf"]] == 40.0
     assert out[STAT_INDEX["mean"]] == pytest.approx(2.0)   # mean elsewhere
     assert out[STAT_INDEX["norm_l2"]] == pytest.approx(2.0)
+
+
+def test_fleet_surge_update_unit():
+    """Fleet norm-surge math (detect/verifier.py:fleet_surge_update):
+    one-sided verdict, clean-only absorption, streak bookkeeping, and the
+    bounded-latch escape hatch that re-baselines a persistent legitimate
+    shift after FLEET_LATCH_LIMIT raw steps."""
+    from trustworthy_dl_tpu.detect.verifier import (
+        FLEET_LATCH_LIMIT,
+        fleet_surge_update,
+        init_verifier_state,
+    )
+
+    state = init_verifier_state(1)
+    streak = jnp.zeros((1,), jnp.int32)
+    # Warm the baseline with jittery clean samples around norm 1.0.
+    rng = np.random.default_rng(0)
+    for _ in range(12):
+        sample = jnp.asarray([1.0 + 0.05 * rng.standard_normal()],
+                             jnp.float32)
+        raw, state, streak = fleet_surge_update(state, sample, streak)
+        assert not bool(raw[0])
+    warm_count = int(state.count[0])
+    assert warm_count == 12  # every clean sample absorbed
+
+    # Upward surge (x20): raw fires, streak counts, baseline FROZEN.
+    surge = jnp.asarray([20.0], jnp.float32)
+    for expect_streak in (1, 2, 3):
+        raw, state, streak = fleet_surge_update(state, surge, streak)
+        assert bool(raw[0])
+        assert int(streak[0]) == expect_streak
+    assert int(state.count[0]) == warm_count  # clean-only absorption
+
+    # One-sided: a DOWNWARD departure of the same magnitude is clean
+    # (clean-run norm decay must not alarm) and resets the streak.
+    raw, state, streak = fleet_surge_update(
+        state, jnp.asarray([0.05], jnp.float32), streak
+    )
+    assert not bool(raw[0]) and int(streak[0]) == 0
+    assert int(state.count[0]) == warm_count + 1  # absorbed
+
+    # Bounded latch: a PERSISTENT shift alarms for FLEET_LATCH_LIMIT
+    # steps, then forced absorption re-baselines and the alarm clears.
+    absorbed_during_latch = 0
+    for _ in range(FLEET_LATCH_LIMIT + 60):
+        before = int(state.count[0])
+        raw, state, streak = fleet_surge_update(state, surge, streak)
+        absorbed_during_latch += int(state.count[0]) - before
+        if not bool(raw[0]):
+            break
+    assert absorbed_during_latch > 0, "latch escape never absorbed"
+    assert not bool(raw[0]), "alarm never cleared after re-baselining"
