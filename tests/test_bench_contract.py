@@ -18,23 +18,92 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def test_bench_emits_skip_json_when_backend_unavailable():
+def _run_bench(extra_env, timeout=300):
     env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "bogus",        # unknown backend → init raises
-        "PALLAS_AXON_POOL_IPS": "",      # keep the axon hook out of the way
-        "TDDL_BENCH_RETRY_SLEEP": "0",   # don't wait out the real backoff
-    })
-    proc = subprocess.run(
+    env.update(extra_env)
+    return subprocess.run(
         [sys.executable, str(REPO / "bench.py")],
-        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
     )
+
+
+def _single_json_line(proc):
     assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, proc.stdout
     rec = json.loads(lines[0])
-    assert rec["skipped"] is True
-    assert "backend unavailable" in rec["reason"]
     # The driver's parser expects these keys on every record.
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in rec
+    return rec
+
+
+def test_bench_emits_skip_json_when_backend_unavailable():
+    proc = _run_bench({
+        "JAX_PLATFORMS": "bogus",        # unknown backend → init raises
+        "PALLAS_AXON_POOL_IPS": "",      # keep the axon hook out of the way
+        "TDDL_BENCH_RETRY_SLEEP": "0",   # don't wait out the real backoff
+    })
+    rec = _single_json_line(proc)
+    assert rec["skipped"] is True
+    assert "backend unavailable" in rec["reason"]
+
+
+def test_bench_serve_leg_keeps_skip_contract():
+    """The serve leg rides the same one-line contract: with it enabled and
+    the backend dead, bench still emits exactly one skip JSON at rc 0."""
+    proc = _run_bench({
+        "JAX_PLATFORMS": "bogus",
+        "PALLAS_AXON_POOL_IPS": "",
+        "TDDL_BENCH_RETRY_SLEEP": "0",
+        "TDDL_BENCH_SERVE": "1",
+    })
+    rec = _single_json_line(proc)
+    assert rec["skipped"] is True
+
+
+def test_bench_watchdog_kills_wedged_body():
+    """Post-probe wedge regression (bench.py watchdog): a backend that
+    answers the liveness probe but hangs inside the measured body must
+    still produce the one-line skip JSON at rc 0 — the body runs in a
+    subprocess under a hard wall-clock limit.  TDDL_BENCH_FAKE_WEDGE is
+    the test hook simulating the hang."""
+    proc = _run_bench({
+        "JAX_PLATFORMS": "cpu",          # probe succeeds on the host
+        "PALLAS_AXON_POOL_IPS": "",
+        "TDDL_NO_REEXEC": "1",
+        "TDDL_BENCH_RETRY_SLEEP": "0",
+        "TDDL_BENCH_FAKE_WEDGE": "1",
+        "TDDL_BENCH_WATCHDOG": "3",
+    }, timeout=300)
+    rec = _single_json_line(proc)
+    assert rec["skipped"] is True
+    assert "watchdog" in rec["reason"]
+
+
+def test_bench_serve_sweep_records(monkeypatch):
+    """bench_serve's offered-load sweep on a tiny model: per-rate records
+    carry the throughput/latency keys the JSON contract publishes."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_SERVE_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_REQUESTS", "5")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_NEW", "4")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_RATES", "100")
+    records = bench.bench_serve()
+    assert len(records) == 1
+    row = records[0]
+    for key in ("offered_rps", "tokens_per_s", "itl_p50_ms", "itl_p99_ms",
+                "ttft_p50_ms", "completed", "shed"):
+        assert key in row, row
+    assert row["completed"] + row["shed"] == 5
+    assert row["tokens_per_s"] > 0

@@ -100,6 +100,40 @@ def test_priority_dispatch_sheds_lowest_probability_routes():
     assert dropped_pri <= dropped_pos + 1e-6
 
 
+def test_priority_dispatch_tiny_batch_regression():
+    """b*t < 4 regression: _capacity's floor of 4 used to exceed the token
+    count, and priority dispatch's ``lax.top_k(rank.T, capacity)`` trace-
+    crashed on the [E, S] operand (S=2 < k=4) where positional dispatch
+    survived.  The num_tokens clamp now applies AFTER the floor, so both
+    dispatchers run and agree on tiny batches (capacity >= S => nothing
+    can overflow)."""
+    from trustworthy_dl_tpu.models.moe import _capacity
+
+    cfg = MoEConfig(**TINY, n_experts=4, top_k=2, dispatch="priority")
+    assert _capacity(2, cfg) == 2          # clamped to the token count
+    assert _capacity(64, cfg) >= 4         # large-batch floor untouched
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, TINY["n_embd"]),
+                          jnp.float32)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    moe_block = jax.tree_util.tree_map(lambda l: l[0],
+                                       params["blocks"])["moe"]
+    y_pri, aux_pri, drop_pri = moe_mlp(moe_block, x, cfg)
+    assert np.all(np.isfinite(np.asarray(y_pri)))
+    cfg_pos = MoEConfig(**TINY, n_experts=4, top_k=2, dispatch="positional")
+    y_pos, aux_pos, drop_pos = moe_mlp(moe_block, x, cfg_pos)
+    # With capacity == num_tokens nothing overflows: the two dispatchers
+    # are the same routing, so outputs agree.
+    np.testing.assert_allclose(np.asarray(y_pri), np.asarray(y_pos),
+                               atol=1e-5)
+    assert float(drop_pri) == pytest.approx(0.0, abs=1e-6)
+    # And the whole tiny-batch LM trains (the original crash repro shape).
+    toks = jax.random.randint(jax.random.PRNGKey(2), (1, 2), 0,
+                              TINY["vocab_size"])
+    batch = {"input": toks, "target": jnp.roll(toks, -1, -1)}
+    loss = loss_fn(params, batch, cfg)
+    assert np.isfinite(float(loss))
+
+
 def test_priority_dispatch_trains_end_to_end():
     cfg = MoEConfig(**TINY, n_experts=4, top_k=2, dispatch="priority")
     params = init_params(jax.random.PRNGKey(0), cfg)

@@ -43,8 +43,33 @@ def test_choose_num_microbatches():
     assert choose_num_microbatches(8, 8) == 8            # batch-bound
     assert choose_num_microbatches(12, 8) == 12          # divisor rule
     assert choose_num_microbatches(64, 8, dp=2) == 32    # per-row batch
-    assert choose_num_microbatches(7, 8) == 7            # prime batch
+    assert choose_num_microbatches(7, 8) == 7            # prime <= cap
     assert choose_num_microbatches(1, 8) == 1
+
+
+def test_choose_num_microbatches_trim_tolerant_fallback(caplog):
+    """Degenerate-batch regression: a per-row batch with no divisor <= cap
+    used to fall back to M=1 silently (~88 % bubble at S=8).  Now the
+    fallback maximises the utilised batch over M in [2, cap] (ties to the
+    larger M) and logs the degradation."""
+    import logging
+
+    from trustworthy_dl_tpu.parallel.pipeline import choose_num_microbatches
+
+    with caplog.at_level(logging.WARNING,
+                         logger="trustworthy_dl_tpu.parallel.pipeline"):
+        # per_row=13, S=2 -> cap 8, no divisor; utilised 12/13 at M∈
+        # {2,3,4,6}, tie resolved to the deepest schedule M=6.
+        assert choose_num_microbatches(13, 2) == 6
+    assert any("trim-tolerant" in r.message for r in caplog.records)
+    # per_row=17, S=2 -> cap 8: M=8 utilises 16/17 (unique maximum).
+    assert choose_num_microbatches(17, 2) == 8
+    # Prime above cap at S=8: 13 -> cap 13 has the exact divisor 13.
+    assert choose_num_microbatches(13, 8) == 13
+    # Huge prime, S=8 -> cap 32: M=32 utilises 96/97.
+    assert choose_num_microbatches(97, 8) == 32
+    # M=1 remains only for genuinely unsplittable batches.
+    assert choose_num_microbatches(2, 8, dp=2) == 1
 
 
 def test_auto_microbatches_resolved_at_build(tmp_path):

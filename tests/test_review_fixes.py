@@ -144,3 +144,90 @@ def test_attack_from_step_zero_is_caught_and_gated():
         gated_from_first_scored_step
     flagged = {rec["node_id"] for rec in trainer.attack_history}
     assert 1 in flagged, trainer.attack_history[:3]
+
+
+def test_step_metrics_model_aux_default_is_none_sentinel():
+    """StepMetrics.model_aux used a mutable {} literal as its NamedTuple
+    default — ONE dict instance shared by every StepMetrics constructed
+    without the field (pipeline mode), so an in-place mutation by any
+    consumer leaked across steps and trainers.  The default is now a None
+    sentinel, normalised at read sites."""
+    from trustworthy_dl_tpu.engine.step import StepMetrics
+
+    assert StepMetrics._field_defaults["model_aux"] is None
+    zeros = {f: jnp.zeros(()) for f in StepMetrics._fields
+             if f not in ("model_aux", "fleet_alert")}
+    a = StepMetrics(**zeros)
+    b = StepMetrics(**zeros)
+    assert a.model_aux is None and b.model_aux is None
+    # The read-site normalisation pattern yields INDEPENDENT dicts.
+    na, nb = a.model_aux or {}, b.model_aux or {}
+    na["leak"] = 1.0
+    assert "leak" not in nb
+    # Explicitly-passed diagnostics still round-trip.
+    c = StepMetrics(**zeros, model_aux={"moe_drop_fraction": jnp.ones(())})
+    assert "moe_drop_fraction" in c.model_aux
+
+
+def test_fleet_surge_latch_marks_episode_absorbed_while_raw():
+    """Sustained-surge regression (detect/verifier): when the fleet
+    norm-surge alarm closes because FLEET_LATCH_LIMIT forced the baseline
+    to absorb the (still ongoing) surge, the host episode must say so —
+    operators need to distinguish 'norms recovered' from 'surge absorbed
+    at the latch limit'."""
+    from trustworthy_dl_tpu.detect.verifier import (
+        FLEET_LATCH_LIMIT,
+        FleetEpisodeTracker,
+        fleet_surge_update,
+        init_verifier_state,
+    )
+
+    def run_episode(surge_steps, post_value):
+        state = init_verifier_state(1)
+        streak = jnp.zeros((1,), jnp.int32)
+        tracker = FleetEpisodeTracker()
+        step = 0
+
+        def feed(value):
+            nonlocal state, streak, step
+            raw, state, streak = fleet_surge_update(
+                state, jnp.asarray([value]), streak)
+            # The engine's 2-step debounce on the raw streak.
+            tracker.update(bool(int(streak[0]) >= 2), int(streak[0]), step)
+            step += 1
+            return bool(raw[0])
+
+        # Warm the Welford baseline on stable-but-jittered norms (exactly
+        # constant values give std=0, which the z guard treats as unscored).
+        warm_rng = np.random.default_rng(0)
+        for _ in range(12):
+            assert not feed(float(warm_rng.normal(1.0, 0.05)))
+        # Surge 1000x; stop as soon as the episode closes (sustained case:
+        # forced absorption re-anchors the baseline mid-surge).
+        opened = False
+        for _ in range(surge_steps):
+            feed(1000.0)
+            opened = opened or tracker.alarm_open
+            if opened and not tracker.alarm_open:
+                break
+        # Post-surge feed until the alarm closes (short-surge recovery).
+        for _ in range(300):
+            if opened and not tracker.alarm_open:
+                break
+            feed(post_value)
+            opened = opened or tracker.alarm_open
+        assert opened, "surge never raised the alarm"
+        assert not tracker.alarm_open, "episode never closed"
+        assert len(tracker.episodes) == 1
+        return tracker.episodes[0]
+
+    # Short surge, then clean norms: the alarm closes as a recovery.
+    short = run_episode(surge_steps=6, post_value=1.0)
+    assert short["resolution"] == "recovered"
+    assert short["peak_raw_streak"] < FLEET_LATCH_LIMIT
+
+    # Sustained surge: the alarm only closes once FLEET_LATCH_LIMIT forces
+    # the baseline to absorb the still-live surge — flagged as such.
+    sustained = run_episode(surge_steps=300, post_value=1000.0)
+    assert sustained["resolution"] == "absorbed-while-raw"
+    assert sustained["peak_raw_streak"] >= FLEET_LATCH_LIMIT

@@ -1,0 +1,247 @@
+"""Serving engine (trustworthy_dl_tpu/serve): continuous batching over the
+slotted KV cache, pinned against models/generate.py numerics.
+
+Fast tier: host-side contracts (slot allocator, buckets, backpressure,
+output-monitor math, sampling-key layout) — nothing jits a model.
+Slow tier (@pytest.mark.slow): jitted smoke tests, including THE acceptance
+scenario — >= 8 concurrent heterogeneous requests through fewer slots with
+mid-flight retirement, the decode step compiled exactly once, and streamed
+tokens bit-identical to batch generate for the same params/keys."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models.generate import generate
+from trustworthy_dl_tpu.serve import (
+    OutputMonitor,
+    ServeRequest,
+    ServingEngine,
+    SlotAllocator,
+    choose_bucket,
+    default_buckets,
+)
+from trustworthy_dl_tpu.serve.scheduler import request_key_stream
+
+CFG = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2, n_embd=32,
+                      n_head=4, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(jax.random.PRNGKey(0), CFG)
+
+
+# --------------------------------------------------------------------------
+# Fast tier: host-side contracts
+# --------------------------------------------------------------------------
+
+
+def test_slot_allocator_lifecycle():
+    alloc = SlotAllocator(3)
+    slots = [alloc.alloc() for _ in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert alloc.alloc() is None          # exhausted, not an error
+    alloc.free(slots[0])
+    assert alloc.free_count == 1
+    with pytest.raises(ValueError):
+        alloc.free(slots[0])              # double free
+    # Quarantine shrinks the serviceable pool and survives free().
+    s = alloc.alloc()
+    alloc.quarantine(s)
+    alloc.free(s)                         # no-op on a quarantined slot
+    assert s not in [alloc.alloc() for _ in range(alloc.free_count)]
+    assert alloc.capacity == 2
+    alloc.release(s)
+    assert alloc.capacity == 3 and alloc.free_count == 1
+
+
+def test_prefill_buckets():
+    assert default_buckets(48) == (16, 32, 48)
+    assert default_buckets(16) == (16,)
+    assert choose_bucket((16, 32, 48), 1) == 16
+    assert choose_bucket((16, 32, 48), 17) == 32
+    assert choose_bucket((16, 32, 48), 48) == 48
+    with pytest.raises(ValueError):
+        choose_bucket((16, 32), 33)
+
+
+def test_backpressure_and_validation(params):
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           queue_limit=2)
+    ok = [engine.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2))
+          for _ in range(3)]
+    assert ok[0] is not None and ok[1] is not None
+    assert ok[2] is None                  # queue full -> shed, not raise
+    assert engine.rejected == 1
+    with pytest.raises(ValueError):
+        engine.submit(ServeRequest(prompt=[], max_new_tokens=1))
+    with pytest.raises(ValueError):      # can never fit the slot depth
+        engine.submit(ServeRequest(prompt=[1] * 30, max_new_tokens=10))
+    # Custom (sub-max_seq) buckets: an unprefillable prompt is rejected at
+    # submit, not crashed on (and slot-leaked) at admission.
+    tight = ServingEngine(params, CFG, max_slots=2, max_seq=48,
+                          buckets=(16,))
+    with pytest.raises(ValueError, match="bucket"):
+        tight.submit(ServeRequest(prompt=[1] * 20, max_new_tokens=2))
+    assert tight.scheduler.allocator.free_count == 2  # nothing leaked
+
+
+def test_request_key_stream_matches_generate_layout():
+    """Serving key streams replicate generate's rng consumption: token 0
+    from the request key, token i from split(fold_in(key, 1), n-1)[i-1]."""
+    key = jax.random.PRNGKey(11)
+    stream = request_key_stream(key, 5)
+    assert stream.shape == (5, 2)
+    np.testing.assert_array_equal(stream[0], np.asarray(key, np.uint32))
+    ref = np.asarray(jax.random.split(jax.random.fold_in(key, 1), 4),
+                     np.uint32)
+    np.testing.assert_array_equal(stream[1:], ref)
+    assert request_key_stream(key, 1).shape == (1, 2)
+
+
+def test_output_monitor_flags_outlier_and_does_not_absorb():
+    mon = OutputMonitor(window=64, warmup=8, z_threshold=4.0)
+    rng = np.random.default_rng(0)
+    for _ in range(16):
+        flagged, _ = mon.observe(rng.normal(3.0, 0.05, 8),
+                                 rng.normal(1.0, 0.05, 8))
+        assert not flagged
+    before = mon.count
+    flagged, z = mon.observe([0.01] * 8, [25.0] * 8)  # collapse signature
+    assert flagged and z > 4.0
+    assert mon.count == before            # flagged request NOT absorbed
+    # Clean requests keep absorbing afterwards.
+    flagged, _ = mon.observe(rng.normal(3.0, 0.05, 8),
+                             rng.normal(1.0, 0.05, 8))
+    assert not flagged and mon.count == before + 1
+
+
+# --------------------------------------------------------------------------
+# Slow tier: jitted smoke tests
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serving_smoke_matches_generate(params):
+    """THE acceptance scenario: 9 concurrent requests with heterogeneous
+    prompt/output lengths through 3 slots — continuous batching admits and
+    retires mid-flight (slot count < request count forces reuse), the
+    fused decode step compiles exactly once, and every request's streamed
+    tokens are bit-identical to models/generate.py for the same params."""
+    engine = ServingEngine(params, CFG, max_slots=3, max_seq=48,
+                           queue_limit=32)
+    cache_before = engine.scheduler.decode_cache_size()
+    rng = np.random.default_rng(0)
+    streamed = {}
+    reqs = []
+    for i in range(9):
+        plen = int(rng.integers(3, 12))
+        new = int(rng.integers(1, 9))
+        prompt = rng.integers(0, CFG.vocab_size, plen).tolist()
+        reqs.append((prompt, new))
+        rid = engine.submit(ServeRequest(
+            prompt=prompt, max_new_tokens=new,
+            on_token=lambda r, t: streamed.setdefault(r, []).append(t),
+        ))
+        assert rid == i
+    results = engine.run_until_idle()
+
+    assert len(results) == 9
+    assert all(r.status == "completed" for r in results.values())
+    # One compiled decode program for the whole heterogeneous run.
+    assert engine.scheduler.decode_cache_size() - cache_before == 1
+    # Slot reuse actually happened: 9 sequences through a 3-slot pool.
+    assert engine.scheduler.allocator.max_slots == 3
+
+    for rid, (prompt, new) in enumerate(reqs):
+        ref = generate(params, CFG, jnp.asarray([prompt], jnp.int32), new,
+                       temperature=0.0)
+        ref_tokens = np.asarray(ref)[0, len(prompt):].tolist()
+        assert results[rid].tokens == ref_tokens, f"request {rid}"
+        assert streamed[rid] == ref_tokens  # streaming saw the same tokens
+        assert len(results[rid].itl_s) == new - 1
+        assert results[rid].ttft_s is not None
+
+    summary = engine.metrics_summary()
+    assert summary["requests_completed"] == 9
+    assert summary["tokens_emitted"] == sum(n for _, n in reqs)
+
+
+@pytest.mark.slow
+def test_sampled_request_matches_generate_stream(params):
+    """A temperature-sampled request reproduces generate() token-for-token
+    under the same key — the per-slot key stream is generate's stream."""
+    prompt = [5, 17, 3, 88, 41]
+    key = jax.random.PRNGKey(7)
+    ref = np.asarray(generate(params, CFG, jnp.asarray([prompt], jnp.int32),
+                              8, temperature=0.8, rng=key))[0, 5:].tolist()
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=48)
+    rid = engine.submit(ServeRequest(prompt=prompt, max_new_tokens=8,
+                                     temperature=0.8, rng=key))
+    assert engine.run_until_idle()[rid].tokens == ref
+
+
+@pytest.mark.slow
+def test_eos_retires_mid_flight(params):
+    """eos_id stops a sequence early — the slot frees before max_new."""
+    prompt = [9, 4, 33]
+    ref = np.asarray(generate(params, CFG, jnp.asarray([prompt], jnp.int32),
+                              6, temperature=0.0))[0, 3:].tolist()
+    # First position at which the greedy stream emits ref[0] again — with a
+    # repetitive random-init model that can be position 0 (stop after one
+    # token); the invariant under test is stop-at-FIRST-eos, whatever the
+    # stream looks like.
+    eos = ref[0]
+    stop = ref.index(eos) + 1
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=48)
+    rid = engine.submit(ServeRequest(prompt=prompt, max_new_tokens=6,
+                                     eos_id=eos))
+    result = engine.run_until_idle()[rid]
+    assert result.status == "completed"
+    assert result.tokens == ref[:stop]    # stopped AT the eos token
+    assert len(result.tokens) < 6         # genuinely early
+    assert engine.scheduler.allocator.free_count == 2  # slot returned
+
+
+@pytest.mark.slow
+def test_deadline_sheds_queued_requests(params):
+    """An already-expired deadline retires the request before admission."""
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=48)
+    rid_ok = engine.submit(ServeRequest(prompt=[1, 2, 3],
+                                        max_new_tokens=2))
+    rid_late = engine.submit(ServeRequest(prompt=[4, 5, 6],
+                                          max_new_tokens=2,
+                                          deadline_s=0.0))
+    results = engine.run_until_idle()
+    assert results[rid_ok].status == "completed"
+    assert results[rid_late].status == "deadline_exceeded"
+    assert results[rid_late].tokens == []
+
+
+@pytest.mark.slow
+def test_flagged_request_quarantines_slot(params):
+    """A monitor-flagged generation quarantines its slot; with every slot
+    quarantined the engine sheds the queue as no_capacity instead of
+    spinning."""
+
+    class FlagAll:
+        def observe(self, entropies, margins):
+            return True, 99.0
+
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=48,
+                           monitor=FlagAll())
+    rids = [engine.submit(ServeRequest(prompt=[i + 1, i + 2],
+                                       max_new_tokens=2))
+            for i in range(3)]
+    results = engine.run_until_idle()
+    assert results[rids[0]].flagged and results[rids[1]].flagged
+    assert engine.quarantined_slots == {0, 1}
+    assert results[rids[2]].status == "no_capacity"
+    # Operator releases a slot -> service resumes.
+    engine.release_quarantine(0)
+    rid = engine.submit(ServeRequest(prompt=[7, 8], max_new_tokens=2))
+    assert engine.run_until_idle()[rid].tokens  # served

@@ -39,6 +39,9 @@ _EXPORTS = {
     "AdversarialAttacker": "trustworthy_dl_tpu.attacks.adversarial",
     "ExperimentRunner": "trustworthy_dl_tpu.experiments.runner",
     "generate": "trustworthy_dl_tpu.models.generate",
+    "ServingEngine": "trustworthy_dl_tpu.serve.engine",
+    "ServeRequest": "trustworthy_dl_tpu.serve.engine",
+    "ServeResult": "trustworthy_dl_tpu.serve.engine",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -216,6 +216,134 @@ def generate_main(argv: Optional[List[str]] = None,
     return 0
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trustworthy-dl-serve",
+        description="Serve a GPT-2 checkpoint with the continuous-batching "
+                    "engine (slotted KV cache, iteration-level scheduling, "
+                    "trust-aware output monitoring).  Drives a synthetic "
+                    "heterogeneous workload and prints serving metrics — "
+                    "the smoke-deployment mode; hook ServingEngine.submit "
+                    "into a real frontend for production traffic.",
+    )
+    parser.add_argument("--model", type=str, default="gpt2")
+    parser.add_argument("--checkpoint-dir", type=str, default="checkpoints",
+                        help="restore the latest checkpoint from here "
+                             "(falls back to fresh init with a warning)")
+    parser.add_argument("--max-slots", type=int, default=8,
+                        help="concurrent sequences resident in the KV pool")
+    parser.add_argument("--max-seq", type=int, default=256,
+                        help="KV slot depth (prompt + generated tokens)")
+    parser.add_argument("--queue-limit", type=int, default=64,
+                        help="admission-queue bound (backpressure beyond)")
+    parser.add_argument("--num-requests", type=int, default=32,
+                        help="synthetic workload size")
+    parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--prompt-len", type=int, default=16,
+                        help="mean synthetic prompt length (lengths vary "
+                             "around it — heterogeneity is the point)")
+    parser.add_argument("--temperature", type=float, default=0.8)
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-request wall-clock deadline")
+    parser.add_argument("--no-monitor", action="store_true",
+                        help="disable the trust-aware output monitor")
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def serve_main(argv: Optional[List[str]] = None,
+               model_overrides: Optional[dict] = None) -> int:
+    """Console entry point ``trustworthy-dl-serve``.
+
+    Same checkpoint handling as ``trustworthy-dl-generate`` (dense GPT-2
+    family; pipeline-stacked checkpoints refused with a clear message);
+    ``model_overrides`` is the tests' shrink hook."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
+    from trustworthy_dl_tpu.engine.trainer import DistributedTrainer
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+
+    args = build_serve_parser().parse_args(argv)
+    if not args.model.startswith("gpt") or args.model.endswith("-moe"):
+        print("serving supports the dense GPT-2 family")
+        return 2
+    probe = CheckpointManager(args.checkpoint_dir)
+    latest = probe.latest_step()
+    if latest is not None:
+        meta = probe.load_metadata(latest) or {}
+        if meta.get("parallelism") == "model":
+            print("checkpoint was trained with pipeline (stage) "
+                  "parallelism; serving needs a data-parallel checkpoint "
+                  "(params stage-stacked)")
+            return 2
+    config = TrainingConfig(model_name=args.model, num_nodes=1, batch_size=1,
+                            checkpoint_dir=args.checkpoint_dir)
+    trainer = DistributedTrainer(config, model_overrides=model_overrides)
+    cfg = trainer.model.config
+    if args.max_seq > cfg.n_positions:
+        print(f"--max-seq {args.max_seq} exceeds the model's "
+              f"n_positions={cfg.n_positions}")
+        return 2
+    if args.prompt_len + args.max_new_tokens > args.max_seq:
+        print(f"--prompt-len + --max-new-tokens = "
+              f"{args.prompt_len + args.max_new_tokens} exceeds "
+              f"--max-seq {args.max_seq}")
+        return 2
+    trainer.initialize()
+    try:
+        trainer.load_checkpoint()
+        print(f"restored step {int(trainer.state.step)} "
+              f"from {args.checkpoint_dir}")
+    except FileNotFoundError:
+        print(f"no checkpoint under {args.checkpoint_dir!r}; "
+              "serving from random init")
+
+    engine = ServingEngine(
+        trainer.state.params, cfg,
+        max_slots=args.max_slots, max_seq=args.max_seq,
+        queue_limit=args.queue_limit, enable_monitor=not args.no_monitor,
+        rng=jax.random.PRNGKey(args.seed),
+    )
+    rng = np.random.default_rng(args.seed)
+    deadline = args.deadline_ms / 1e3 if args.deadline_ms else None
+    submitted = 0
+    for _ in range(args.num_requests):
+        plen = int(np.clip(rng.integers(max(args.prompt_len // 2, 1),
+                                        args.prompt_len * 2 + 1),
+                           1, args.max_seq - args.max_new_tokens))
+        prompt = rng.integers(0, cfg.vocab_size, plen).tolist()
+        new = int(rng.integers(1, args.max_new_tokens + 1))
+        rid = engine.submit(ServeRequest(
+            prompt=prompt, max_new_tokens=new,
+            temperature=args.temperature, deadline_s=deadline,
+        ))
+        if rid is None:
+            engine.run_until_idle()  # drain, then retry the arrival
+            rid = engine.submit(ServeRequest(
+                prompt=prompt, max_new_tokens=new,
+                temperature=args.temperature, deadline_s=deadline,
+            ))
+        if rid is not None:
+            submitted += 1
+    engine.run_until_idle()
+    summary = engine.metrics_summary()
+    print(f"served {submitted} request(s) on {args.max_slots} slot(s)")
+    for key in ("requests_completed", "requests_deadline_exceeded",
+                "requests_flagged", "tokens_emitted", "tokens_per_s",
+                "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms"):
+        if key in summary:
+            value = summary[key]
+            shown = f"{value:.3f}" if isinstance(value, float) else value
+            print(f"  {key}: {shown}")
+    if summary.get("quarantined_slots"):
+        print(f"  quarantined slots: {summary['quarantined_slots']}")
+    trainer.cleanup()
+    return 0
+
+
 def build_prepare_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="trustworthy-dl-prepare-data",

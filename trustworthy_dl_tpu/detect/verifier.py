@@ -124,6 +124,75 @@ def fleet_surge_update(
     return raw, new_state, new_streak
 
 
+class FleetEpisodeTracker:
+    """Host-side bookkeeping for fleet norm-surge episodes.
+
+    The in-step alarm (``fleet_surge_update``) is a bool per step; this
+    tracker turns it into *episodes* (open on the rising edge, close on
+    the falling edge) and — critically — records HOW each episode ended.
+    After ``FLEET_LATCH_LIMIT`` consecutive raw steps the baseline starts
+    force-absorbing the surged norms (the bounded-alarm escape hatch), so
+    the z falling back under threshold can mean two very different
+    things:
+
+    * ``"recovered"``            — norms actually returned to baseline;
+    * ``"absorbed-while-raw"``   — the surge NEVER stopped; the latch
+      re-baselined it.  The model may now be training on poisoned
+      gradients that look statistically normal — an operator must treat
+      this as an unresolved incident, not an all-clear.
+
+    The distinction comes from the raw streak: it only exceeds
+    ``FLEET_LATCH_LIMIT`` when forced absorption began while the alarm
+    was still raw."""
+
+    def __init__(self, latch_limit: int = FLEET_LATCH_LIMIT):
+        self.latch_limit = latch_limit
+        self.episodes: List[dict] = []
+        self._open = False
+        self._peak_streak = 0
+
+    @property
+    def alarm_open(self) -> bool:
+        return self._open
+
+    def update(self, alert: bool, raw_streak: int, step: int,
+               extra: Optional[dict] = None) -> Optional[dict]:
+        """Feed one step's (debounced alert, raw streak).  Returns the
+        episode dict on the step it OPENS (for host-side side effects:
+        logging, state-machine flips), else None."""
+        if alert:
+            self._peak_streak = max(self._peak_streak, int(raw_streak))
+            if not self._open:
+                self._open = True
+                episode = {"step": int(step), "resolution": None,
+                           **(extra or {})}
+                self.episodes.append(episode)
+                return episode
+        elif self._open:
+            self._open = False
+            episode = self.episodes[-1]
+            episode["resolved_step"] = int(step)
+            episode["peak_raw_streak"] = self._peak_streak
+            if self._peak_streak >= self.latch_limit:
+                episode["resolution"] = "absorbed-while-raw"
+                logger.error(
+                    "fleet norm-surge episode (opened step %d) closed at "
+                    "step %d by FORCED ABSORPTION at the %d-step latch "
+                    "limit — the surge did not recover, the baseline "
+                    "re-anchored onto it; treat as unresolved",
+                    episode["step"], int(step), self.latch_limit,
+                )
+            else:
+                episode["resolution"] = "recovered"
+                logger.info(
+                    "fleet norm-surge episode (opened step %d) recovered "
+                    "at step %d (peak raw streak %d)",
+                    episode["step"], int(step), self._peak_streak,
+                )
+            self._peak_streak = 0
+        return None
+
+
 def absorb_norms(state: VerifierState, grad_norms: jax.Array,
                  mask: jax.Array) -> VerifierState:
     """Welford-absorb this step's log-norms where ``mask`` holds (the

@@ -219,9 +219,13 @@ class StepMetrics(NamedTuple):
     grad_stats: Array         # f32[n, 17] gradient stat battery
     # Model-specific diagnostics averaged over nodes (e.g. MoE
     # {"moe_drop_fraction"}: share of routed assignments dropped at expert
-    # capacity — invisible in the loss on any single step).  Empty for
-    # models that report none.
-    model_aux: Dict[str, Array] = {}
+    # capacity — invisible in the loss on any single step).  None for
+    # models/modes that report none — a None SENTINEL, not a shared {}
+    # literal: a mutable NamedTuple default is one dict instance shared by
+    # every StepMetrics ever constructed without the field, so an in-place
+    # mutation by any consumer would leak across steps and trainers.
+    # Read sites normalise with ``metrics.model_aux or {}``.
+    model_aux: Optional[Dict[str, Array]] = None
     # Fleet-level norm-surge alarm (bool[], debounced) — the
     # majority-attack backstop; None when the step doesn't compute it
     # (pipeline mode, verification off).

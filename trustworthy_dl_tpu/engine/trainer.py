@@ -38,7 +38,8 @@ from trustworthy_dl_tpu.detect.stats import (
     NUM_TENSOR_STATS,
     TENSOR_STAT_NAMES,
 )
-from trustworthy_dl_tpu.detect.verifier import GradientVerifier
+from trustworthy_dl_tpu.detect.verifier import FleetEpisodeTracker, \
+    GradientVerifier
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
 from trustworthy_dl_tpu.engine.state import TrainState, \
@@ -215,9 +216,12 @@ class DistributedTrainer:
         self.reassignment_history: List[Dict] = []
         # Fleet-level norm-surge episodes (unattributed majority-attack
         # alarms) — separate from attack_history, whose records name a
-        # node and feed per-node precision/recall accounting.
-        self.fleet_alerts: List[Dict] = []
-        self._fleet_alarm_open = False
+        # node and feed per-node precision/recall accounting.  The tracker
+        # also records HOW each episode closed ("recovered" vs
+        # "absorbed-while-raw" at the latch limit — see
+        # detect/verifier.FleetEpisodeTracker).
+        self._fleet_tracker = FleetEpisodeTracker()
+        self.fleet_alerts: List[Dict] = self._fleet_tracker.episodes
         # Epoch-cadence ML-tier verdicts (original node id -> bool).
         self.ml_flags: Dict[int, bool] = {}
         # Mesh coordinate -> ORIGINAL node id.  Identity until elastic
@@ -639,8 +643,11 @@ class DistributedTrainer:
                     id_of[i]: float(trust[i]) for i in range(len(trust))
                 },
                 # Model diagnostics (e.g. MoE capacity-drop fraction).
+                # ``model_aux`` is a None sentinel when absent (mutable {}
+                # NamedTuple defaults are a shared instance) — normalise.
                 **{k: float(v)
-                   for k, v in getattr(metrics, "model_aux", {}).items()},
+                   for k, v in (getattr(metrics, "model_aux", None)
+                                or {}).items()},
             }
         )
         # Feed the stat batteries into the host detector's history — the
@@ -671,26 +678,26 @@ class DistributedTrainer:
         # training-state machine flips to UNDER_ATTACK.
         fleet_alert = getattr(metrics, "fleet_alert", None)
         if fleet_alert is not None:
-            if bool(np.asarray(fleet_alert)):
-                if not self._fleet_alarm_open:
-                    self._fleet_alarm_open = True
-                    self.fleet_alerts.append({
-                        "step": self.global_step,
-                        "epoch": epoch,
-                        "median_grad_norm": float(
-                            np.median(np.asarray(metrics.grad_norm))
-                        ),
-                    })
-                    logger.error(
-                        "FLEET-LEVEL norm surge at step %d: the "
-                        "cross-sectional median gradient norm departed "
-                        "its own history — consistent with a "
-                        "majority/coordinated attack the per-node gate "
-                        "cannot attribute", self.global_step,
-                    )
-                    self.training_state = TrainingState.UNDER_ATTACK
-            else:
-                self._fleet_alarm_open = False
+            streak = getattr(self.state, "fleet_raw_streak", None)
+            streak = int(np.asarray(streak)[0]) if streak is not None else 0
+            opened = self._fleet_tracker.update(
+                bool(np.asarray(fleet_alert)), streak, self.global_step,
+                extra={
+                    "epoch": epoch,
+                    "median_grad_norm": float(
+                        np.median(np.asarray(metrics.grad_norm))
+                    ),
+                },
+            )
+            if opened is not None:
+                logger.error(
+                    "FLEET-LEVEL norm surge at step %d: the "
+                    "cross-sectional median gradient norm departed "
+                    "its own history — consistent with a "
+                    "majority/coordinated attack the per-node gate "
+                    "cannot attribute", self.global_step,
+                )
+                self.training_state = TrainingState.UNDER_ATTACK
 
         # Host incidents fire only on confirmed evidence: debounced verdicts
         # (metrics.attacked already folds in sustained norm-verification

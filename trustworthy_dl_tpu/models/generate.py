@@ -40,7 +40,12 @@ Params = Dict[str, Any]
 class KVCache(NamedTuple):
     k: jax.Array       # [L, B, H, S, Dh]
     v: jax.Array       # [L, B, H, S, Dh]
-    length: jax.Array  # i32[] — number of valid positions
+    # i32[] — number of valid positions, shared by every row (batch
+    # generate), OR i32[B] — per-row valid lengths (the serving engine's
+    # slotted cache, where each slot decodes at its own position).  The
+    # rank is static under jit, so the two spellings trace to different
+    # programs but share all the code below.
+    length: jax.Array
 
 
 def init_cache(cfg: gpt2.GPT2Config, batch: int, max_len: int) -> KVCache:
@@ -64,8 +69,11 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over [B, T, D] new positions, attending to
     cached K/V [B, H, S, Dh] plus itself (causal).  ``start`` is the write
-    offset — positions [start, start+T) land in the cache.  Returns
-    (activations, new layer_k, new layer_v)."""
+    offset — positions [start, start+T) land in the cache.  Scalar
+    ``start`` writes every row at the same offset (batch generate);
+    ``start`` i32[B] writes each row at its own offset (the serving
+    engine's slotted cache).  Returns (activations, new layer_k, new
+    layer_v)."""
     dtype = cfg.dtype
     b, t, d = x.shape
     h = cfg.n_head
@@ -76,21 +84,37 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_split_heads(a, h) for a in (q, k, v))  # [B, H, T, Dh]
 
-    layer_k = jax.lax.dynamic_update_slice(
-        layer_k, k.astype(layer_k.dtype), (0, 0, start, 0)
-    )
-    layer_v = jax.lax.dynamic_update_slice(
-        layer_v, v.astype(layer_v.dtype), (0, 0, start, 0)
-    )
+    if jnp.ndim(start) == 0:
+        layer_k = jax.lax.dynamic_update_slice(
+            layer_k, k.astype(layer_k.dtype), (0, 0, start, 0)
+        )
+        layer_v = jax.lax.dynamic_update_slice(
+            layer_v, v.astype(layer_v.dtype), (0, 0, start, 0)
+        )
+    else:
+        # Per-row write offsets: a batched dynamic_update_slice (one slice
+        # per row) — XLA lowers the vmap to a scatter, still static-shape.
+        row_update = jax.vmap(
+            lambda cache_row, new_row, off: jax.lax.dynamic_update_slice(
+                cache_row, new_row, (0, off, 0)
+            )
+        )
+        layer_k = row_update(layer_k, k.astype(layer_k.dtype), start)
+        layer_v = row_update(layer_v, v.astype(layer_v.dtype), start)
 
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, layer_k) / math.sqrt(d // h)
     # Causal vs cache: query at absolute position start+i may see cache
     # slots [0, start+i].
-    q_pos = start + jnp.arange(t)[:, None]         # [T, 1]
-    k_pos = jnp.arange(s)[None, :]                 # [1, S]
-    mask = k_pos <= q_pos                          # [T, S]
-    scores = jnp.where(mask[None, None], scores,
-                       jnp.finfo(scores.dtype).min)
+    if jnp.ndim(start) == 0:
+        q_pos = start + jnp.arange(t)[:, None]         # [T, 1]
+        k_pos = jnp.arange(s)[None, :]                 # [1, S]
+        mask = k_pos <= q_pos                          # [T, S]
+        mask = mask[None, None]                        # [1, 1, T, S]
+    else:
+        q_pos = start[:, None, None] + jnp.arange(t)[None, :, None]
+        k_pos = jnp.arange(s)[None, None, :]
+        mask = (k_pos <= q_pos)[:, None]               # [B, 1, T, S]
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, layer_v)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
@@ -139,13 +163,25 @@ def _decode_view(params: Params, cfg: gpt2.GPT2Config) -> Params:
 
 
 def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
-                      cfg: gpt2.GPT2Config
+                      cfg: gpt2.GPT2Config,
+                      last_pos: Optional[jax.Array] = None
                       ) -> Tuple[jax.Array, KVCache]:
     """Run all blocks over ``tokens`` [B, T] starting at cache.length;
-    returns (logits of the LAST position [B, V], updated cache)."""
+    returns (logits of the LAST position [B, V], updated cache).
+
+    ``cache.length`` may be scalar (all rows aligned — batch generate) or
+    i32[B] (per-row offsets — the serving engine's slotted decode); see
+    _block_with_cache.  ``last_pos`` (traced i32[], optional) overrides
+    WHICH position's logits are returned: the serving prefill pads prompts
+    to a bucket length, so the logits it needs live at real_len-1, not at
+    the (padded) last position.  None keeps the static [-1] slice — the
+    batch-generate program is unchanged."""
     start = cache.length
     t = tokens.shape[-1]
-    pos = start + jnp.arange(t)
+    if jnp.ndim(start) == 0:
+        pos = start + jnp.arange(t)                        # [T]
+    else:
+        pos = start[:, None] + jnp.arange(t)[None, :]      # [B, T]
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(jnp.float32)
 
     def scan_fn(carry, layer):
@@ -160,11 +196,15 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
     x, (new_k, new_v) = jax.lax.scan(
         scan_fn, x, (params["blocks"], cache.k, cache.v)
     )
+    if last_pos is None:
+        x_last = x[:, -1:, :]
+    else:
+        x_last = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
     wte_head = params.get("wte_head")
     if wte_head is None:
-        logits = gpt2.unembed(params, x[:, -1:, :], cfg)[:, 0, :]  # [B, V]
+        logits = gpt2.unembed(params, x_last, cfg)[:, 0, :]  # [B, V]
     else:
-        normed = L.layernorm(params["ln_f"], x[:, -1:, :])
+        normed = L.layernorm(params["ln_f"], x_last)
         logits = (normed.astype(cfg.dtype) @ wte_head.T).astype(
             jnp.float32
         )[:, 0, :]

@@ -147,9 +147,15 @@ def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
 
 
 def _capacity(num_tokens: int, cfg: MoEConfig) -> int:
+    """Per-expert slot count: ceil(k·S/E · factor), floored at 4 (tiny
+    batches would otherwise drop most assignments) and ALWAYS clamped to
+    ``num_tokens`` — the num_tokens clamp must come last, because a
+    capacity above S is meaningless (an expert can hold at most every
+    token) and the priority dispatcher's ``lax.top_k(rank.T, capacity)``
+    trace-crashes when capacity exceeds its [E, S] operand width."""
     c = math.ceil(num_tokens * cfg.top_k / cfg.n_experts
                   * cfg.capacity_factor)
-    return max(4, min(int(c), num_tokens))
+    return min(max(4, int(c)), num_tokens)
 
 
 def _topk_gating(probs: jax.Array, top_k: int

@@ -35,12 +35,12 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, \
     corrupt_stage_compute, poison_gradients
 from trustworthy_dl_tpu.core.config import TrainingConfig
-from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS
+from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, \
+    shard_map_compat as shard_map
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.detect import stats as st
 from trustworthy_dl_tpu.detect.detector import AttackType, anomaly_verdicts
@@ -94,15 +94,38 @@ def choose_num_microbatches(batch_size: int, num_stages: int,
     from M=2 to M=16.  Past M ≈ 4·S the marginal bubble gain is < ~6 %
     while per-tick battery/bookkeeping overhead keeps growing linearly
     and per-microbatch arithmetic intensity falls (mb shrinks toward 1),
-    so the cap keeps the MXU fed.  M must divide the per-replica-row
-    batch B/dp so every microbatch is full.
+    so the cap keeps the MXU fed.  An exact divisor of the per-replica-row
+    batch B/dp is preferred (every microbatch full, no samples trimmed);
+    when none <= cap exists (prime-ish batches) the fallback picks the
+    trim-tolerant M that maximises the utilised batch (M * (per_row // M),
+    ties resolved toward the larger M for the smaller bubble) instead of
+    silently degrading to M=1 — at S=8 that old fallback ran an ~88 %
+    bubble, far worse than trimming a couple of samples per row (the
+    trainer's _node_batch already trims every batch to the M*dp quantum).
+    Degraded auto-selection is logged with the utilisation it settles for.
     """
+    import logging as _logging
+
     per_row = max(batch_size // max(dp, 1), 1)
     cap = min(per_row, 4 * num_stages)
     for m in range(cap, 1, -1):
         if per_row % m == 0:
             return m
-    return 1
+    best_m, best_used = 1, 0
+    for m in range(2, cap + 1):
+        used = (per_row // m) * m
+        if used >= best_used:  # >= : ties prefer the deeper schedule
+            best_m, best_used = m, used
+    if best_m > 1:
+        _logging.getLogger(__name__).warning(
+            "no exact microbatch divisor of per-row batch %d <= cap %d; "
+            "auto-selected trim-tolerant M=%d (utilises %d/%d samples "
+            "per row, bubble %.0f%% vs %.0f%% at M=1)",
+            per_row, cap, best_m, best_used, per_row,
+            100.0 * bubble_fraction(num_stages, best_m),
+            100.0 * bubble_fraction(num_stages, 1),
+        )
+    return best_m
 
 
 def bubble_fraction(num_stages: int, num_microbatches: int) -> float:

@@ -30,10 +30,10 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from trustworthy_dl_tpu.core.mesh import SEQ_AXIS
+from trustworthy_dl_tpu.core.mesh import SEQ_AXIS, \
+    shard_map_compat as shard_map
 from trustworthy_dl_tpu.models.gpt2 import full_attention, register_attention
 
 _SEQ_MESH: Optional[Mesh] = None

@@ -1,0 +1,46 @@
+"""Trust-aware TPU-native inference serving (beyond-reference).
+
+The framework trains and batch-samples (models/generate.py) but the ROADMAP
+north star — heavy traffic from millions of users — needs a *serving* path:
+concurrent requests with heterogeneous prompt/output lengths, admitted and
+retired mid-flight without recompiles.  This package is the Orca/vLLM-style
+answer, shaped for XLA's static-shape world:
+
+* ``kv_slots``  — slotted KV cache [L, MAX_SLOTS, H, S, Dh] + host-side
+  slot allocator (alloc/free/quarantine); no dynamic shapes anywhere.
+* ``scheduler`` — continuous (iteration-level) batching: bucketed prefill
+  for newly admitted slots, ONE fused decode step for all active slots,
+  mid-flight retirement and slot reuse.
+* ``engine``    — request lifecycle (queue → prefill → decode → stream),
+  deadlines, backpressure, serving metrics (TTFT / ITL / tokens/s / slot
+  occupancy), and trust-aware output monitoring: per-request logit
+  entropy / top-1 margin z-scored against a rolling baseline, with
+  anomalous generations quarantining the issuing slot — the inference
+  mirror of the training-side trust state machine.
+"""
+
+from trustworthy_dl_tpu.serve.engine import (
+    OutputMonitor,
+    ServeRequest,
+    ServeResult,
+    ServingEngine,
+)
+from trustworthy_dl_tpu.serve.kv_slots import SlotAllocator, SlotKV, init_slots
+from trustworthy_dl_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    choose_bucket,
+    default_buckets,
+)
+
+__all__ = [
+    "ContinuousBatchingScheduler",
+    "OutputMonitor",
+    "ServeRequest",
+    "ServeResult",
+    "ServingEngine",
+    "SlotAllocator",
+    "SlotKV",
+    "choose_bucket",
+    "default_buckets",
+    "init_slots",
+]

@@ -1,0 +1,400 @@
+"""Request lifecycle for the serving engine: queue → prefill → decode →
+stream, with deadlines, backpressure, serving metrics, and trust-aware
+output monitoring.
+
+The engine is a synchronous iteration loop (``step()``): each iteration
+admits queued requests into free slots, runs the scheduler's single fused
+decode step, streams new tokens to per-request callbacks, and retires
+finished/expired sequences.  Everything host-side is O(MAX_SLOTS) python;
+the device work per iteration is exactly one decode program plus one
+bucketed prefill per admission.
+
+Trust-aware admission control (the inference mirror of the training trust
+state machine): every emitted token's logit entropy and top-1 margin are
+computed in-step (scheduler._logit_signals); at retirement the request's
+mean signal vector is z-scored against a rolling baseline of past *clean*
+requests (detect/baseline ring buffer — score-then-absorb-only-clean, the
+same hardening the training detector uses so an attacker cannot drag its
+own baseline).  A flagged generation marks the request and QUARANTINES the
+slot it ran on — a compromised replica's capacity leaves the pool until an
+operator releases it, mirroring COMPROMISED → probation on the training
+side.
+
+Serving metrics flow through ``utils.metrics.MetricsCollector``: per
+iteration (slot occupancy, queue depth, tokens emitted) and per request
+(TTFT, ITLs); ``metrics_summary()`` reports tokens/s and p50/p99
+inter-token latency — the numbers the bench serve leg records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trustworthy_dl_tpu.detect import baseline as bl
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    SlotTask,
+    request_key_stream,
+)
+from trustworthy_dl_tpu.utils.metrics import MetricsCollector
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One generation request.  ``temperature<=0`` decodes greedily;
+    ``deadline_s`` is a relative wall-clock budget from submit time (the
+    request retires mid-flight with whatever it has when it expires);
+    ``on_token`` streams each token as ``on_token(request_id, token)``."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    rng: Optional[jax.Array] = None
+    on_token: Optional[Callable[[int, int], None]] = None
+
+
+@dataclasses.dataclass
+class ServeResult:
+    request_id: int
+    tokens: List[int]
+    # completed | deadline_exceeded | no_capacity (shed because every
+    # slot was quarantined — see run_until_idle)
+    status: str
+    ttft_s: Optional[float]        # submit -> first token
+    itl_s: List[float]             # inter-token latencies
+    flagged: bool = False          # output monitor verdict
+    monitor_z: float = 0.0
+
+
+class OutputMonitor:
+    """Rolling per-request output-anomaly baseline.
+
+    Signal vector per finished request: [mean logit entropy, mean top-1
+    margin].  Both are cheap in-step reductions of the decode logits, and
+    together they see the two anomaly directions: a backdoored/looping
+    generation collapses entropy and inflates margin; a corrupted replica
+    emitting garbage logits does the reverse.  The baseline is the same
+    ring-buffer machinery the training detector uses (detect/baseline),
+    one fleet-wide row, and absorbs ONLY requests it did not flag."""
+
+    NUM_SIGNALS = 2
+
+    def __init__(self, window: int = 256, warmup: int = 16,
+                 z_threshold: float = 4.0):
+        self.warmup = warmup
+        self.z_threshold = z_threshold
+        self._state = bl.init_baseline_state(1, window, self.NUM_SIGNALS)
+
+    def observe(self, entropies: Sequence[float],
+                margins: Sequence[float]) -> tuple:
+        """Score one finished request; absorb it iff clean.  Returns
+        (flagged, max_z)."""
+        vec = jnp.asarray(
+            [[float(np.mean(entropies)), float(np.mean(margins))]],
+            jnp.float32,
+        )
+        mean, std, valid = bl.baseline_moments(self._state)
+        z = float(jnp.max(bl.zscores(vec, mean, std)))
+        warm = int(valid[0]) >= self.warmup
+        flagged = warm and z > self.z_threshold
+        if not flagged:
+            self._state = bl.push_stats(self._state, vec)
+        return flagged, z
+
+    @property
+    def count(self) -> int:
+        return int(self._state.count[0])
+
+
+class ServingEngine:
+    """Continuous-batching serving over a fixed slot pool.
+
+    ``queue_limit`` is the backpressure bound: ``submit`` returns None
+    (shed load) once the admission queue is full — slots exhausted is not
+    an error, it is the steady state under heavy traffic.
+
+    Long-lived servers: per-request bookkeeping is dropped at retirement;
+    finished ``ServeResult``s accumulate in ``results`` until the caller
+    reads them — use ``drain_results()`` on a production loop so host
+    memory stays bounded."""
+
+    def __init__(self, params: Any, cfg: gpt2.GPT2Config,
+                 max_slots: int = 8, max_seq: int = 256,
+                 queue_limit: int = 64,
+                 buckets: Optional[Sequence[int]] = None,
+                 rng: Optional[jax.Array] = None,
+                 monitor: Optional[OutputMonitor] = None,
+                 enable_monitor: bool = True,
+                 metrics: Optional[MetricsCollector] = None):
+        self.cfg = cfg
+        self.scheduler = ContinuousBatchingScheduler(
+            params, cfg, max_slots, max_seq, buckets
+        )
+        self.queue_limit = queue_limit
+        self.monitor = monitor if monitor is not None else (
+            OutputMonitor() if enable_monitor else None
+        )
+        self.metrics = metrics or MetricsCollector()
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._queue: Deque[tuple] = deque()   # (task, request)
+        self._inflight: Dict[int, tuple] = {}  # request_id -> (task, req, t)
+        self._timing: Dict[int, List[float]] = {}  # request_id -> token times
+        self._submit_t: Dict[int, float] = {}
+        self.results: Dict[int, ServeResult] = {}
+        self.rejected = 0
+        self._next_id = 0
+        self._iteration = 0
+        self._tokens_emitted = 0
+        self._t_start: Optional[float] = None
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, request: ServeRequest) -> Optional[int]:
+        """Enqueue a request; returns its request_id, or None when shed by
+        backpressure (queue full).  Raises for requests that can never be
+        served (longer than the cache)."""
+        prompt = np.asarray(list(request.prompt), np.int32)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + request.max_new_tokens
+        if total > self.scheduler.max_seq:
+            raise ValueError(
+                f"prompt+new = {total} exceeds max_seq="
+                f"{self.scheduler.max_seq}"
+            )
+        largest_bucket = max(self.scheduler.buckets)
+        if prompt.size > largest_bucket:
+            # Reject at submission, not at admission — an engine built
+            # with custom (sub-max_seq) buckets must fail the request up
+            # front rather than crash the serving loop mid-flight.
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket {largest_bucket}"
+            )
+        if len(self._queue) >= self.queue_limit:
+            self.rejected += 1
+            return None
+        request_id = self._next_id
+        self._next_id += 1
+        rng = request.rng
+        if rng is None:
+            rng = jax.random.fold_in(self._rng, request_id)
+        task = SlotTask(
+            request_id=request_id,
+            prompt=prompt,
+            max_new_tokens=int(request.max_new_tokens),
+            temperature=float(request.temperature),
+            keys=request_key_stream(rng, int(request.max_new_tokens)),
+            eos_id=request.eos_id,
+        )
+        self._queue.append((task, request))
+        self._submit_t[request_id] = time.perf_counter()
+        return request_id
+
+    # -- iteration loop ----------------------------------------------------
+
+    def step(self) -> int:
+        """One scheduler iteration: expire → admit → decode → retire.
+        Returns the number of tokens emitted this iteration."""
+        now = time.perf_counter()
+        if self._t_start is None:
+            self._t_start = now
+        self._iteration += 1
+        self._expire_queued(now)
+
+        # Admit as many queued requests as there are free slots.  Each
+        # admission prefetches the first token (prefill), so TTFT is the
+        # admission latency itself.
+        emitted = 0
+        while self._queue and self.scheduler.has_free_slot:
+            task, request = self._queue.popleft()
+            if not self.scheduler.admit(task):
+                self._queue.appendleft((task, request))
+                break
+            rid = task.request_id
+            self._inflight[rid] = (task, request)
+            t_tok = time.perf_counter()
+            self._timing[rid] = [t_tok]
+            self._stream(request, rid, task.emitted[-1])
+            emitted += 1
+            if task.done:
+                self._finish(task, request, "completed")
+        for task in self.scheduler.decode_tick():
+            rid = task.request_id
+            if rid not in self._inflight:
+                continue
+            _, request = self._inflight[rid]
+            self._timing[rid].append(time.perf_counter())
+            self._stream(request, rid, task.emitted[-1])
+            emitted += 1
+            deadline = request.deadline_s
+            expired = (deadline is not None
+                       and time.perf_counter() - self._submit_t[rid]
+                       > deadline)
+            if task.done:
+                self._finish(task, request, "completed")
+            elif expired:
+                self._finish(task, request, "deadline_exceeded")
+        self._tokens_emitted += emitted
+
+        self.metrics.collect_batch_metrics({
+            "step": self._iteration,
+            "active_slots": self.scheduler.active_count,
+            "slot_occupancy": self.scheduler.occupancy,
+            "queue_depth": len(self._queue),
+            "tokens_emitted": emitted,
+            "slots_in_service": self.scheduler.allocator.capacity,
+        })
+        self.metrics.tick()
+        return emitted
+
+    def run_until_idle(self, max_iterations: int = 100_000
+                       ) -> Dict[int, ServeResult]:
+        """Drive ``step()`` until queue and slots drain (or the iteration
+        bound trips — a liveness backstop, not a normal exit)."""
+        it = 0
+        while self._queue or self._inflight:
+            if (not self._inflight
+                    and self.scheduler.allocator.capacity == 0):
+                # Every slot quarantined: the queue can never drain.
+                while self._queue:
+                    task, _ = self._queue.popleft()
+                    self._submit_t.pop(task.request_id, None)
+                    self.results[task.request_id] = ServeResult(
+                        request_id=task.request_id, tokens=[],
+                        status="no_capacity", ttft_s=None, itl_s=[],
+                    )
+                break
+            self.step()
+            it += 1
+            if it >= max_iterations:
+                raise RuntimeError(
+                    f"serving loop did not drain in {max_iterations} "
+                    "iterations"
+                )
+        return self.results
+
+    # -- internals ---------------------------------------------------------
+
+    def _stream(self, request: ServeRequest, request_id: int,
+                token: int) -> None:
+        if request.on_token is not None:
+            request.on_token(request_id, token)
+
+    def _expire_queued(self, now: float) -> None:
+        """Shed queued requests whose deadline passed before admission."""
+        keep: Deque[tuple] = deque()
+        while self._queue:
+            task, request = self._queue.popleft()
+            rid = task.request_id
+            if (request.deadline_s is not None
+                    and now - self._submit_t[rid] > request.deadline_s):
+                self._submit_t.pop(rid, None)
+                self.results[rid] = ServeResult(
+                    request_id=rid, tokens=[],
+                    status="deadline_exceeded", ttft_s=None, itl_s=[],
+                )
+            else:
+                keep.append((task, request))
+        self._queue = keep
+
+    def _finish(self, task: SlotTask, request: ServeRequest,
+                status: str) -> None:
+        rid = task.request_id
+        flagged, z = False, 0.0
+        if self.monitor is not None and task.entropies:
+            flagged, z = self.monitor.observe(task.entropies, task.margins)
+        self.scheduler.retire(task, quarantine=flagged)
+        times = self._timing.pop(rid, [])
+        t0 = self._submit_t.pop(rid, None)
+        ttft = (times[0] - t0) if times and t0 is not None else None
+        itl = [b - a for a, b in zip(times, times[1:])]
+        self.results[rid] = ServeResult(
+            request_id=rid, tokens=list(task.emitted), status=status,
+            ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
+        )
+        self.metrics.collect_batch_metrics({
+            "step": self._iteration,
+            "request_id": rid,
+            "ttft_s": ttft if ttft is not None else -1.0,
+            "tokens": len(task.emitted),
+            "flagged": int(flagged),
+        })
+        self._inflight.pop(rid, None)
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        """Work still queued or in flight."""
+        return bool(self._queue or self._inflight)
+
+    @property
+    def in_service_capacity(self) -> int:
+        """Slots currently serviceable (total minus quarantined)."""
+        return self.scheduler.allocator.capacity
+
+    def drain_results(self) -> Dict[int, ServeResult]:
+        """Return finished results and clear them — the bounded-memory
+        retrieval API for long-lived serving loops."""
+        out = self.results
+        self.results = {}
+        return out
+
+    @property
+    def quarantined_slots(self):
+        return self.scheduler.allocator.quarantined
+
+    def release_quarantine(self, slot: int) -> None:
+        self.scheduler.allocator.release(slot)
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        """Serving-side rollup: throughput, latency percentiles, trust."""
+        done = [r for r in self.results.values() if r.tokens]
+        itls = np.asarray(
+            [d for r in done for d in r.itl_s], np.float64
+        )
+        ttfts = np.asarray(
+            [r.ttft_s for r in done if r.ttft_s is not None], np.float64
+        )
+        elapsed = (
+            (time.perf_counter() - self._t_start)
+            if self._t_start is not None else 0.0
+        )
+        out: Dict[str, Any] = {
+            "requests_completed":
+                sum(r.status == "completed" for r in self.results.values()),
+            "requests_deadline_exceeded":
+                sum(r.status == "deadline_exceeded"
+                    for r in self.results.values()),
+            "requests_rejected": self.rejected,
+            "requests_flagged":
+                sum(r.flagged for r in self.results.values()),
+            "quarantined_slots": sorted(self.quarantined_slots),
+            "tokens_emitted": self._tokens_emitted,
+            "tokens_per_s":
+                self._tokens_emitted / elapsed if elapsed > 0 else 0.0,
+            "iterations": self._iteration,
+        }
+        if itls.size:
+            out["itl_p50_ms"] = float(np.percentile(itls, 50) * 1e3)
+            out["itl_p99_ms"] = float(np.percentile(itls, 99) * 1e3)
+        if ttfts.size:
+            out["ttft_p50_ms"] = float(np.percentile(ttfts, 50) * 1e3)
+            out["ttft_p99_ms"] = float(np.percentile(ttfts, 99) * 1e3)
+        return out

@@ -1,0 +1,116 @@
+"""Slotted KV cache — the serving engine's static-shape memory pool.
+
+vLLM pages the KV cache at block granularity (PagedAttention, Kwon et al.,
+SOSP '23) because CUDA kernels can chase block tables.  Under XLA the
+equivalent that keeps the decode step a single never-recompiled program is
+coarser: one cache SLOT per in-flight sequence,
+
+    k, v: [L, MAX_SLOTS, H, MAX_SEQ, Dh]
+
+with per-slot valid lengths.  The decode step is then exactly the batch
+generate decode (models/generate._block_with_cache) with a *vector* of
+per-row write offsets — same numerics source, same static shapes, so it
+jits once for the engine's lifetime.
+
+THE STATIC-SHAPE INVARIANT: nothing in the device programs depends on how
+many requests are live.  Admission/retirement only change the host-side
+``lengths``/active arrays fed in as (traced) *values*; slot allocation and
+free-list bookkeeping are pure host work (SlotAllocator below).
+
+Slot hygiene: a freed slot's cache rows are NOT scrubbed — the decode step
+keeps writing garbage K/V at the freed slot's stale position (static shapes
+mean inactive rows still compute).  That is safe by construction: a slot is
+only re-used after prefill overwrites positions [0, prompt_len), the decode
+mask admits k_pos <= current position only, and every position a new
+request ever attends to is (re)written before it first becomes visible.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.models import gpt2
+
+
+class SlotKV(NamedTuple):
+    """Slot-pooled KV arrays; lengths live host-side (scheduler)."""
+
+    k: jax.Array  # [L, MAX_SLOTS, H, MAX_SEQ, Dh]
+    v: jax.Array  # [L, MAX_SLOTS, H, MAX_SEQ, Dh]
+
+    @property
+    def max_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[3]
+
+
+def init_slots(cfg: gpt2.GPT2Config, max_slots: int, max_seq: int) -> SlotKV:
+    if max_seq > cfg.n_positions:
+        raise ValueError(
+            f"max_seq={max_seq} exceeds the model's position table "
+            f"(n_positions={cfg.n_positions})"
+        )
+    shape = (cfg.n_layer, max_slots, cfg.n_head, max_seq,
+             cfg.n_embd // cfg.n_head)
+    return SlotKV(k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype))
+
+
+class SlotAllocator:
+    """Host-side slot lifecycle: free list + quarantine set.
+
+    Quarantine is the serving mirror of the training trust gate: a slot
+    whose request was flagged anomalous leaves the pool (capacity shrinks,
+    visible in the occupancy metric) until an operator releases it —
+    matching the training-side COMPROMISED → probation → readmission
+    ladder, where re-entry is also an explicit decision, not automatic."""
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = max_slots
+        # LIFO free list: the most recently freed slot is re-used first,
+        # keeping the working set of cache rows small (cache-friendly).
+        self._free: List[int] = list(range(max_slots - 1, -1, -1))
+        self._quarantined: Set[int] = set()
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot, or None when the pool is exhausted."""
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        if slot in self._quarantined:
+            return  # quarantined slots never re-enter the pool via free()
+        if slot in self._free or not 0 <= slot < self.max_slots:
+            raise ValueError(f"double free / bad slot {slot}")
+        self._free.append(slot)
+
+    def quarantine(self, slot: int) -> None:
+        """Remove a slot from service (flagged-anomalous request)."""
+        self._quarantined.add(slot)
+        if slot in self._free:
+            self._free.remove(slot)
+
+    def release(self, slot: int) -> None:
+        """Operator action: return a quarantined slot to the pool."""
+        if slot in self._quarantined:
+            self._quarantined.discard(slot)
+            self._free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def quarantined(self) -> Set[int]:
+        return set(self._quarantined)
+
+    @property
+    def capacity(self) -> int:
+        """Slots currently in service (total minus quarantined)."""
+        return self.max_slots - len(self._quarantined)

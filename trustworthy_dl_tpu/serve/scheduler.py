@@ -1,0 +1,330 @@
+"""Continuous (iteration-level) batching over the slotted KV cache.
+
+Orca's insight (Yu et al., OSDI '22): schedule at token granularity, not
+request granularity — every iteration admits queued requests into free
+slots, runs ONE fused decode step for all live sequences, and retires
+finished ones immediately so their slots free up mid-flight.  Here that
+schedule drives exactly two kinds of XLA programs:
+
+* **prefill** — per newly admitted slot, over its prompt padded to a
+  BUCKET length (``default_buckets``: powers of two), so the number of
+  distinct prefill programs is bounded by the bucket count, not by the
+  number of distinct prompt lengths ever seen;
+* **decode** — one program for the engine's lifetime: [MAX_SLOTS] tokens
+  in, [MAX_SLOTS] next tokens out, attending to the slot cache at per-slot
+  offsets via the SAME ``models/generate._block_with_cache`` numerics the
+  batch sampler uses (vector ``start``).  Admission/retirement never
+  change its shapes, so it compiles exactly once.
+
+Inactive slots still compute inside the decode step (static shapes); their
+outputs are ignored and their garbage cache writes are masked out by
+construction (see kv_slots module docstring).
+
+Sampling is per-slot: greedy is a *traced* bool (mixing greedy and
+temperature-sampled requests in one batch cannot recompile), temperature is
+traced, and each slot consumes its own key stream — laid out exactly like
+``models/generate.generate``'s (first token from the request key, step i
+from ``split(fold_in(key, 1), max_new-1)[i-1]``), so a single-slot greedy
+or sampled request reproduces the batch sampler token-for-token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trustworthy_dl_tpu.models import generate as gen
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.serve.kv_slots import SlotAllocator, SlotKV, init_slots
+
+logger = logging.getLogger(__name__)
+
+
+def default_buckets(max_seq: int, smallest: int = 16) -> Tuple[int, ...]:
+    """Power-of-two prefill buckets up to ``max_seq`` (inclusive) — bounds
+    the number of distinct prefill programs at O(log max_seq)."""
+    out: List[int] = []
+    b = smallest
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)
+
+
+def choose_bucket(buckets: Sequence[int], prompt_len: int) -> int:
+    """Smallest bucket holding ``prompt_len`` tokens."""
+    for b in sorted(buckets):
+        if b >= prompt_len:
+            return b
+    raise ValueError(
+        f"prompt of {prompt_len} tokens exceeds the largest prefill "
+        f"bucket {max(buckets)}"
+    )
+
+
+# --------------------------------------------------------------------------
+# Device programs.  Jitted lazily (first use) so importing this module never
+# initialises a backend; donation of the big cache buffers is enabled only
+# where XLA implements it (TPU) to keep CPU test runs warning-free.
+# --------------------------------------------------------------------------
+
+
+def _sample_tokens(logits: jax.Array, keys: jax.Array, temps: jax.Array,
+                   greedy: jax.Array) -> jax.Array:
+    """[B, V] -> [B] per-slot sampling.  ``greedy`` and ``temps`` are
+    traced per-slot values — heterogeneous sampling settings share the one
+    compiled program (unlike generate's static flags, which are uniform
+    across its batch)."""
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(greedy, greedy_tok, sampled)
+
+
+def _logit_signals(logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-slot trust signals from the step's logits [B, V]: softmax
+    entropy (collapse → ~0, garbage → ~log V) and top-1 logit margin.
+    Computed in-step — the [B, V] logits never leave the device."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    entropy = -jnp.sum(p * logp, axis=-1)
+    top2 = gen._exact_topk(logits, 2)[0]
+    return entropy, top2[:, 0] - top2[:, 1]
+
+
+def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
+                  view: Any, tokens: jax.Array, real_len: jax.Array,
+                  slot: jax.Array, key: jax.Array, temp: jax.Array,
+                  greedy: jax.Array):
+    """Prefill one slot: run the stacked blocks over the bucketed prompt
+    [P] (local cache, width P), write the K/V into the slot row, and sample
+    the first token from the logits at ``real_len - 1`` (the prompt's last
+    REAL position — the bucket padding beyond it is causally invisible to
+    it and is overwritten before any decode step can attend to it)."""
+    bucket = tokens.shape[0]
+    local = gen.init_cache(cfg, 1, bucket)
+    logits, local = gen._apply_with_cache(
+        view, tokens[None, :], local, cfg, last_pos=real_len - 1
+    )
+    new_k = jax.lax.dynamic_update_slice(
+        slot_k, local.k.astype(slot_k.dtype), (0, slot, 0, 0, 0)
+    )
+    new_v = jax.lax.dynamic_update_slice(
+        slot_v, local.v.astype(slot_v.dtype), (0, slot, 0, 0, 0)
+    )
+    token = _sample_tokens(logits, key[None], temp[None], greedy[None])[0]
+    ent, margin = _logit_signals(logits)
+    return new_k, new_v, token, ent[0], margin[0]
+
+
+def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
+                 view: Any, tokens: jax.Array, lengths: jax.Array,
+                 keys: jax.Array, temps: jax.Array, greedy: jax.Array):
+    """THE fused decode step: one token for every slot, live or not.
+    ``lengths`` i32[MAX_SLOTS] are the per-slot write offsets — the vector
+    ``start`` path of models/generate._block_with_cache, so serving decode
+    and batch generate share one numerics source."""
+    cache = gen.KVCache(k=slot_k, v=slot_v, length=lengths)
+    logits, cache = gen._apply_with_cache(view, tokens[:, None], cache, cfg)
+    next_tok = _sample_tokens(logits, keys, temps, greedy)
+    ent, margin = _logit_signals(logits)
+    return next_tok, cache.k, cache.v, ent, margin
+
+
+_PROGRAMS: Dict[str, Any] = {}
+
+
+def _programs() -> Dict[str, Any]:
+    if not _PROGRAMS:
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        _PROGRAMS["prefill"] = jax.jit(
+            _prefill_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        _PROGRAMS["decode"] = jax.jit(
+            _decode_impl, static_argnums=(0,), donate_argnums=donate
+        )
+    return _PROGRAMS
+
+
+def request_key_stream(rng: jax.Array, max_new_tokens: int) -> np.ndarray:
+    """uint32[max_new, 2] per-token sampling keys, laid out exactly like
+    generate's stream: token 0 uses the request key itself, token i>0 uses
+    ``split(fold_in(key, 1), max_new-1)[i-1]``."""
+    keys = [np.asarray(rng, np.uint32)]
+    if max_new_tokens > 1:
+        rest = jax.random.split(jax.random.fold_in(rng, 1),
+                                max_new_tokens - 1)
+        keys.extend(np.asarray(rest, np.uint32))
+    return np.stack(keys)
+
+
+@dataclasses.dataclass
+class SlotTask:
+    """Host-side record of one in-flight sequence (scheduler's view)."""
+
+    request_id: int
+    prompt: np.ndarray            # i32[P] token ids
+    max_new_tokens: int
+    temperature: float
+    keys: np.ndarray              # uint32[max_new, 2] sampling key stream
+    eos_id: Optional[int] = None
+    slot: int = -1
+    emitted: List[int] = dataclasses.field(default_factory=list)
+    next_token: int = -1          # last emitted token = next decode input
+    entropies: List[float] = dataclasses.field(default_factory=list)
+    margins: List[float] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    def _record(self, token: int, ent: float, margin: float) -> None:
+        self.emitted.append(token)
+        self.next_token = token
+        self.entropies.append(ent)
+        self.margins.append(margin)
+        if (len(self.emitted) >= self.max_new_tokens
+                or (self.eos_id is not None and token == self.eos_id)):
+            self.done = True
+
+
+class ContinuousBatchingScheduler:
+    """Slot admission + fused decode over the slotted KV cache.
+
+    Host state: per-slot lengths (numpy — alloc/free never touch the
+    device) and the live ``SlotTask`` table.  Device state: the SlotKV
+    arrays, threaded functionally through the prefill/decode programs.
+    """
+
+    def __init__(self, params: Any, cfg: gpt2.GPT2Config, max_slots: int,
+                 max_seq: int,
+                 buckets: Optional[Sequence[int]] = None):
+        self.cfg = cfg
+        # One numerics source with batch generate: the same pre-cast
+        # decode view of the weights (bit-identical by construction — see
+        # models/generate._decode_view).
+        self.view = gen._decode_view(params, cfg)
+        self.kv = init_slots(cfg, max_slots, max_seq)
+        self.allocator = SlotAllocator(max_slots)
+        self.buckets = tuple(sorted(buckets or default_buckets(max_seq)))
+        if max(self.buckets) > max_seq:
+            raise ValueError("prefill bucket exceeds max_seq")
+        self.lengths = np.zeros(max_slots, np.int32)
+        self.tasks: Dict[int, SlotTask] = {}   # slot -> task
+        self.max_seq = max_seq
+
+    # -- admission ---------------------------------------------------------
+
+    @property
+    def has_free_slot(self) -> bool:
+        return self.allocator.free_count > 0
+
+    @property
+    def active_count(self) -> int:
+        return len(self.tasks)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.tasks) / max(self.allocator.max_slots, 1)
+
+    def admit(self, task: SlotTask) -> bool:
+        """Claim a slot, prefill the prompt, emit the first token.
+        Returns False (task untouched) when no slot is free."""
+        total = len(task.prompt) + task.max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"request {task.request_id}: prompt+new = {total} exceeds "
+                f"max_seq={self.max_seq}"
+            )
+        p = len(task.prompt)
+        # Resolve the bucket BEFORE claiming a slot: with custom (smaller
+        # than max_seq) buckets this can raise, and a slot claimed first
+        # would leak — the allocator has no owner to free it.
+        bucket = choose_bucket(self.buckets, p)
+        slot = self.allocator.alloc()
+        if slot is None:
+            return False
+        padded = np.zeros(bucket, np.int32)
+        padded[:p] = task.prompt
+        new_k, new_v, token, ent, margin = _programs()["prefill"](
+            self.cfg, self.kv.k, self.kv.v, self.view,
+            jnp.asarray(padded), jnp.asarray(p, jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(task.keys[0], jnp.uint32),
+            jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
+            jnp.asarray(task.greedy),
+        )
+        self.kv = SlotKV(k=new_k, v=new_v)
+        task.slot = slot
+        task._record(int(token), float(ent), float(margin))
+        self.lengths[slot] = p
+        self.tasks[slot] = task
+        return True
+
+    # -- decode ------------------------------------------------------------
+
+    def decode_tick(self) -> List[SlotTask]:
+        """One fused decode step for every active slot; returns the tasks
+        that received a token this tick (some may now be ``done``)."""
+        if not self.tasks:
+            return []
+        ms = self.allocator.max_slots
+        tokens = np.zeros(ms, np.int32)
+        keys = np.zeros((ms, 2), np.uint32)
+        temps = np.ones(ms, np.float32)
+        greedy = np.ones(ms, bool)
+        for slot, task in self.tasks.items():
+            tokens[slot] = task.next_token
+            # Next emission index is len(emitted) (< max_new while live).
+            keys[slot] = task.keys[len(task.emitted)]
+            temps[slot] = max(task.temperature, 1e-6)
+            greedy[slot] = task.greedy
+        next_tok, new_k, new_v, ent, margin = _programs()["decode"](
+            self.cfg, self.kv.k, self.kv.v, self.view,
+            jnp.asarray(tokens), jnp.asarray(self.lengths),
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
+        )
+        self.kv = SlotKV(k=new_k, v=new_v)
+        next_tok = np.asarray(next_tok)
+        ent = np.asarray(ent)
+        margin = np.asarray(margin)
+        ticked: List[SlotTask] = []
+        for slot, task in self.tasks.items():
+            # The decode step wrote this slot's token K/V at lengths[slot].
+            self.lengths[slot] += 1
+            task._record(int(next_tok[slot]), float(ent[slot]),
+                         float(margin[slot]))
+            ticked.append(task)
+        return ticked
+
+    # -- retirement --------------------------------------------------------
+
+    def retire(self, task: SlotTask, quarantine: bool = False) -> None:
+        """Release the task's slot (or quarantine it — flagged-anomalous
+        output; the slot leaves the pool until an operator releases it)."""
+        slot = task.slot
+        if slot < 0 or self.tasks.get(slot) is not task:
+            return
+        del self.tasks[slot]
+        if quarantine:
+            self.allocator.quarantine(slot)
+            logger.warning(
+                "slot %d quarantined after request %d was flagged "
+                "anomalous (%d slots remain in service)",
+                slot, task.request_id, self.allocator.capacity,
+            )
+        else:
+            self.allocator.free(slot)
+
+    def decode_cache_size(self) -> int:
+        """Number of compiled decode programs (the static-shape invariant
+        says this is 1 for the scheduler's lifetime)."""
+        prog = _PROGRAMS.get("decode")
+        return prog._cache_size() if prog is not None else 0
