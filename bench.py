@@ -21,7 +21,10 @@ TDDL_BENCH_STEPS (20), TDDL_BENCH_WARMUP (3), TDDL_BENCH_REMAT (1),
 TDDL_BENCH_CHUNK (unset = model default "auto"; 0 forces the
 materialised-logits CE; >0 forces the fused vocab-chunked head),
 TDDL_BENCH_ATTN (model default), TDDL_BENCH_ACCUM (grad accumulation
-microbatches, 1).
+microbatches, 1).  Optional legs: TDDL_BENCH_LONGCTX=1 (flash vs XLA
+long-context A/B), TDDL_BENCH_GEN=1 (decode), TDDL_BENCH_SERVE=1
+(continuous-batching offered-load sweep), TDDL_BENCH_CHAOS=1 (seeded
+chaos survival sweep through the self-healing supervisor).
 
 ``--config <preset>`` selects a BASELINE.md benchmark-matrix shape
 (`--config list` prints them); env overrides still apply on top.  The
@@ -386,6 +389,94 @@ def bench_serve() -> "list[dict]":
     return records
 
 
+def bench_chaos() -> "list[dict]":
+    """Survival sweep (TDDL_BENCH_CHAOS=1): seeded chaos fault plans
+    driven through the self-healing supervisor on a tiny GPT-2, one row
+    per seed — survived?, rollbacks/retries/restarts, recovered final
+    loss vs the fault-free baseline on the same data.  Runs inside the
+    TDDL_BENCH_WATCHDOG subprocess like every other leg, so a wedged
+    recovery path still yields the skip JSON.
+
+    Env: TDDL_BENCH_CHAOS_SEEDS ("0,1,2"), TDDL_BENCH_CHAOS_EPOCHS (3),
+    TDDL_BENCH_CHAOS_RATE (0.04)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from trustworthy_dl_tpu import (
+        DistributedTrainer,
+        TrainingConfig,
+        TrainingSupervisor,
+        get_dataloader,
+    )
+    from trustworthy_dl_tpu.chaos import FaultInjector, FaultKind, FaultPlan
+
+    seeds = [int(s) for s in os.environ.get(
+        "TDDL_BENCH_CHAOS_SEEDS", "0,1,2").split(",")]
+    epochs = int(os.environ.get("TDDL_BENCH_CHAOS_EPOCHS", "3"))
+    rate = float(os.environ.get("TDDL_BENCH_CHAOS_RATE", "0.04"))
+    tiny = dict(n_layer=2, n_embd=64, n_head=4, vocab_size=512,
+                n_positions=64, seq_len=32)
+    ckpt_dir = tempfile.mkdtemp(prefix="tddl_bench_chaos_")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext", batch_size=16,
+        num_nodes=4, learning_rate=3e-3, detector_warmup=4,
+        checkpoint_interval=5, checkpoint_dir=ckpt_dir, num_epochs=epochs,
+    )
+    trainer = DistributedTrainer(config, model_overrides=tiny)
+    dl = get_dataloader("openwebtext", batch_size=16, seq_len=32,
+                        vocab_size=512, num_examples=128)
+    steps_per_epoch = 128 // 16
+    horizon = steps_per_epoch * epochs
+
+    trainer.initialize()
+    base = trainer.train(dl, num_epochs=epochs)
+    base_loss = base["epochs"][-1]["train_loss"]
+    log(f"chaos baseline (fault-free): final loss {base_loss:.4f} "
+        f"({horizon} steps)")
+
+    rows = []
+    for seed in seeds:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        trainer.reset_for_run()
+        plan = FaultPlan.generate(seed, horizon, {
+            FaultKind.GRAD_NAN: rate,
+            FaultKind.DATA_LOSS: rate,
+            FaultKind.STALL: rate / 2,
+            FaultKind.PREEMPT: rate / 2,
+            FaultKind.CKPT_CRASH: rate / 2,
+            FaultKind.CKPT_CORRUPT: rate / 2,
+        }, severity=0.05)
+        injector = FaultInjector(plan)
+        supervisor = TrainingSupervisor(
+            trainer, max_retries=1, rollback_after=2,
+            max_restarts=plan.count(FaultKind.PREEMPT) + 1,
+            chaos=injector,
+        )
+        row = {"seed": seed, "faults_planned": len(plan.events)}
+        try:
+            res = supervisor.run(dl, num_epochs=epochs)
+            rep = res["supervisor"]
+            final = res["epochs"][-1]["train_loss"]
+            row.update(
+                survived=True,
+                final_loss=round(final, 4),
+                baseline_loss=round(base_loss, 4),
+                loss_gap=round(final - base_loss, 4),
+                rollbacks=rep["rollbacks"], retries=rep["retries"],
+                restarts=rep["restarts"],
+                faults_fired=rep.get("faults_fired", {}),
+            )
+        except Exception as exc:  # survival is the metric, not a crash
+            row.update(survived=False,
+                       error=f"{type(exc).__name__}: {str(exc)[:120]}")
+        log(f"chaos seed {seed}: {row}")
+        rows.append(row)
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return rows
+
+
 def bench_generate() -> None:
     """Optional decode benchmark (TDDL_BENCH_GEN=1): KV-cache generation
     steady-state cost on the full GPT-2.  Diagnostics only — stderr.
@@ -660,6 +751,9 @@ def _inner_main() -> None:
     serve_records = None
     if os.environ.get("TDDL_BENCH_SERVE") == "1":
         serve_records = bench_serve()
+    chaos_records = None
+    if os.environ.get("TDDL_BENCH_CHAOS") == "1":
+        chaos_records = bench_chaos()
 
     record = {
         "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"
@@ -676,6 +770,8 @@ def _inner_main() -> None:
     }
     if serve_records is not None:
         record["serve"] = serve_records
+    if chaos_records is not None:
+        record["chaos"] = chaos_records
     print(json.dumps(record))
 
 

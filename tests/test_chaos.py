@@ -1,0 +1,332 @@
+"""Chaos subsystem + checkpoint-integrity contracts (fast tier, host-only).
+
+Covers the deterministic fault plan, fire-once injector semantics, and the
+CheckpointManager's COMMIT-manifest machinery: atomic metadata, staged
+force-overwrite, crash-before-commit on async save, and walking restore /
+latest_step past corrupt or uncommitted checkpoints.  The jitted survival
+drill lives in tests/test_survival.py (slow tier, ``chaos`` marker).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.chaos import (
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    FaultPlan,
+    SimulatedPreemption,
+    corrupt_file,
+)
+from trustworthy_dl_tpu.chaos.injector import _largest_file
+from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
+
+
+def _state(scale: float):
+    return {"a": jnp.arange(4.0) * scale, "n": {"b": jnp.ones((2, 2)) * scale}}
+
+
+def _template():
+    return {"a": jnp.zeros(4), "n": {"b": jnp.zeros((2, 2))}}
+
+
+# --------------------------------------------------------------------------
+# FaultPlan
+# --------------------------------------------------------------------------
+
+
+def test_generate_is_deterministic_per_seed():
+    rates = {FaultKind.GRAD_NAN: 0.1, FaultKind.DATA_LOSS: 0.2,
+             FaultKind.STALL: 0.05}
+    a = FaultPlan.generate(7, 200, rates)
+    b = FaultPlan.generate(7, 200, rates)
+    c = FaultPlan.generate(8, 200, rates)
+    assert a.events == b.events
+    assert a.events != c.events
+    assert len(a.events) > 0
+    assert all(0 <= e.step < 200 for e in a.events)
+
+
+def test_scripted_plan_sorts_and_indexes():
+    plan = FaultPlan.scripted([
+        FaultEvent(step=9, kind=FaultKind.PREEMPT),
+        FaultEvent(step=2, kind=FaultKind.GRAD_NAN),
+        FaultEvent(step=2, kind=FaultKind.STALL, severity=0.5),
+    ])
+    assert [e.step for e in plan.events] == [2, 2, 9]
+    assert len(plan.at(2)) == 2
+    assert plan.at(2, FaultKind.STALL)[0].severity == 0.5
+    assert plan.at(3) == []
+    assert plan.count(FaultKind.PREEMPT) == 1
+
+
+def test_predict_matches_event_counts():
+    plan = FaultPlan.scripted([
+        FaultEvent(step=5, kind=FaultKind.GRAD_NAN),
+        FaultEvent(step=40, kind=FaultKind.GRAD_NAN),
+        FaultEvent(step=12, kind=FaultKind.PREEMPT),
+        FaultEvent(step=3, kind=FaultKind.DATA_LOSS),
+        FaultEvent(step=4, kind=FaultKind.STALL),
+    ])
+    pred = plan.predict(max_retries=2, rollback_after=3)
+    assert pred == {"retries": 12, "rollbacks": 2, "restarts": 1,
+                    "preemptions": 1, "dropped_batches": 1, "stalls": 1}
+
+
+# --------------------------------------------------------------------------
+# FaultInjector (host hooks, fire-once)
+# --------------------------------------------------------------------------
+
+
+def test_injector_fires_each_event_exactly_once():
+    """A post-rollback replay of the same global steps must not re-trigger
+    the fault that caused the rollback — events are one-shot."""
+    sleeps = []
+    plan = FaultPlan.scripted([
+        FaultEvent(step=3, kind=FaultKind.DATA_LOSS),
+        FaultEvent(step=4, kind=FaultKind.STALL, severity=0.25),
+        FaultEvent(step=5, kind=FaultKind.PREEMPT),
+    ])
+    inj = FaultInjector(plan, sleep_fn=sleeps.append)
+    assert inj.on_batch(2, {"x": 1}) == {"x": 1}
+    assert inj.on_batch(3, {"x": 1}) is None      # fires
+    assert inj.on_batch(3, {"x": 1}) == {"x": 1}  # replay: already fired
+    inj.on_step_start(4)
+    assert sleeps == [0.25]
+    inj.on_step_start(4)  # replay: no second stall
+    assert sleeps == [0.25]
+    with pytest.raises(SimulatedPreemption):
+        inj.on_step_start(5)
+    inj.on_step_start(5)  # replay after resume: no second preemption
+    assert inj.counts() == {"data_loss": 1, "stall": 1, "preempt": 1}
+
+
+def test_injector_caps_stall_duration():
+    sleeps = []
+    plan = FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.STALL, severity=1e6),
+    ])
+    FaultInjector(plan, sleep_fn=sleeps.append, max_stall_s=2.0
+                  ).on_step_start(1)
+    assert sleeps == [2.0]
+
+
+def test_grad_nan_corrupts_largest_param_leaf():
+    plan = FaultPlan.scripted([FaultEvent(step=2, kind=FaultKind.GRAD_NAN)])
+    inj = FaultInjector(plan)
+
+    class S:
+        params = {"big": jnp.ones((8, 8)), "small": jnp.ones((2,))}
+
+        def _replace(self, params):
+            out = S()
+            out.params = params
+            return out
+
+    out, _ = inj.on_step_end(2, S(), metrics=None)
+    assert np.isnan(np.asarray(out.params["big"])).all()
+    assert np.isfinite(np.asarray(out.params["small"])).all()
+
+
+# --------------------------------------------------------------------------
+# Checkpoint integrity manifest (COMMIT marker semantics)
+# --------------------------------------------------------------------------
+
+
+def test_restore_and_latest_step_walk_past_corrupt_latest(tmp_path):
+    """Bit-rot on the newest checkpoint costs one save interval, not the
+    run: latest_step() and restore(step=None) both land on the prior
+    verified step without operator input."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    mgr.save(_state(2.0), 2)
+    assert mgr.latest_step() == 2
+    corrupt_file(_largest_file(mgr.path_for(2)))
+    ok, reason = mgr.check_integrity(2)
+    assert not ok and "mismatch" in reason
+    assert mgr.latest_step() == 1
+    out = mgr.restore(_template())
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0))
+
+
+def test_async_save_crash_before_commit_lands_on_previous(tmp_path):
+    """save(block=False) that dies before the COMMIT manifest leaves an
+    uncommitted payload dir; latest_step()/restore() must land on the
+    previous verified step, not the partial directory."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    crash = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=2, kind=FaultKind.CKPT_CRASH),
+    ]))
+    mgr.chaos = crash
+    mgr.save(_state(2.0), 2, block=False)
+    mgr.wait()  # the commit point — vetoed by the injected crash
+    assert os.path.isdir(mgr.path_for(2))  # payload landed...
+    ok, reason = mgr.check_integrity(2)
+    assert not ok and "uncommitted" in reason  # ...but was never committed
+    # A fresh manager (the restarted process) sees the same truth.
+    fresh = CheckpointManager(str(tmp_path))
+    assert fresh.latest_step() == 1
+    out = fresh.restore(_template())
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0))
+
+
+def test_force_overwrite_failure_keeps_old_state(tmp_path, monkeypatch):
+    """save(force=True) stages the replacement and swaps at commit — a
+    failed overwrite never loses the last good checkpoint (it used to
+    rmtree the old payload *before* writing the new one)."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+
+    def boom(path, state):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(mgr._ckptr, "save", boom)
+    with pytest.raises(RuntimeError, match="disk full"):
+        mgr.save(_state(9.0), 1, force=True)
+    monkeypatch.undo()
+    mgr._pending = None
+    out = mgr.restore(_template(), step=1)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0))
+
+
+def test_force_overwrite_swaps_in_new_state(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    mgr.save(_state(3.0), 1, force=True)
+    assert not os.path.exists(mgr.path_for(1) + ".staging")
+    ok, reason = mgr.check_integrity(1)
+    assert ok and reason == "verified"
+    out = mgr.restore(_template(), step=1)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0) * 3)
+
+
+def test_explicit_step_integrity_failure_stays_loud(tmp_path):
+    """restore(step=N) on a corrupt checkpoint raises — silent fallback is
+    only for the step=None walk the operator did not pin."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    corrupt_file(_largest_file(mgr.path_for(1)))
+    with pytest.raises(RuntimeError, match="integrity"):
+        mgr.restore(_template(), step=1)
+
+
+def test_uncommitted_remnants_cleared_on_resave(tmp_path):
+    """A crashed save's junk payload must not shadow a later good save of
+    the same step (the skip-if-exists check consults committed-ness)."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.chaos = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.CKPT_CRASH),
+    ]))
+    mgr.save(_state(1.0), 1)  # commit vetoed -> uncommitted junk
+    assert mgr.latest_step() is None
+    mgr.chaos = None
+    mgr.save(_state(5.0), 1)  # same step: junk cleared, fresh save commits
+    assert mgr.latest_step() == 1
+    out = mgr.restore(_template(), step=1)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0) * 5)
+
+
+def test_resave_replaces_corrupt_committed_checkpoint(tmp_path):
+    """A post-rollback replay that re-reaches a step whose committed
+    checkpoint has rotted must REPLACE it, not skip-because-exists and
+    leave the corruption in place forever."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    corrupt_file(_largest_file(mgr.path_for(1)))
+    assert not mgr.check_integrity(1)[0]
+    mgr.save(_state(2.0), 1)  # no force needed: unusable -> rewritten
+    ok, reason = mgr.check_integrity(1)
+    assert ok and reason == "verified"
+    out = mgr.restore(_template(), step=1)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0) * 2)
+
+
+def test_post_commit_corruption_hook_is_detected(tmp_path):
+    """The injector's CKPT_CORRUPT flips bytes AFTER a clean commit; the
+    manifest checksums catch it on the next walk."""
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=2, kind=FaultKind.CKPT_CORRUPT),
+    ]))
+    mgr = CheckpointManager(str(tmp_path), chaos=inj)
+    mgr.save(_state(1.0), 1)
+    mgr.save(_state(2.0), 2)  # corrupted right after its commit
+    assert inj.counts() == {"ckpt_corrupt": 1}
+    assert mgr.latest_step() == 1
+
+
+def test_save_metadata_atomic_and_tolerant_of_stale_tmp(tmp_path):
+    """Topology sidecars write via tmp + os.replace: a reader never sees
+    truncated JSON, a stale .tmp from a crashed writer is ignored, and a
+    rewrite replaces cleanly."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save_metadata(5, {"num_nodes": 4, "node_map": [0, 1, 2, 3]})
+    meta_path = mgr._meta_path(5)
+    assert not os.path.exists(meta_path + ".tmp")
+    # A crashed mid-write leaves only the tmp file — the committed sidecar
+    # is untouched and still parses.
+    with open(meta_path + ".tmp", "w") as f:
+        f.write('{"num_nodes": 4, "node_')  # truncated
+    assert mgr.load_metadata(5)["num_nodes"] == 4
+    mgr.save_metadata(5, {"num_nodes": 3, "node_map": [0, 1, 2]})
+    assert mgr.load_metadata(5)["node_map"] == [0, 1, 2]
+    with open(meta_path) as f:
+        json.load(f)  # still valid JSON on disk
+
+
+def test_cli_parser_accepts_supervisor_and_chaos_flags():
+    from trustworthy_dl_tpu.cli import build_parser
+
+    args = build_parser().parse_args([
+        "--supervise", "--chaos-seed", "5", "--chaos-rate", "0.1",
+        "--max-retries", "1", "--rollback-after", "2", "--max-restarts", "4",
+    ])
+    assert args.supervise and args.chaos_seed == 5
+    assert (args.max_retries, args.rollback_after, args.max_restarts,
+            args.chaos_rate) == (1, 2, 4, 0.1)
+    defaults = build_parser().parse_args([])
+    assert not defaults.supervise and defaults.chaos_seed is None
+
+
+# --------------------------------------------------------------------------
+# Serving-side poison hook (host-level; engine integration in slow tier)
+# --------------------------------------------------------------------------
+
+
+def test_serve_poison_signals_trip_the_output_monitor():
+    from trustworthy_dl_tpu.serve.engine import OutputMonitor
+    from trustworthy_dl_tpu.serve.scheduler import SlotTask
+
+    monitor = OutputMonitor(warmup=4, z_threshold=4.0)
+    rng = np.random.default_rng(0)
+    for _ in range(8):  # varied clean traffic (std > 0 so z is defined)
+        monitor.observe(3.0 + rng.normal(0, 0.1, 3),
+                        1.0 + rng.normal(0, 0.1, 3))
+
+    def task(rid):
+        t = SlotTask(request_id=rid, prompt=np.zeros(4, np.int32),
+                     max_new_tokens=4, temperature=0.0,
+                     keys=np.zeros((4, 2), np.uint32))
+        t.entropies.extend([3.0, 3.05, 2.95])
+        t.margins.extend([1.0, 1.05, 0.95])
+        return t
+
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=7, kind=FaultKind.SERVE_POISON),
+    ]))
+    clean = task(6)
+    inj.on_serve_retire(clean)  # not scheduled: untouched
+    assert clean.entropies[0] == 3.0
+    flagged, _ = monitor.observe(clean.entropies, clean.margins)
+    assert not flagged
+
+    poisoned = task(7)
+    inj.on_serve_retire(poisoned)  # scheduled: collapsed entropy profile
+    assert poisoned.entropies == [0.0] * 3
+    flagged, z = monitor.observe(poisoned.entropies, poisoned.margins)
+    assert flagged and z > 4.0

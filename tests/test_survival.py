@@ -1,0 +1,204 @@
+"""The survival drill — acceptance for the chaos + supervisor subsystem.
+
+One seeded FaultPlan run (non-finite state, lost batch, stall, checkpoint
+corruption, simulated preemption) must auto-recover with the EXACT
+rollback/retry/restart counts the plan predicts, land its rollback on the
+prior *verified* checkpoint (the newer one is corrupt), and finish with a
+loss close to the fault-free baseline on the same data.
+
+Cost note (tests/BUDGET.md): the module fixture runs one fault-free
+baseline (32 steps) plus the drill (~40 steps with retries/replays) on the
+2L/32d tiny GPT-2; both share one compiled step via ``reset_for_run``.
+~60-90 s warm.  The serve-chaos test reuses test_serve's CFG/engine shapes
+so its decode/prefill programs come from the persistent cache.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+
+from trustworthy_dl_tpu.chaos import (
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    FaultPlan,
+    corrupt_file,
+)
+from trustworthy_dl_tpu.chaos.injector import _largest_file
+from trustworthy_dl_tpu.core.config import TrainingConfig
+from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.engine import DistributedTrainer, TrainingSupervisor
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
+            seq_len=16)
+EPOCHS = 4  # 8 steps/epoch (64 examples / batch 8)
+
+# The drill schedule (mirrors examples/chaos_drill.py).  Checkpoints land
+# at steps 0 (supervisor preamble), 5, 10, 15, ... — CKPT_CORRUPT hits the
+# step-10 save right after its commit, so the GRAD_NAN rollback two steps
+# later MUST walk past it to step 5.
+PLAN = FaultPlan.scripted([
+    FaultEvent(step=3, kind=FaultKind.DATA_LOSS),
+    FaultEvent(step=7, kind=FaultKind.STALL, severity=0.01),
+    FaultEvent(step=10, kind=FaultKind.CKPT_CORRUPT),
+    FaultEvent(step=12, kind=FaultKind.GRAD_NAN),
+    FaultEvent(step=18, kind=FaultKind.PREEMPT),
+])
+MAX_RETRIES, ROLLBACK_AFTER = 2, 2
+
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    ckpt_dir = str(tmp_path_factory.mktemp("drill") / "ckpt")
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext",
+        batch_size=8, num_nodes=4, learning_rate=3e-3,
+        detector_warmup=4, checkpoint_interval=5,
+        checkpoint_dir=ckpt_dir, num_epochs=EPOCHS,
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,
+                        vocab_size=128, num_examples=64)
+
+    trainer.initialize()
+    baseline = trainer.train(dl, num_epochs=EPOCHS)
+    base_loss = baseline["epochs"][-1]["train_loss"]
+
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    trainer.reset_for_run()
+    supervisor = TrainingSupervisor(
+        trainer, max_retries=MAX_RETRIES, rollback_after=ROLLBACK_AFTER,
+        max_restarts=2, chaos=FaultInjector(PLAN),
+    )
+    result = supervisor.run(dl, num_epochs=EPOCHS)
+    return dict(trainer=trainer, supervisor=supervisor, result=result,
+                base_loss=base_loss, ckpt_dir=ckpt_dir)
+
+
+def test_drill_recovers_with_plan_predicted_counts(drill):
+    report = drill["result"]["supervisor"]
+    predicted = PLAN.predict(max_retries=MAX_RETRIES,
+                             rollback_after=ROLLBACK_AFTER)
+    assert {k: report[k] for k in predicted} == predicted
+    # Every planned fault actually fired (nothing silently skipped).
+    assert sum(report["faults_fired"].values()) == len(PLAN.events)
+    assert drill["result"]["stats"]["training_state"] == "completed"
+
+
+def test_drill_rollback_skipped_the_corrupt_checkpoint(drill):
+    """GRAD_NAN at 12 forces a rollback at step 14; the step-10 checkpoint
+    is bit-rotten, so the verified walk must land on step 5."""
+    assert drill["result"]["supervisor"]["rollback_steps"] == [5]
+
+
+def test_drill_final_loss_within_tolerance_of_fault_free(drill):
+    final = drill["result"]["epochs"][-1]["train_loss"]
+    base = drill["base_loss"]
+    # The drill loses ~9 steps of progress to the rollback rewind plus one
+    # dropped batch; it must still land close to the fault-free run and
+    # far below the ~ln(128)=4.85 init loss (i.e. it genuinely recovered
+    # and kept learning — a wedged-then-restored run would sit at init).
+    assert final < base + 0.75, (final, base)
+    assert final < 4.2, final
+
+
+def test_corrupted_latest_checkpoint_restore_falls_back(drill):
+    """Acceptance: bit-rot on the latest checkpoint after the run — a
+    plain load_checkpoint() (no operator input) lands on the prior
+    verified step."""
+    trainer = drill["trainer"]
+    jax.block_until_ready(trainer.state)
+    latest = trainer.checkpointer.latest_step()
+    assert latest is not None and latest >= 15
+    corrupt_file(_largest_file(trainer.checkpointer.path_for(latest)))
+    trainer.load_checkpoint()
+    assert trainer.global_step < latest
+    assert trainer.global_step == trainer.checkpointer.latest_step()
+    # The restored state is live: one more clean step trains on it.
+    batch = trainer._node_batch(trainer.model.example_batch(8))
+    trainer.state, metrics = trainer._train_step(
+        trainer.state, batch, trainer.attack_plan
+    )
+    assert np.isfinite(float(np.asarray(metrics.loss)))
+
+
+def test_example_chaos_drill_smoke(tmp_path, capsys):
+    """examples/chaos_drill.py is the drill's user-facing spelling — run it
+    in-process (examples smoke path; shares the persistent compile cache
+    with the module fixture's identical shapes) and let its own asserts
+    gate."""
+    import runpy
+
+    example = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "chaos_drill.py")
+    os.environ["TDDL_DRILL_CKPT_DIR"] = str(tmp_path / "ckpt")
+    try:
+        runpy.run_path(example, run_name="__main__")
+    finally:
+        del os.environ["TDDL_DRILL_CKPT_DIR"]
+    out = capsys.readouterr().out
+    assert "drill survived with the plan-predicted recovery counts" in out
+
+
+def test_save_checkpoint_refuses_non_finite_params(tmp_path):
+    """The rollback target must never be poisoned by the very corruption
+    it exists to undo: a periodic save landing on NaN state is refused,
+    keeping the older good checkpoint as latest."""
+    from trustworthy_dl_tpu.chaos.injector import _corrupt_largest_leaf
+
+    config = TrainingConfig(
+        model_name="gpt2", dataset_name="openwebtext",
+        batch_size=8, num_nodes=4, learning_rate=3e-3,
+        checkpoint_interval=10_000, checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    trainer = DistributedTrainer(config, model_overrides=dict(TINY))
+    trainer.initialize()
+    trainer.global_step = 1
+    assert trainer.save_checkpoint() is not None
+    trainer.state = trainer.state._replace(
+        params=_corrupt_largest_leaf(trainer.state.params)
+    )
+    trainer.global_step = 2
+    assert trainer.save_checkpoint() is None
+    assert trainer.checkpointer.latest_step() == 1
+
+
+def test_serve_chaos_poison_quarantines_slot():
+    """Engine-level SERVE_POISON drill: a poisoned replica's request is
+    flagged at retirement and the slot it ran on leaves the pool
+    (engine shapes mirror test_serve so the programs are cache-warm)."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+    from trustworthy_dl_tpu.serve.engine import OutputMonitor
+
+    cfg = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                          n_embd=32, n_head=4)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    plan = FaultPlan.scripted([
+        FaultEvent(step=4, kind=FaultKind.SERVE_POISON),
+    ])
+    engine = ServingEngine(params, cfg, max_slots=2, max_seq=48,
+                           monitor=OutputMonitor(warmup=3),
+                           chaos=FaultInjector(plan))
+    rng = np.random.default_rng(0)
+    for i in range(5):  # ids 0..4; id 4 is the poisoned one
+        plen = int(rng.integers(3, 10))
+        engine.submit(ServeRequest(
+            prompt=rng.integers(0, cfg.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(2, 6)),
+        ))
+    results = engine.run_until_idle()
+    assert results[4].flagged and not results[3].flagged
+    assert len(engine.quarantined_slots) == 1
+    assert engine.in_service_capacity == 1
+    # Operator release returns the capacity.
+    engine.release_quarantine(next(iter(engine.quarantined_slots)))
+    assert engine.in_service_capacity == 2

@@ -37,6 +37,11 @@ _EXPORTS = {
     "MetricsCollector": "trustworthy_dl_tpu.utils.metrics",
     "NodeMonitor": "trustworthy_dl_tpu.utils.monitor",
     "AdversarialAttacker": "trustworthy_dl_tpu.attacks.adversarial",
+    "FaultInjector": "trustworthy_dl_tpu.chaos.injector",
+    "FaultKind": "trustworthy_dl_tpu.chaos.plan",
+    "FaultPlan": "trustworthy_dl_tpu.chaos.plan",
+    "SimulatedPreemption": "trustworthy_dl_tpu.chaos.injector",
+    "TrainingSupervisor": "trustworthy_dl_tpu.engine.supervisor",
     "ExperimentRunner": "trustworthy_dl_tpu.experiments.runner",
     "generate": "trustworthy_dl_tpu.models.generate",
     "ServingEngine": "trustworthy_dl_tpu.serve.engine",

@@ -1,0 +1,24 @@
+"""Chaos subsystem: seeded, deterministic fault injection.
+
+Recovery machinery that is never exercised is decorative — this package
+makes the failure modes (non-finite state, stalls, preemptions, corrupt or
+uncommitted checkpoints, lost batches, poisoned serving replicas) a
+reproducible, scriptable schedule that drills the self-healing loop in
+``engine/supervisor.py`` end to end.  See ``examples/chaos_drill.py``.
+"""
+
+from trustworthy_dl_tpu.chaos.injector import (
+    FaultInjector,
+    SimulatedPreemption,
+    corrupt_file,
+)
+from trustworthy_dl_tpu.chaos.plan import FaultEvent, FaultKind, FaultPlan
+
+__all__ = [
+    "FaultEvent",
+    "FaultInjector",
+    "FaultKind",
+    "FaultPlan",
+    "SimulatedPreemption",
+    "corrupt_file",
+]

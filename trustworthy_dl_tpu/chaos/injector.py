@@ -1,0 +1,201 @@
+"""Fault injector — executes a ``FaultPlan`` at explicit hook points.
+
+Hook points (all host-side, none on the jitted hot path):
+
+* ``DistributedTrainer.train_epoch``: ``on_batch`` (data-iterator
+  failures), ``on_step_start`` (stalls, preemption signals),
+  ``on_step_end`` (state corruption → genuinely non-finite losses);
+* ``CheckpointManager``: ``on_checkpoint_commit`` (crash-before-COMMIT),
+  ``on_checkpoint_saved`` (post-commit shard bit-rot);
+* ``serve.ServingEngine``: ``on_serve_retire`` (poisoned replica output).
+
+The injector is the *stateful* half of the chaos subsystem: each event
+fires exactly once (``fired``), so when the supervisor rolls global steps
+back past an already-fired event, the replayed steps run clean — recovery
+converges instead of looping.  ``counts()`` reports fired events by kind
+for the supervisor's survival report.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trustworthy_dl_tpu.chaos.plan import FaultEvent, FaultKind, FaultPlan
+
+logger = logging.getLogger(__name__)
+
+
+class SimulatedPreemption(Exception):
+    """Raised at a PREEMPT event's hook point — stands in for the
+    SIGTERM/maintenance notice a real preemptible host receives.  The
+    supervisor catches it, checkpoints, and auto-resumes."""
+
+
+class FaultInjector:
+    """Consumes a :class:`FaultPlan`; one instance per run.
+
+    ``sleep_fn`` is injectable so tests exercise STALL events without
+    real wall-clock cost.
+    """
+
+    def __init__(self, plan: FaultPlan,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 max_stall_s: float = 30.0):
+        self.plan = plan
+        self._sleep = sleep_fn
+        self._max_stall_s = max_stall_s
+        self.fired: List[FaultEvent] = []
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _take_at(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
+        """Fire-once event scheduled exactly at ``step``."""
+        for event in self.plan.at(step, kind):
+            if event not in self.fired:
+                self.fired.append(event)
+                return event
+        return None
+
+    def _take_due(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
+        """Fire-once event whose schedule step has been reached (for
+        checkpoint kinds, which fire on the first save at/after it)."""
+        for event in self.plan.of_kind(kind):
+            if event.step <= step and event not in self.fired:
+                self.fired.append(event)
+                return event
+        return None
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for event in self.fired:
+            out[event.kind.value] = out.get(event.kind.value, 0) + 1
+        return out
+
+    # -- trainer hooks -----------------------------------------------------
+
+    def on_batch(self, step: int, batch: Any) -> Optional[Any]:
+        """DATA_LOSS: the batch for this step is lost (simulated iterator
+        failure) — return None and the trainer skips it, exactly like its
+        stale-batch path."""
+        if self._take_at(step, FaultKind.DATA_LOSS) is not None:
+            logger.warning("chaos: data-iterator failure at step %d "
+                           "(batch dropped)", step)
+            return None
+        return batch
+
+    def on_step_start(self, step: int) -> None:
+        """STALL: block the host like a straggling/wedged peer.
+        PREEMPT: raise the simulated preemption signal."""
+        stall = self._take_at(step, FaultKind.STALL)
+        if stall is not None:
+            seconds = min(float(stall.severity), self._max_stall_s)
+            logger.warning("chaos: host stall %.2fs at step %d",
+                           seconds, step)
+            self._sleep(seconds)
+        if self._take_at(step, FaultKind.PREEMPT) is not None:
+            logger.warning("chaos: simulated preemption at step %d", step)
+            raise SimulatedPreemption(f"preempted at step {step}")
+
+    def on_step_end(self, step: int, state: Any, metrics: Any
+                    ) -> Tuple[Any, Any]:
+        """GRAD_NAN: corrupt the live parameters with NaN AFTER this step
+        commits — from the next step on, every node's loss and gradients
+        are genuinely non-finite (the in-step gate freezes the params, so
+        without a rollback the run is wedged forever: exactly the failure
+        the supervisor's verified-checkpoint rollback exists for)."""
+        if self._take_at(step, FaultKind.GRAD_NAN) is not None:
+            logger.warning("chaos: corrupting params with NaN after "
+                           "step %d", step)
+            state = state._replace(params=_corrupt_largest_leaf(state.params))
+        return state, metrics
+
+    # -- checkpoint hooks --------------------------------------------------
+
+    def on_checkpoint_commit(self, step: int) -> bool:
+        """CKPT_CRASH: return False to simulate dying between the payload
+        write and the COMMIT marker — the save stays uncommitted and
+        restore/latest_step must walk past it."""
+        if self._take_due(step, FaultKind.CKPT_CRASH) is not None:
+            logger.warning("chaos: simulated crash before COMMIT of "
+                           "checkpoint step %d", step)
+            return False
+        return True
+
+    def on_checkpoint_saved(self, step: int, path: str) -> None:
+        """CKPT_CORRUPT: flip bytes in the committed payload's largest
+        file (bit-rot after a clean commit) — the manifest's checksums no
+        longer match, so a later restore must detect it and fall back."""
+        if self._take_due(step, FaultKind.CKPT_CORRUPT) is None:
+            return
+        target = _largest_file(path)
+        if target is None:
+            logger.warning("chaos: no payload file to corrupt in %s", path)
+            return
+        logger.warning("chaos: corrupting checkpoint shard %s (step %d)",
+                       target, step)
+        corrupt_file(target)
+
+    # -- serving hook ------------------------------------------------------
+
+    def on_serve_retire(self, task: Any) -> None:
+        """SERVE_POISON: overwrite the retiring request's output signals
+        with a collapsed-entropy / inflated-margin profile (a poisoned
+        replica looping on one token) so the engine's output monitor must
+        flag it and quarantine the slot it ran on."""
+        event = self._take_at(int(task.request_id), FaultKind.SERVE_POISON)
+        if event is None:
+            return
+        logger.warning("chaos: poisoning serve output of request %d",
+                       task.request_id)
+        n = max(len(task.entropies), 1)
+        task.entropies[:] = [0.0] * n
+        task.margins[:] = [1e3 * float(event.severity)] * n
+
+
+def _corrupt_largest_leaf(params: Any) -> Any:
+    """NaN-out the largest parameter leaf (for transformer LMs that is the
+    tied embedding/LM-head matrix, guaranteed on the compute path).
+    Multiplication preserves the leaf's sharding/placement, so the
+    corrupted state feeds back into the jitted step without a relayout."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    idx = int(np.argmax([int(np.prod(np.shape(l))) for l in leaves]))
+    leaves[idx] = leaves[idx] * jnp.asarray(
+        float("nan"), dtype=leaves[idx].dtype
+    )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _largest_file(root: str) -> Optional[str]:
+    best, best_size = None, -1
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            p = os.path.join(dirpath, name)
+            size = os.path.getsize(p)
+            if size > best_size:
+                best, best_size = p, size
+    return best
+
+
+def corrupt_file(path: str, offset: int = 0, nbytes: int = 64) -> None:
+    """Flip bytes in-place (XOR 0xFF) — deterministic, size-preserving
+    corruption a checksum catches but a directory listing does not."""
+    size = os.path.getsize(path)
+    if size == 0:
+        with open(path, "wb") as f:
+            f.write(b"\xff")
+        return
+    offset = min(offset, size - 1)
+    nbytes = min(nbytes, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))

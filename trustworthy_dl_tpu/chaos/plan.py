@@ -1,0 +1,144 @@
+"""Deterministic fault plans — the chaos counterpart of
+``attacks.adversarial.AttackPlan``.
+
+An ``AttackPlan`` schedules *adversarial* behaviour (a node lying about its
+gradients); a ``FaultPlan`` schedules *infrastructure* failure: non-finite
+gradients from corrupted state, wedged hosts, preemptions, truncated or
+bit-rotten checkpoint shards, data-iterator failures, and poisoned serving
+replicas.  Production recovery machinery is only trustworthy if it is
+continuously exercised (Gemini's in-memory recovery, SOSP '23; Bamboo,
+NSDI '23) — the plan is the exercise schedule, and it is **seeded and
+reproducible**: the same ``(seed, horizon, rates)`` always generates the
+same events, so a survival drill can assert the *exact* number of retries,
+rollbacks and restarts the supervisor should perform (``predict``).
+
+Events are consumed by ``chaos.injector.FaultInjector`` at explicit hook
+points in ``DistributedTrainer.train_epoch``, ``CheckpointManager`` and
+``serve.ServingEngine``.  Each event fires **once** (the injector tracks
+fired events), so a post-rollback replay of the same global steps does not
+re-trigger the fault that caused the rollback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FaultKind(str, enum.Enum):
+    """What breaks.  ``step`` semantics per kind are documented on
+    ``FaultEvent``."""
+
+    #: Corrupt live parameters with NaN after step ``step`` completes —
+    #: every subsequent loss is genuinely non-finite until state is
+    #: restored from a checkpoint (the "silently corrupted optimizer
+    #: state" failure the supervisor's rollback path exists for).
+    GRAD_NAN = "grad_nan"
+    #: Host stall / straggler: sleep ``severity`` seconds before the step.
+    STALL = "stall"
+    #: Simulated preemption signal raised before the step runs — the
+    #: supervisor must save-on-signal and auto-resume.
+    PREEMPT = "preempt"
+    #: Flip bytes in a committed checkpoint's payload (bit-rot): fires on
+    #: the first checkpoint committed at global step >= ``step``.
+    CKPT_CORRUPT = "ckpt_corrupt"
+    #: Die between payload write and COMMIT marker: the first save at
+    #: global step >= ``step`` is left uncommitted on disk.
+    CKPT_CRASH = "ckpt_crash"
+    #: Data-iterator failure: the batch at ``step`` is lost (the loader
+    #: "raised"); training must continue on the next batch.
+    DATA_LOSS = "data_loss"
+    #: Poison a serving slot's output signals for request id ``step`` —
+    #: the engine's output monitor must flag and quarantine the slot.
+    SERVE_POISON = "serve_poison"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.  ``step`` is the trainer's *global step* for
+    training-side kinds, the minimum save step for checkpoint kinds, and
+    the request id for ``SERVE_POISON``.  ``severity`` is kind-specific
+    (stall seconds, poison magnitude); unused kinds ignore it."""
+
+    step: int
+    kind: FaultKind
+    severity: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable, seeded schedule of ``FaultEvent``s.
+
+    Build with :meth:`generate` (seeded rates over a step horizon) or
+    :meth:`scripted` (explicit events, for drills that must predict exact
+    recovery counts).  The plan itself is pure; all firing state lives in
+    the injector.
+    """
+
+    seed: int
+    events: Tuple[FaultEvent, ...]
+
+    @classmethod
+    def scripted(cls, events: Sequence[FaultEvent], seed: int = 0
+                 ) -> "FaultPlan":
+        return cls(seed=seed,
+                   events=tuple(sorted(events, key=lambda e: e.step)))
+
+    @classmethod
+    def generate(cls, seed: int, num_steps: int,
+                 rates: Mapping[FaultKind, float],
+                 severity: float = 1.0) -> "FaultPlan":
+        """Seeded Bernoulli draw per (step, kind): the same arguments
+        always produce the same plan, so a drill is reproducible from its
+        seed alone.  ``rates`` maps kind -> per-step probability."""
+        rng = np.random.default_rng(seed)
+        events: List[FaultEvent] = []
+        # Fixed kind order (enum declaration order) keeps the draw stream
+        # stable across python versions / dict orderings.
+        kinds = [k for k in FaultKind if rates.get(k, 0.0) > 0.0]
+        for step in range(num_steps):
+            for kind in kinds:
+                if rng.random() < rates[kind]:
+                    events.append(FaultEvent(
+                        step=step, kind=kind,
+                        severity=float(severity * (0.5 + rng.random())),
+                    ))
+        return cls(seed=seed, events=tuple(events))
+
+    def of_kind(self, kind: FaultKind) -> List[FaultEvent]:
+        return [e for e in self.events if e.kind is kind]
+
+    def count(self, kind: FaultKind) -> int:
+        return len(self.of_kind(kind))
+
+    def at(self, step: int, kind: Optional[FaultKind] = None
+           ) -> List[FaultEvent]:
+        """Events scheduled exactly at ``step`` (optionally one kind)."""
+        return [e for e in self.events
+                if e.step == step and (kind is None or e.kind is kind)]
+
+    def predict(self, max_retries: int, rollback_after: int
+                ) -> Dict[str, int]:
+        """Expected supervisor recovery counts for this plan under a
+        ``TrainingSupervisor(max_retries=..., rollback_after=...)``.
+
+        Valid when events are *isolated*: GRAD_NAN events spaced further
+        apart than the rollback window, and a verified checkpoint existing
+        before each (the supervisor writes one at start, so this holds for
+        any plan whose first GRAD_NAN is after step 0).  Each GRAD_NAN
+        corrupts state persistently, so every retry of a bad step fails:
+        the supervisor burns ``max_retries`` retries on each of
+        ``rollback_after`` consecutive bad steps, then rolls back once.
+        """
+        n_nan = self.count(FaultKind.GRAD_NAN)
+        return {
+            "retries": n_nan * rollback_after * max_retries,
+            "rollbacks": n_nan,
+            "restarts": self.count(FaultKind.PREEMPT),
+            "preemptions": self.count(FaultKind.PREEMPT),
+            "dropped_batches": self.count(FaultKind.DATA_LOSS),
+            "stalls": self.count(FaultKind.STALL),
+        }

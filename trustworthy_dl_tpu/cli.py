@@ -44,6 +44,29 @@ def build_parser() -> argparse.ArgumentParser:
                         help="disable the in-step attack detector")
     parser.add_argument("--steps-per-epoch", type=int, default=50,
                         help="synthetic-data epoch length")
+    # Self-healing supervisor (engine/supervisor.py) + chaos drills.
+    parser.add_argument("--supervise", action="store_true",
+                        help="wrap training in the self-healing supervisor: "
+                             "non-finite step guard, bounded retries, "
+                             "verified-checkpoint rollback, SIGTERM "
+                             "save-on-signal + capped auto-resume")
+    parser.add_argument("--max-retries", type=int, default=2,
+                        help="per-step retry budget before a step counts "
+                             "as bad (supervisor)")
+    parser.add_argument("--rollback-after", type=int, default=3,
+                        help="consecutive bad steps before rolling back to "
+                             "the last verified checkpoint (supervisor)")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="auto-resume budget after preemptions "
+                             "(supervisor)")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        help="run under a seeded chaos fault plan "
+                             "(implies --supervise): non-finite state, "
+                             "stalls, lost batches, preemptions, "
+                             "checkpoint corruption — chaos/plan.py")
+    parser.add_argument("--chaos-rate", type=float, default=0.02,
+                        help="per-step probability of each drill fault "
+                             "kind under --chaos-seed")
     return parser
 
 
@@ -85,7 +108,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                             batch_size=config.batch_size,
                             num_examples=max(num_examples // 10,
                                              config.batch_size))
-    result = trainer.train(train_dl, val_dl)
+    if args.supervise or args.chaos_seed is not None:
+        from trustworthy_dl_tpu.chaos import FaultInjector, FaultKind, \
+            FaultPlan
+        from trustworthy_dl_tpu.engine.supervisor import TrainingSupervisor
+
+        injector = None
+        max_restarts = args.max_restarts
+        if args.chaos_seed is not None:
+            horizon = args.steps_per_epoch * config.num_epochs
+            rate = args.chaos_rate
+            plan = FaultPlan.generate(args.chaos_seed, horizon, {
+                FaultKind.GRAD_NAN: rate,
+                FaultKind.DATA_LOSS: rate,
+                FaultKind.STALL: rate,
+                FaultKind.PREEMPT: rate / 4,
+                FaultKind.CKPT_CRASH: rate / 4,
+                FaultKind.CKPT_CORRUPT: rate / 4,
+            }, severity=0.05)
+            injector = FaultInjector(plan)
+            # Every planned preemption costs one restart; keep the budget
+            # above the plan so the drill exercises resume, not give-up.
+            max_restarts = max(max_restarts,
+                               plan.count(FaultKind.PREEMPT) + 1)
+            print(f"chaos drill: seed {args.chaos_seed}, "
+                  f"{len(plan.events)} fault(s) over {horizon} steps")
+        supervisor = TrainingSupervisor(
+            trainer, max_retries=args.max_retries,
+            rollback_after=args.rollback_after, max_restarts=max_restarts,
+            chaos=injector, handle_signals=True,
+        )
+        result = supervisor.run(train_dl, val_dl)
+        print(f"supervisor report: {result['supervisor']}")
+    else:
+        result = trainer.train(train_dl, val_dl)
     stats = result["stats"]
     print(f"Training completed: {stats['global_step']} steps, "
           f"final state {stats['training_state']}")
@@ -145,7 +201,10 @@ def generate_main(argv: Optional[List[str]] = None,
     # than let Orbax fail with a structure mismatch.  The topology sidecar
     # records the training parallelism for exactly this check.
     probe = CheckpointManager(args.checkpoint_dir)
-    latest = probe.latest_step()
+    # verified=False: this probe only reads the topology sidecar to
+    # refuse pipeline checkpoints — no reason to checksum the whole
+    # payload here (load_checkpoint verifies on the actual restore).
+    latest = probe.latest_step(verified=False)
     if latest is not None:
         meta = probe.load_metadata(latest) or {}
         if meta.get("parallelism") == "model":
@@ -271,7 +330,10 @@ def serve_main(argv: Optional[List[str]] = None,
         print("serving supports the dense GPT-2 family")
         return 2
     probe = CheckpointManager(args.checkpoint_dir)
-    latest = probe.latest_step()
+    # verified=False: this probe only reads the topology sidecar to
+    # refuse pipeline checkpoints — no reason to checksum the whole
+    # payload here (load_checkpoint verifies on the actual restore).
+    latest = probe.latest_step(verified=False)
     if latest is not None:
         meta = probe.load_metadata(latest) or {}
         if meta.get("parallelism") == "model":

@@ -309,6 +309,13 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     if not keep:
         raise ValueError("cannot evict every node")
 
+    # Quiesce the in-flight step before compacting/migrating and then
+    # DROPPING the old state: the caller (mid-_record_batch) has only
+    # materialised a few metric outputs, and freeing still-being-written
+    # output buffers races the async runtime (intermittent heap
+    # corruption on the CPU client — same hazard the supervisor's
+    # rollback quiesces).
+    jax.block_until_ready(trainer.state)
     t0 = time.perf_counter()
     # Remember each evicted coordinate's device group so a later
     # readmission (readmit_and_reshard) can restore it to the mesh.  In
@@ -341,6 +348,11 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)
+    # Re-own the migrated leaves before they enter the donated step: a
+    # cross-mesh device_put on the virtual-device CPU backend can alias
+    # host buffers across shards, and donating aliased buffers corrupts
+    # the heap (same family as the checkpoint-restore ownership fix).
+    new_state = jax.tree_util.tree_map(jnp.copy, new_state)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
 
@@ -476,6 +488,9 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
     n_old = config.num_nodes
     n_new = n_old + len(node_ids)
 
+    # Same quiesce as evict_and_reshard: the old state is dropped below
+    # while the caller's step may still be writing its unread outputs.
+    jax.block_until_ready(trainer.state)
     t0 = time.perf_counter()
     devices = list(trainer.mesh.devices.flat)
     for nid in node_ids:
@@ -504,6 +519,8 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)
+    # Re-own before donation — see evict_and_reshard.
+    new_state = jax.tree_util.tree_map(jnp.copy, new_state)
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
 

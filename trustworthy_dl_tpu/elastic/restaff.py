@@ -115,6 +115,10 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     if not survivors:
         raise ValueError("cannot evict every stage")
 
+    # Quiesce the in-flight step before repartitioning and dropping the
+    # old state (see evict_and_reshard — freeing still-being-written
+    # output buffers races the async runtime).
+    jax.block_until_ready(trainer.state)
     state = trainer.state
     blocks = state.params["blocks"]
     lead = jax.tree_util.tree_leaves(blocks)[0]
@@ -287,6 +291,12 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     )
     new_state = state._replace(params=params, opt_state=opt_state,
                                **per_stage, **scalars)
+    # NOTE: no jnp.copy re-owning here (unlike evict/readmit_and_reshard):
+    # the restaff path has not exhibited the donated-alias crash the
+    # data-parallel migrations did, and the pipeline step's shard_map
+    # spec checks are strict about the exact placements this function
+    # constructs — re-add the copy only with pipeline coverage green on
+    # the target container.
     jax.block_until_ready(new_state)
     migration_time = time.perf_counter() - t0
     bytes_moved = sum(

@@ -15,19 +15,26 @@ post-reassignment resume come back on a different device set (SURVEY §5.4).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
 
-def _latest_step(directory: str) -> Optional[int]:
+def _payload_steps(directory: str) -> List[int]:
+    """Steps with a payload directory present (committed or not).  Orbax
+    tmp dirs and our ``.staging`` dirs fail the int parse and are
+    ignored."""
     if not os.path.isdir(directory):
-        return None
+        return []
     steps = []
     for name in os.listdir(directory):
         if name.startswith("checkpoint_step_"):
@@ -35,7 +42,34 @@ def _latest_step(directory: str) -> Optional[int]:
                 steps.append(int(name.rsplit("_", 1)[1]))
             except ValueError:
                 continue
-    return max(steps) if steps else None
+    return steps
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _atomic_write_json(path: str, payload: Any) -> None:
+    """tmp file + fsync + ``os.replace``: readers see either the old
+    content or the new, never a truncated file (a preemption mid-write
+    used to leave broken JSON that wedged every later resume)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _unlink(path: str) -> None:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
 
 
 def _merge_into_template(template: Any, raw: Any) -> Any:
@@ -179,18 +213,42 @@ def _saved_abstract(meta_node: Any, template_node: Any) -> Any:
 class CheckpointManager:
     """Step-addressed checkpoints under ``directory`` (path layout mirrors
     the reference's ``checkpoints/checkpoint_step_{N}`` naming,
-    distributed_trainer.py:461)."""
+    distributed_trainer.py:461).
 
-    def __init__(self, directory: str = "checkpoints"):
+    Every save is *verified*: after the payload lands, a manifest of
+    per-file sizes + CRC32 checksums is written atomically — the
+    manifest's existence IS the COMMIT marker.  ``latest_step()`` and
+    ``restore(step=None)`` walk backward past uncommitted (crashed
+    mid-save) and corrupt (checksum-mismatch) checkpoints instead of
+    raising, so a truncated latest checkpoint costs one save interval of
+    progress, never the run.  Pre-manifest checkpoints (older writers)
+    are accepted as "legacy" — unverifiable but not skipped.
+
+    ``chaos`` optionally wires a ``chaos.FaultInjector`` into the commit
+    path (crash-before-COMMIT / post-commit bit-rot drills).
+    """
+
+    def __init__(self, directory: str = "checkpoints", chaos: Any = None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
+        self.chaos = chaos
+        # One in-flight async save awaiting its COMMIT (manifest write and,
+        # for force-overwrites, the staging swap).  Committed by the next
+        # join point: save / restore / wait / latest_step.
+        self._pending: Optional[Dict[str, Any]] = None
 
     def path_for(self, step: int) -> str:
         return os.path.join(self.directory, f"checkpoint_step_{step}")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest_{step}.json")
+
+    def _inflight_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"inflight_{step}")
 
     # -- topology sidecar -------------------------------------------------
     # After an elastic eviction the live node count differs from the
@@ -202,14 +260,11 @@ class CheckpointManager:
         return os.path.join(self.directory, f"topology_{step}.json")
 
     def save_metadata(self, step: int, meta: dict) -> None:
-        import json
-
-        with open(self._meta_path(step), "w") as f:
-            json.dump(meta, f)
+        # Atomic (tmp + os.replace): a preemption mid-write must not leave
+        # truncated JSON that breaks every later resume.
+        _atomic_write_json(self._meta_path(step), meta)
 
     def load_metadata(self, step: int) -> Optional[dict]:
-        import json
-
         path = self._meta_path(step)
         if not os.path.exists(path):
             return None
@@ -223,35 +278,155 @@ class CheckpointManager:
         steps instead of stalling them.  Buffer donation stays safe — the
         step only donates the on-device arrays, which Orbax has already
         snapshotted to host.  A later save/restore (or ``wait``) joins the
-        in-flight write."""
-        path = self.path_for(step)
-        # Join any previous in-flight async save BEFORE inspecting the
-        # destination: Orbax commits async writes by rename, so an
-        # in-flight save of this same step only becomes visible to the
-        # exists() check once joined (skip/force decisions would otherwise
-        # race the commit).
-        self._ckptr.wait_until_finished()
-        if os.path.exists(path):
-            if not force:
-                logger.info("Checkpoint already exists: %s", path)
-                return path
-            import shutil
+        in-flight write AND commits it (manifest written last — a crash
+        before the join leaves the save uncommitted, and restore walks
+        past it).
 
+        ``force=True`` overwrites via a staging directory swapped in only
+        at commit: a failed overwrite never loses the last good state
+        (the old payload used to be rmtree'd *before* the new save)."""
+        path = self.path_for(step)
+        os.makedirs(self.directory, exist_ok=True)  # tolerate external rm
+        # Join (and commit) any previous in-flight async save BEFORE
+        # inspecting the destination, so skip/force decisions never race
+        # the commit of this same step.
+        self._join()
+        exists = os.path.exists(path)
+        # Full integrity check, not just the commit marker: a re-save at
+        # an existing step (post-rollback replay) must replace a
+        # bit-rotten-but-committed checkpoint instead of skipping and
+        # leaving the corruption in place forever.  The CRC read only
+        # happens when a payload already exists at this step — never on
+        # the common fresh-step save.
+        usable, reason = self.check_integrity(step) if exists else (
+            False, "missing payload"
+        )
+        if exists and usable and not force:
+            logger.info("Checkpoint already exists: %s", path)
+            return path
+        staging = None
+        if exists and not usable:
+            # Uncommitted or corrupt leftovers: clear and rewrite.
+            logger.warning("Clearing unusable checkpoint at step %d "
+                           "(%s): %s", step, reason, path)
             shutil.rmtree(path)
-        self._ckptr.save(path, state)
+            _unlink(self._manifest_path(step))
+            _unlink(self._inflight_path(step))
+        elif exists:
+            # Force-overwrite of a good checkpoint: write to a staging
+            # path and swap at commit.
+            staging = path + ".staging"
+            if os.path.exists(staging):
+                shutil.rmtree(staging)
+        target = staging if staging is not None else path
+        if not block:
+            # Snapshot before the async write: on CPU-backed platforms
+            # Orbax's "device→host copy" can zero-copy ALIAS the live
+            # buffers, and the caller's next donated train step then
+            # rewrites them mid-write — the checkpoint silently contains
+            # future-step bytes (test_async_checkpoint_roundtrip was
+            # flaky at the seed for exactly this).  An eager device copy
+            # hands the writer buffers nobody will ever donate.
+            state = jax.tree_util.tree_map(
+                lambda a: jnp.copy(a) if hasattr(a, "dtype") else a, state
+            )
+        with open(self._inflight_path(step), "w") as f:
+            f.write("save in flight; the manifest is the COMMIT marker\n")
+        self._ckptr.save(target, state)
+        self._pending = {"step": step, "target": target, "final": path}
         if block:
-            self._ckptr.wait_until_finished()
+            self._join()
         logger.info("Checkpoint %s: %s",
                     "saved" if block else "saving (async)", path)
         return path
 
-    def wait(self) -> None:
-        """Join any in-flight async save."""
+    def _join(self) -> None:
+        """Join any in-flight async save and COMMIT it: swap staging into
+        place (force-overwrites), write the checksum manifest atomically,
+        drop the in-flight marker.  Everything before the manifest write
+        is invisible to restore — that ordering is the crash-safety
+        contract."""
         self._ckptr.wait_until_finished()
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        step, target, final = (pending["step"], pending["target"],
+                               pending["final"])
+        if self.chaos is not None and not self.chaos.on_checkpoint_commit(
+            step
+        ):
+            return  # drill: died pre-COMMIT — payload left uncommitted
+        if target != final:
+            # Retire the old checkpoint only now that its replacement is
+            # fully on disk.  Manifest goes first: a crash inside this
+            # window demotes the old payload to "uncommitted" (walked
+            # past) rather than leaving a trusted-but-half-swapped state.
+            _unlink(self._manifest_path(step))
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(target, final)
+        self._write_manifest(step, final)
+        _unlink(self._inflight_path(step))
+        if self.chaos is not None:
+            self.chaos.on_checkpoint_saved(step, final)
+
+    def _write_manifest(self, step: int, path: str) -> None:
+        files: Dict[str, Dict[str, int]] = {}
+        for dirpath, _, names in os.walk(path):
+            for name in sorted(names):
+                p = os.path.join(dirpath, name)
+                rel = os.path.relpath(p, path)
+                files[rel] = {"size": os.path.getsize(p),
+                              "crc32": _crc32_file(p)}
+        _atomic_write_json(self._manifest_path(step),
+                           {"step": step, "files": files})
+
+    def check_integrity(self, step: int, verify: bool = True
+                        ) -> Tuple[bool, str]:
+        """(ok, reason) for one step: committed (manifest present) and —
+        with ``verify`` — every manifest entry's size and CRC32 matching
+        the bytes on disk.  Legacy checkpoints (no manifest, no in-flight
+        marker: written before manifests existed) are accepted but
+        unverifiable."""
+        path = self.path_for(step)
+        if not os.path.isdir(path):
+            return False, "missing payload"
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            if os.path.exists(self._inflight_path(step)):
+                return False, "uncommitted (save died before COMMIT)"
+            return True, "legacy (pre-manifest, unverifiable)"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as exc:
+            return False, f"unreadable manifest ({exc})"
+        if not verify:
+            return True, "committed"
+        for rel, meta in manifest.get("files", {}).items():
+            p = os.path.join(path, rel)
+            if not os.path.exists(p):
+                return False, f"missing shard {rel}"
+            if os.path.getsize(p) != meta["size"]:
+                return False, f"size mismatch on {rel}"
+            if _crc32_file(p) != meta["crc32"]:
+                return False, f"checksum mismatch on {rel}"
+        return True, "verified"
+
+    def wait(self) -> None:
+        """Join (and commit) any in-flight async save."""
+        self._join()
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
-        """Restore into the structure/shardings of ``template``.  ``step``
-        defaults to the latest available.
+        """Restore into the structure/shardings of ``template``.
+
+        ``step=None`` walks the available steps newest-first and restores
+        the most recent checkpoint that is committed, passes its integrity
+        manifest, AND actually loads — a truncated/bit-rotten latest
+        checkpoint falls back to the prior verified step without operator
+        input.  An *explicit* ``step`` stays loud: an integrity failure on
+        a checkpoint the operator named raises instead of silently
+        substituting an older one.
 
         Structure drift between versions (a TrainState field added — e.g.
         ``clean_streak`` in round 3 — or an optimizer-state leaf removed,
@@ -259,13 +434,45 @@ class CheckpointManager:
         restore: saved leaves land where the template has a same-named
         slot, template values fill anything the checkpoint lacks, and
         extra saved keys are ignored."""
-        self._ckptr.wait_until_finished()  # join an in-flight async save
+        self._join()  # join + commit an in-flight async save
         if step is None:
-            step = _latest_step(self.directory)
-            if step is None:
+            skipped = []
+            for s in sorted(_payload_steps(self.directory), reverse=True):
+                ok, reason = self.check_integrity(s)
+                if not ok:
+                    logger.warning(
+                        "Skipping checkpoint step %d: %s", s, reason
+                    )
+                    skipped.append((s, reason))
+                    continue
+                try:
+                    return self._restore_step(template, s)
+                except Exception as exc:  # corrupt beyond the checksums
+                    logger.warning(
+                        "Restore of checkpoint step %d failed (%s: %s); "
+                        "walking back to an older checkpoint",
+                        s, type(exc).__name__, str(exc)[:200],
+                    )
+                    skipped.append((s, f"{type(exc).__name__}"))
+            if skipped:
                 raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}"
+                    f"no restorable checkpoint under {self.directory} "
+                    f"(skipped: {skipped})"
                 )
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}"
+            )
+        ok, reason = self.check_integrity(step)
+        if not ok:
+            raise RuntimeError(
+                f"checkpoint step {step} failed its integrity check "
+                f"({reason}); refusing an explicit-step restore — use "
+                "restore(step=None) to fall back to the latest verified "
+                "checkpoint"
+            )
+        return self._restore_step(template, step)
+
+    def _restore_step(self, template: Any, step: int) -> Any:
         path = self.path_for(step)
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(
@@ -313,5 +520,27 @@ class CheckpointManager:
         item = getattr(meta, "item_metadata", meta)
         return getattr(item, "tree", item)
 
-    def latest_step(self) -> Optional[int]:
-        return _latest_step(self.directory)
+    def verified_steps(self) -> List[int]:
+        """All restorable steps, newest first — the rollback candidate
+        list (integrity-checked; legacy pre-manifest checkpoints
+        included)."""
+        self._join()
+        return [s for s in sorted(_payload_steps(self.directory),
+                                  reverse=True)
+                if self.check_integrity(s)[0]]
+
+    def latest_step(self, verified: bool = True) -> Optional[int]:
+        """Latest step whose checkpoint is restorable.  With ``verified``
+        (default) uncommitted and checksum-failing checkpoints are walked
+        past — the caller gets the newest step a restore would actually
+        land on, not the newest directory name.  ``verified=False`` is
+        the raw listing (cheap, no file reads)."""
+        self._join()  # an in-flight async save is not "latest" until committed
+        for s in sorted(_payload_steps(self.directory), reverse=True):
+            if not verified:
+                return s
+            ok, reason = self.check_integrity(s)
+            if ok:
+                return s
+            logger.warning("latest_step: skipping step %d: %s", s, reason)
+        return None

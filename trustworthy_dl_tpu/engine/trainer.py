@@ -252,6 +252,21 @@ class DistributedTrainer:
         self._per_node_batch: Optional[int] = None
         self._trim_grace = 0
         self.attack_plan: AttackPlan = null_plan(config.num_nodes)
+        # Robustness hook points (chaos/ + engine/supervisor.py).  Both are
+        # per-run host state so reset_for_run detaches them: ``chaos`` is a
+        # chaos.FaultInjector consulted in the step loop (fault injection);
+        # ``step_guard`` is a supervisor implementing ``after_step(trainer,
+        # node_batch, metrics) -> Optional[StepMetrics]`` — returning None
+        # rejects the step (the trainer must not account it).
+        self.chaos: Any = None
+        self.step_guard: Any = None
+        # A supervisor also wires its injector into the checkpointer's
+        # commit hooks; detach that too on reset, or a previous run's
+        # UNFIRED checkpoint faults would fire in the next clean run.
+        # (hasattr: the constructor calls this before the checkpointer
+        # exists.)
+        if hasattr(self, "checkpointer"):
+            self.checkpointer.chaos = None
 
     def initialize(self, seed: Optional[int] = None) -> TrainState:
         """Init params/optimizer/world-view.  Params are replicated over the
@@ -566,6 +581,16 @@ class DistributedTrainer:
                 per = lead // (self.config.num_nodes * accum)
                 if per > 0:
                     self._per_node_batch = per
+            if self.chaos is not None:
+                # Fault-injection hooks (chaos/injector.py): a lost batch
+                # (simulated data-iterator failure) rides the stale-batch
+                # skip path; on_step_start may stall (straggler) or raise
+                # SimulatedPreemption for the supervisor to catch.
+                batch = self.chaos.on_batch(self.global_step, batch)
+                if batch is None:
+                    self.global_step -= 1
+                    continue
+                self.chaos.on_step_start(self.global_step)
             node_batch = self._node_batch(batch)
             if node_batch is None:  # stale undersized batch mid-transition
                 self.global_step -= 1
@@ -574,6 +599,18 @@ class DistributedTrainer:
                 self.state, metrics = self._train_step(
                     self.state, node_batch, self.attack_plan
                 )
+            if self.chaos is not None:
+                self.state, metrics = self.chaos.on_step_end(
+                    self.global_step, self.state, metrics
+                )
+            if self.step_guard is not None:
+                metrics = self.step_guard.after_step(self, node_batch,
+                                                     metrics)
+                if metrics is None:
+                    # Step rejected (non-finite / wedged) — possibly rolled
+                    # back to a verified checkpoint (global_step restored by
+                    # load_checkpoint).  Nothing to account.
+                    continue
             self.metrics_collector.tick()
             loss = float(metrics.loss)
             self._record_batch(metrics, epoch, loss)
@@ -1076,12 +1113,31 @@ class DistributedTrainer:
     def save_checkpoint(self) -> Optional[str]:
         if self.state is None:
             return None
-        import os
-
+        # Never persist non-finite params over the last good checkpoint:
+        # "verified" means integrity-verified AND taken from sane state.
+        # Without this gate, corruption landing exactly on a save step
+        # would poison the rollback target itself — the supervisor would
+        # then restore NaN state forever while reporting recovery.  Cost
+        # is one reduction per param leaf at save cadence, not per step.
+        finite = all(
+            bool(jnp.all(jnp.isfinite(leaf)))
+            for leaf in jax.tree_util.tree_leaves(self.state.params)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+        )
+        if not finite:
+            logger.error(
+                "Refusing to checkpoint non-finite params at step %d; "
+                "keeping the last good checkpoint", self.global_step,
+            )
+            return None
         # Sidecar and payload must stay in sync: CheckpointManager.save
-        # skips an existing step directory, so a pre-existing payload (a
-        # reused checkpoint_dir) must not get its topology overwritten.
-        already = os.path.exists(self.checkpointer.path_for(self.global_step))
+        # skips an existing COMMITTED step directory, so a pre-existing
+        # payload (a reused checkpoint_dir) must not get its topology
+        # overwritten — but uncommitted junk from a crashed save IS
+        # rewritten by save(), so its sidecar must be rewritten with it.
+        already = self.checkpointer.check_integrity(
+            self.global_step, verify=False
+        )[0]
         path = self.checkpointer.save(
             self.state, self.global_step,
             block=not self.config.async_checkpoint,
@@ -1222,6 +1278,21 @@ class DistributedTrainer:
         if self.state is None:
             self.initialize()
         self.state = self.checkpointer.restore(self.state, step)
+        # Two resume hazards fixed here, in order:
+        # 1. Ownership: on CPU-backed platforms the checkpoint reader can
+        #    hand back arrays that zero-copy alias ITS host memory, and
+        #    the train step's donate_argnums would then free buffers XLA
+        #    does not own (observed as intermittent heap corruption a few
+        #    dozen donated steps after any resume).  The eager copy
+        #    re-homes every leaf into runtime-owned buffers.
+        # 2. Placement: a leaf the host replaced mid-run with an
+        #    uncommitted array (e.g. _epoch_intelligence's threshold
+        #    push-back) restores COMMITTED to device 0, and the next step
+        #    would refuse to mix it with mesh-committed params —
+        #    _place_on_mesh re-pins everything exactly like initialize().
+        self.state = self._place_on_mesh(
+            jax.tree_util.tree_map(jnp.copy, self.state)
+        )
         if meta:
             self.node_map = [int(i) for i in meta["node_map"]]
             # Original ids can exceed the constructor's node count (e.g. a

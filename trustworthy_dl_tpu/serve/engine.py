@@ -138,7 +138,13 @@ class ServingEngine:
                  rng: Optional[jax.Array] = None,
                  monitor: Optional[OutputMonitor] = None,
                  enable_monitor: bool = True,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 chaos: Any = None):
+        # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
+        # events overwrite a retiring request's output signals — the
+        # deterministic drill for the monitor→quarantine path (a poisoned
+        # replica must lose its slot, not keep serving).
+        self.chaos = chaos
         self.cfg = cfg
         self.scheduler = ContinuousBatchingScheduler(
             params, cfg, max_slots, max_seq, buckets
@@ -316,6 +322,11 @@ class ServingEngine:
     def _finish(self, task: SlotTask, request: ServeRequest,
                 status: str) -> None:
         rid = task.request_id
+        if self.chaos is not None:
+            # Chaos hook point: a SERVE_POISON event for this request id
+            # rewrites the recorded entropy/margin signals before the
+            # monitor scores them (simulating a compromised replica).
+            self.chaos.on_serve_retire(task)
         flagged, z = False, 0.0
         if self.monitor is not None and task.entropies:
             flagged, z = self.monitor.observe(task.entropies, task.margins)
