@@ -16,6 +16,12 @@ The supervisor's report must match ``FaultPlan.predict`` exactly — the
 recovery machinery is deterministic, which is what makes it testable
 (tests/test_survival.py asserts the same counts).
 
+The drill also routes through the obs flight recorder: every fault,
+retry, rollback and preemption is a trace event, the supervisor dumps
+the ring buffer next to the checkpoints on each incident, and the final
+dump's event counts are asserted against the SAME ``predict`` numbers —
+the post-mortem artifact and the recovery report cannot drift apart.
+
 Run:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/chaos_drill.py
@@ -24,6 +30,8 @@ Env knobs (the test smoke path shrinks with these): TDDL_DRILL_EPOCHS,
 TDDL_DRILL_CKPT_DIR.
 """
 
+import glob
+import json
 import os
 import shutil
 
@@ -35,6 +43,7 @@ from trustworthy_dl_tpu import (
 )
 from trustworthy_dl_tpu.chaos import FaultEvent, FaultInjector, FaultKind, \
     FaultPlan
+from trustworthy_dl_tpu.obs import ObsSession
 
 TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
             seq_len=16)
@@ -71,9 +80,10 @@ def main() -> None:
         FaultEvent(step=12, kind=FaultKind.GRAD_NAN),
         FaultEvent(step=18, kind=FaultKind.PREEMPT),
     ])
+    obs = ObsSession(os.path.join(ckpt_dir, "obs"))
     supervisor = TrainingSupervisor(
         trainer, max_retries=2, rollback_after=2, max_restarts=2,
-        chaos=FaultInjector(plan),
+        chaos=FaultInjector(plan), obs=obs,
     )
     result = supervisor.run(dl, num_epochs=epochs)
     report = result["supervisor"]
@@ -91,7 +101,42 @@ def main() -> None:
         assert got == want, f"{key}: predicted {want}, got {got}"
     assert report["rollback_steps"] == [5], report["rollback_steps"]
     assert final_loss < base_loss + 0.75, (final_loss, base_loss)
-    print("drill survived with the plan-predicted recovery counts")
+
+    # Flight-recorder post-mortems: the rollback and the preemption each
+    # dumped the ring buffer next to the checkpoints mid-run...
+    dumps = sorted(glob.glob(os.path.join(ckpt_dir, "flight_*.json")))
+    reasons = set()
+    for p in dumps:
+        with open(p) as f:
+            reasons.add(json.load(f)["reason"])
+    assert {"guard_trip", "rollback", "preemption"} <= reasons, reasons
+    # ...and the final dump's event sequence must carry the SAME recovery
+    # counts the plan predicted — the artifact a post-mortem reads agrees
+    # with the report the supervisor returns, by construction.
+    final_dump = obs.dump_flight("drill", directory=ckpt_dir)
+    with open(final_dump) as f:
+        events = json.load(f)["events"]
+
+    def count(etype, **match):
+        return sum(
+            e["type"] == etype and all(e.get(k) == v
+                                       for k, v in match.items())
+            for e in events
+        )
+
+    observed = {
+        "retries": count("supervisor_retry"),
+        "rollbacks": count("supervisor_rollback"),
+        "restarts": count("supervisor_restart"),
+        "preemptions": count("preemption"),
+        "dropped_batches": count("chaos_fault", kind="data_loss"),
+        "stalls": count("chaos_fault", kind="stall"),
+    }
+    print(f"flight dump {os.path.basename(final_dump)}: {observed}")
+    assert observed == predicted, (observed, predicted)
+    obs.finalize()
+    print("drill survived with the plan-predicted recovery counts "
+          "(supervisor report AND flight-recorder events)")
 
 
 if __name__ == "__main__":

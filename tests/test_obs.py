@@ -1,0 +1,425 @@
+"""Unified telemetry (trustworthy_dl_tpu/obs/): registry semantics,
+event-schema validation, flight-recorder dump-on-rollback, run-metadata
+stamping — all host-only (nothing jits), fast tier.
+
+Also the artifact-stamping CONTRACT test: any ``experiments/`` module or
+``bench.py`` that writes a JSON artifact must reference the shared
+``run_metadata`` helper — the regression class VERDICT weak #5 flagged
+(numbers published without the platform that produced them) stays closed
+permanently.
+"""
+
+import json
+import os
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from trustworthy_dl_tpu.obs import (
+    EVENT_SCHEMAS,
+    EventType,
+    FlightRecorder,
+    MetricsRegistry,
+    ObsSession,
+    PHASES,
+    StepTimeReporter,
+    TraceBus,
+    mfu_from_throughput,
+    run_metadata,
+)
+from trustworthy_dl_tpu.obs.events import read_jsonl, validate_event
+from trustworthy_dl_tpu.obs.meta import RUN_METADATA_KEYS
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("tddl_x_total", "things", labels=("kind",))
+    c.inc(kind="a")
+    c.inc(2.5, kind="a")
+    c.inc(kind="b")
+    assert c.value(kind="a") == 3.5
+    assert c.value(kind="b") == 1.0
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")  # counters only go up
+
+    g = reg.gauge("tddl_x_depth")
+    g.set(7)
+    g.set(3)
+    assert g.value() == 3.0
+
+    h = reg.histogram("tddl_x_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    hv = h.value()
+    assert hv["bucket_counts"] == [1, 2, 1]  # <=0.1, <=1.0, +Inf
+    assert hv["count"] == 4
+    assert hv["sum"] == pytest.approx(6.05)
+
+
+def test_registry_label_cardinality_bound():
+    reg = MetricsRegistry(max_series=2)
+    c = reg.counter("tddl_ids_total", labels=("id",))
+    c.inc(id=1)
+    c.inc(id=2)
+    with pytest.raises(ValueError, match="cardinality"):
+        c.inc(id=3)
+    # Existing series keep working after the bound trips.
+    c.inc(id=1)
+    assert c.value(id=1) == 2.0
+
+
+def test_registry_rejects_kind_conflicts_and_bad_names():
+    reg = MetricsRegistry()
+    reg.counter("tddl_a_total")
+    with pytest.raises(ValueError):
+        reg.gauge("tddl_a_total")  # same name, different kind
+    with pytest.raises(ValueError):
+        reg.counter("not a metric name!")
+    with pytest.raises(ValueError):
+        reg.counter("tddl_b_total", labels=("bad label",))
+    # Wrong label set at update time fails loudly too.
+    c = reg.counter("tddl_c_total", labels=("kind",))
+    with pytest.raises(ValueError):
+        c.inc(other="x")
+
+
+def test_snapshot_json_round_trip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("tddl_r_total", "help text", labels=("k",)).inc(k="x")
+    reg.gauge("tddl_r_depth").set(2.0)
+    reg.histogram("tddl_r_seconds", buckets=(0.5,)).observe(0.2)
+    snap = reg.snapshot()
+    # Through JSON (what snapshot_to_json persists) and back.
+    loaded = json.loads(json.dumps(snap))
+    rebuilt = MetricsRegistry.from_snapshot(loaded)
+    assert rebuilt.snapshot() == snap
+
+    path = tmp_path / "m.json"
+    written = reg.snapshot_to_json(str(path))
+    on_disk = json.loads(path.read_text())
+    assert on_disk["metrics"] == snap["metrics"]
+    assert set(RUN_METADATA_KEYS) <= set(written["run_metadata"])
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("tddl_p_total", "things", labels=("kind",)).inc(kind="a")
+    reg.histogram("tddl_p_seconds", buckets=(1.0,)).observe(0.5)
+    text = reg.prometheus_text()
+    assert '# TYPE tddl_p_total counter' in text
+    assert 'tddl_p_total{kind="a"} 1.0' in text
+    assert 'tddl_p_seconds_bucket{le="1"} 1' in text
+    assert 'tddl_p_seconds_bucket{le="+Inf"} 1' in text
+    assert 'tddl_p_seconds_count 1' in text
+
+
+# ---------------------------------------------------------------------------
+# Events / trace bus
+# ---------------------------------------------------------------------------
+
+
+def _minimal_event(etype: EventType) -> dict:
+    schema = EVENT_SCHEMAS[etype]
+    event = {"type": etype.value, "seq": 1, "t": 0.0, "t_mono": 0.0}
+    for key in schema["requires"]:
+        event[key] = 1
+    for field in schema["fields"]:
+        event[field] = "x"
+    return event
+
+
+def test_every_event_type_has_a_schema_and_validates():
+    assert set(EVENT_SCHEMAS) == set(EventType)
+    for etype in EventType:
+        validate_event(_minimal_event(etype))
+
+
+def test_event_validation_catches_missing_fields_and_unknown_types():
+    with pytest.raises(ValueError, match="unknown event type"):
+        validate_event({"type": "nonsense"})
+    for etype in EventType:
+        schema = EVENT_SCHEMAS[etype]
+        for key in schema["requires"]:
+            bad = _minimal_event(etype)
+            del bad[key]
+            with pytest.raises(ValueError, match="requires correlation"):
+                validate_event(bad)
+        for field in schema["fields"]:
+            bad = _minimal_event(etype)
+            del bad[field]
+            with pytest.raises(ValueError, match="missing required"):
+                validate_event(bad)
+
+
+def test_trace_bus_writes_correlated_jsonl(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    reg = MetricsRegistry()
+    bus = TraceBus(str(path), registry=reg)
+    bus.emit(EventType.TRAIN_STEP, step=3, loss=1.0, grad_norm=0.5)
+    bus.emit(EventType.CKPT_SAVE, step=3, path="/ckpt")
+    bus.emit(EventType.SERVE_SUBMIT, request_id=9, prompt_len=4,
+             max_new_tokens=8)
+    with pytest.raises(ValueError):
+        bus.emit(EventType.TRAIN_STEP, loss=1.0, grad_norm=0.5)  # no step
+    bus.close()
+
+    events = read_jsonl(str(path))
+    assert [e["seq"] for e in events] == [1, 2, 3]
+    assert all("t" in e and "t_mono" in e for e in events)
+    # Step correlation: the ckpt event joins the train step on step id.
+    assert events[0]["step"] == events[1]["step"] == 3
+    assert events[2]["request_id"] == 9
+    counts = reg.get("tddl_obs_events_total")
+    assert counts.value(type="train_step") == 1.0
+    assert counts.value(type="ckpt_save") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_and_dump(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    bus = TraceBus(None, recorder=rec)
+    for step in range(10):
+        bus.emit(EventType.TRAIN_STEP, step=step, loss=0.0, grad_norm=0.0)
+    events = rec.events()
+    assert len(events) == 4                       # ring bound
+    assert [e["step"] for e in events] == [6, 7, 8, 9]  # newest retained
+    assert rec.total_recorded == 10
+    assert rec.counts() == {"train_step": 4}
+
+    p1 = rec.dump(str(tmp_path), "rollback", step=9)
+    p2 = rec.dump(str(tmp_path), "rollback", step=9)
+    assert p1 != p2                               # incidents never collide
+    payload = json.loads(Path(p1).read_text())
+    assert payload["reason"] == "rollback"
+    assert payload["step"] == 9
+    assert payload["num_events"] == 4
+    assert [e["step"] for e in payload["events"]] == [6, 7, 8, 9]
+    assert set(RUN_METADATA_KEYS) <= set(payload["run_metadata"])
+
+
+def test_supervisor_dumps_flight_recorder_on_rollback(tmp_path):
+    """Dump-on-rollback via a seeded fault, host-only: a duck-typed
+    trainer whose step is persistently bad (the GRAD_NAN signature —
+    masked loss 0.0 with zero finite nodes) drives the real supervisor
+    ladder; the rollback must leave flight-recorder dumps next to the
+    checkpoints whose events record the retries and the restore."""
+    from trustworthy_dl_tpu.engine.supervisor import TrainingSupervisor
+
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    bad = SimpleNamespace(loss=np.float32(0.0), grad_norm=np.float32(0.0),
+                          finite=np.zeros(4, bool))
+
+    class FakeTrainer:
+        def __init__(self):
+            self.global_step = 12
+            self.state = {"w": np.zeros(2, np.float32)}
+            self.attack_plan = None
+            self.step_guard = None
+            self.chaos = None
+            self.obs = None
+            self.training_state = None
+            self.config = SimpleNamespace(checkpoint_dir=str(ckpt_dir))
+            self.checkpointer = SimpleNamespace(
+                verified_steps=lambda: [5], chaos=None, trace=None,
+            )
+            self.restored = []
+
+        def attach_obs(self, session):
+            self.obs = session
+
+        def _train_step(self, state, batch, plan):
+            return state, bad
+
+        def load_checkpoint(self, step):
+            self.restored.append(step)
+            self.global_step = step
+
+    trainer = FakeTrainer()
+    session = ObsSession(None, registry=MetricsRegistry())  # in-memory
+    supervisor = TrainingSupervisor(trainer, max_retries=1,
+                                    rollback_after=2, obs=session)
+    assert supervisor.after_step(trainer, {}, bad) is None  # streak 1
+    assert supervisor.after_step(trainer, {}, bad) is None  # -> rollback
+    assert trainer.restored == [5]
+    assert supervisor.rollbacks == 1 and supervisor.retries == 2
+
+    dumps = sorted(ckpt_dir.glob("flight_*.json"))
+    reasons = [p.name.split("_")[2] for p in dumps]
+    assert "guard" in reasons[0]      # first bad step of the streak
+    assert any("rollback" in r for r in reasons)
+    rollback_dump = json.loads(dumps[-1].read_text())
+    types = [e["type"] for e in rollback_dump["events"]]
+    assert types.count("supervisor_retry") == 2
+    assert types.count("guard_trip") == 2
+    assert "supervisor_rollback" in types
+    restore_event = next(e for e in rollback_dump["events"]
+                         if e["type"] == "supervisor_rollback")
+    assert restore_event["step"] == 12
+    assert restore_event["restored_step"] == 5
+    # Registry absorbed the same ladder counts.
+    actions = session.registry.get("tddl_supervisor_actions_total")
+    assert actions.value(action="retry") == 2.0
+    assert actions.value(action="rollback") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Step-time reporter / MFU
+# ---------------------------------------------------------------------------
+
+
+def test_step_time_reporter_phases_and_mfu():
+    reg = MetricsRegistry()
+    reporter = StepTimeReporter(registry=reg)
+    reporter.set_model_info(n_params=1_000_000, tokens_per_step=2048,
+                            model_kind="lm", num_chips=2)
+    for _ in range(3):
+        reporter.discard_step()
+        time.sleep(0.002)
+        reporter.lap("data")
+        time.sleep(0.004)
+        reporter.lap("compute")
+        reporter.finish_step()
+    report = reporter.report()
+    assert report["num_steps"] == 3
+    phases = report["phases"]
+    assert set(phases) == {"data", "compute"}
+    assert phases["compute"]["fraction"] > phases["data"]["fraction"]
+    assert sum(p["fraction"] for p in phases.values()) == pytest.approx(1.0)
+    mfu = report["mfu"]
+    assert mfu["mfu"] is not None and mfu["mfu"] > 0
+    assert mfu["num_chips"] == 2
+    assert mfu["tokens_per_step"] == 2048
+    phase_hist = reg.get("tddl_phase_time_seconds")
+    assert phase_hist.value(phase="data")["count"] == 3
+    assert phase_hist.value(phase="compute")["count"] == 3
+    # End-to-end step time stays MetricsCollector's series — the
+    # reporter must not publish a near-duplicate under a second name.
+    assert reg.get("tddl_step_time_seconds") is None
+
+    with pytest.raises(ValueError):
+        reporter.lap("not_a_phase")
+
+
+def test_step_time_reporter_discard_drops_partial_step():
+    reporter = StepTimeReporter()
+    reporter.lap("data")
+    reporter.discard_step()
+    reporter.finish_step()
+    assert reporter.num_steps == 0
+
+
+def test_mfu_from_throughput_names_its_peak_source():
+    block = mfu_from_throughput(124_000_000, 50_000, device_kind="TPU v4")
+    assert block["peak_flops_per_chip"] == 275e12
+    assert block["peak_flops_source"].startswith("bf16-peak-table")
+    assert block["mfu"] == pytest.approx(
+        6 * 124e6 * 50e3 / 275e12, rel=1e-6
+    )
+    fallback = mfu_from_throughput(124_000_000, 50_000, device_kind="???")
+    assert fallback["mfu"] is not None
+    assert "estimate" in fallback["peak_flops_source"] \
+        or "env" in fallback["peak_flops_source"]
+
+
+def test_phase_names_cover_the_issue_contract():
+    # data/forward/backward/optimizer/detection/host_sync are the named
+    # vocabulary shared with utils.profiling's trace annotations.
+    for name in ("data", "forward", "backward", "optimizer", "detection",
+                 "host_sync"):
+        assert name in PHASES
+
+
+# ---------------------------------------------------------------------------
+# MetricsCollector -> registry absorption
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_collector_feeds_registry():
+    from trustworthy_dl_tpu.utils.metrics import MetricsCollector
+
+    reg = MetricsRegistry()
+    collector = MetricsCollector(registry=reg, namespace="t1")
+    collector.collect_batch_metrics({
+        "loss": 1.5, "step": 3, "epoch": 0,
+        "trust_scores": {0: 0.9, 1: 0.8},
+    })
+    assert reg.get("tddl_t1_loss").value() == 1.5
+    assert reg.get("tddl_t1_trust_scores").value(node="0") == 0.9
+    assert reg.get("tddl_t1_trust_scores").value(node="1") == 0.8
+    assert reg.get("tddl_t1_step") is None       # correlation id, not metric
+    collector.tick()
+    collector.tick()
+    assert reg.get("tddl_t1_step_time_seconds").value()["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Run metadata + artifact-stamping contract
+# ---------------------------------------------------------------------------
+
+
+def test_run_metadata_carries_the_required_keys():
+    meta = run_metadata()
+    assert set(RUN_METADATA_KEYS) <= set(meta)
+    assert meta["platform"]        # resolved (cpu under the test harness)
+    assert meta["jax_version"]
+    json.dumps(meta)               # must be JSON-serialisable as-is
+
+
+def test_artifact_writers_are_stamped_with_run_metadata():
+    """CONTRACT: every experiments/ module and bench.py that writes a
+    JSON artifact must reference the shared run_metadata helper.  A new
+    artifact writer that forgets the stamp fails here, not in review."""
+    writers = sorted(
+        (REPO / "trustworthy_dl_tpu" / "experiments").glob("*.py")
+    ) + [REPO / "bench.py"]
+    unstamped = []
+    for module in writers:
+        source = module.read_text()
+        if "json.dump(" in source and "run_metadata" not in source:
+            unstamped.append(str(module.relative_to(REPO)))
+    assert not unstamped, (
+        f"JSON artifact writer(s) without the run-metadata stamp "
+        f"(use trustworthy_dl_tpu.obs.run_metadata): {unstamped}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# ObsSession plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_obs_session_artifacts_and_snapshot_cadence(tmp_path):
+    reg = MetricsRegistry()
+    session = ObsSession(str(tmp_path), registry=reg,
+                         metrics_snapshot_every=5)
+    reg.counter("tddl_s_total").inc()
+    session.trace.emit(EventType.TRAIN_STEP, step=5, loss=1.0,
+                       grad_norm=0.1)
+    session.on_step(4)   # not on cadence
+    session.on_step(5)   # snapshot
+    session.finalize()
+    session.finalize()   # idempotent
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"trace.jsonl", "metrics_snapshot.json", "metrics.prom",
+            "obs_report.json"} <= names
+    events = read_jsonl(str(tmp_path / "trace.jsonl"))
+    types = [e["type"] for e in events]
+    assert types[0] == "run_start" and types[-1] == "run_end"
+    # One cadence snapshot + one final.
+    assert types.count("metrics_snapshot") == 2
+    assert "tddl_s_total 1.0" in (tmp_path / "metrics.prom").read_text()

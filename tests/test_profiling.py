@@ -41,6 +41,49 @@ def test_trace_noop_without_dir():
         pass  # must not create anything or require a profiler session
 
 
+def test_annotations_are_noop_safe_without_profiler_session():
+    """step/phase annotations must enter and exit cleanly with NO active
+    profiler session — the trainer annotates every hot-loop step."""
+    from trustworthy_dl_tpu.utils.profiling import PHASES, \
+        phase_annotation, step_annotation
+
+    with step_annotation(7):
+        pass
+    for name in PHASES:
+        with phase_annotation(name):
+            pass
+    with pytest.raises(ValueError):
+        phase_annotation("not_a_phase")  # typos fail loudly, not silently
+
+
+def test_annotations_survive_a_broken_profiler_backend(monkeypatch):
+    """A backend whose profiler plugin raises (construction OR entry)
+    degrades to a no-op instead of killing the step loop."""
+    import trustworthy_dl_tpu.utils.profiling as prof
+
+    class BoomOnInit:
+        def __init__(self, *a, **k):
+            raise RuntimeError("no profiler session")
+
+    class BoomOnEnter:
+        def __init__(self, *a, **k):
+            pass
+
+        def __enter__(self):
+            raise RuntimeError("plugin missing")
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(prof.jax.profiler, "StepTraceAnnotation",
+                        BoomOnInit)
+    monkeypatch.setattr(prof.jax.profiler, "TraceAnnotation", BoomOnEnter)
+    with prof.step_annotation(1):
+        pass
+    with prof.phase_annotation("data"):
+        pass
+
+
 def test_nan_debug_mode_traps(monkeypatch):
     enable_nan_debugging(True)
     try:

@@ -43,6 +43,13 @@ _EXPORTS = {
     "SimulatedPreemption": "trustworthy_dl_tpu.chaos.injector",
     "TrainingSupervisor": "trustworthy_dl_tpu.engine.supervisor",
     "ExperimentRunner": "trustworthy_dl_tpu.experiments.runner",
+    "ObsSession": "trustworthy_dl_tpu.obs.session",
+    "MetricsRegistry": "trustworthy_dl_tpu.obs.registry",
+    "TraceBus": "trustworthy_dl_tpu.obs.events",
+    "EventType": "trustworthy_dl_tpu.obs.events",
+    "FlightRecorder": "trustworthy_dl_tpu.obs.recorder",
+    "StepTimeReporter": "trustworthy_dl_tpu.obs.report",
+    "run_metadata": "trustworthy_dl_tpu.obs.meta",
     "generate": "trustworthy_dl_tpu.models.generate",
     "ServingEngine": "trustworthy_dl_tpu.serve.engine",
     "ServeRequest": "trustworthy_dl_tpu.serve.engine",

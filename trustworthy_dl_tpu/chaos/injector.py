@@ -50,15 +50,27 @@ class FaultInjector:
         self._sleep = sleep_fn
         self._max_stall_s = max_stall_s
         self.fired: List[FaultEvent] = []
+        # Optional obs TraceBus (obs/events.py): every fired fault is
+        # emitted as a ``chaos_fault`` event, so a flight-recorder dump
+        # can be diffed against ``FaultPlan.predict`` counts.
+        self.trace: Any = None
 
     # -- bookkeeping -------------------------------------------------------
+
+    def _fire(self, event: FaultEvent, at_step: int) -> FaultEvent:
+        self.fired.append(event)
+        if self.trace is not None:
+            self.trace.emit("chaos_fault", step=at_step,
+                            kind=event.kind.value,
+                            scheduled_step=event.step,
+                            severity=event.severity)
+        return event
 
     def _take_at(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
         """Fire-once event scheduled exactly at ``step``."""
         for event in self.plan.at(step, kind):
             if event not in self.fired:
-                self.fired.append(event)
-                return event
+                return self._fire(event, step)
         return None
 
     def _take_due(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
@@ -66,8 +78,7 @@ class FaultInjector:
         checkpoint kinds, which fire on the first save at/after it)."""
         for event in self.plan.of_kind(kind):
             if event.step <= step and event not in self.fired:
-                self.fired.append(event)
-                return event
+                return self._fire(event, step)
         return None
 
     def counts(self) -> Dict[str, int]:

@@ -67,6 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--chaos-rate", type=float, default=0.02,
                         help="per-step probability of each drill fault "
                              "kind under --chaos-seed")
+    # Unified telemetry (trustworthy_dl_tpu/obs/).
+    parser.add_argument("--obs-dir", type=str, default=None,
+                        help="write run telemetry here: trace.jsonl "
+                             "(structured events with step correlation "
+                             "ids), metrics_snapshot.json + metrics.prom "
+                             "(registry export), obs_report.json "
+                             "(per-phase step-time breakdown + MFU), and "
+                             "flight-recorder dumps")
+    parser.add_argument("--metrics-snapshot-every", type=int, default=0,
+                        help="re-write the metrics snapshot every N steps "
+                             "(0 = only at run end); needs --obs-dir")
     return parser
 
 
@@ -97,6 +108,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     trainer = DistributedTrainer(config)
     trainer.initialize()
+    obs_session = None
+    if args.obs_dir:
+        from trustworthy_dl_tpu.obs import ObsSession
+
+        obs_session = ObsSession(
+            args.obs_dir,
+            metrics_snapshot_every=args.metrics_snapshot_every,
+        )
+        trainer.attach_obs(obs_session)
     if args.resume:
         trainer.load_checkpoint()
 
@@ -136,7 +156,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         supervisor = TrainingSupervisor(
             trainer, max_retries=args.max_retries,
             rollback_after=args.rollback_after, max_restarts=max_restarts,
-            chaos=injector, handle_signals=True,
+            chaos=injector, handle_signals=True, obs=obs_session,
         )
         result = supervisor.run(train_dl, val_dl)
         print(f"supervisor report: {result['supervisor']}")
@@ -146,6 +166,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"Training completed: {stats['global_step']} steps, "
           f"final state {stats['training_state']}")
     trainer.save_checkpoint()
+    if obs_session is not None:
+        obs_session.finalize()
+        print(f"obs artifacts in {args.obs_dir}: trace.jsonl, "
+              "metrics_snapshot.json, metrics.prom, obs_report.json")
     trainer.cleanup()
     return 0
 
@@ -306,6 +330,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         help="per-request wall-clock deadline")
     parser.add_argument("--no-monitor", action="store_true",
                         help="disable the trust-aware output monitor")
+    parser.add_argument("--obs-dir", type=str, default=None,
+                        help="write serving telemetry here: trace.jsonl "
+                             "(request lifecycle events correlated by "
+                             "request id) + metrics snapshot/Prometheus "
+                             "export")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -363,11 +392,18 @@ def serve_main(argv: Optional[List[str]] = None,
         print(f"no checkpoint under {args.checkpoint_dir!r}; "
               "serving from random init")
 
+    obs_session = None
+    if args.obs_dir:
+        from trustworthy_dl_tpu.obs import ObsSession
+
+        obs_session = ObsSession(args.obs_dir)
     engine = ServingEngine(
         trainer.state.params, cfg,
         max_slots=args.max_slots, max_seq=args.max_seq,
         queue_limit=args.queue_limit, enable_monitor=not args.no_monitor,
         rng=jax.random.PRNGKey(args.seed),
+        trace=obs_session.trace if obs_session else None,
+        registry=obs_session.registry if obs_session else None,
     )
     rng = np.random.default_rng(args.seed)
     deadline = args.deadline_ms / 1e3 if args.deadline_ms else None
@@ -402,6 +438,9 @@ def serve_main(argv: Optional[List[str]] = None,
             print(f"  {key}: {shown}")
     if summary.get("quarantined_slots"):
         print(f"  quarantined slots: {summary['quarantined_slots']}")
+    if obs_session is not None:
+        obs_session.finalize()
+        print(f"obs artifacts in {args.obs_dir}")
     trainer.cleanup()
     return 0
 

@@ -236,6 +236,10 @@ class CheckpointManager:
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
         self.chaos = chaos
+        # Optional obs TraceBus (obs/events.py): COMMIT outcomes are the
+        # durability decision a post-mortem needs — emitted here because
+        # only the manager knows whether the manifest actually landed.
+        self.trace: Any = None
         # One in-flight async save awaiting its COMMIT (manifest write and,
         # for force-overwrites, the staging swap).  Committed by the next
         # join point: save / restore / wait / latest_step.
@@ -355,6 +359,9 @@ class CheckpointManager:
         if self.chaos is not None and not self.chaos.on_checkpoint_commit(
             step
         ):
+            if self.trace is not None:
+                self.trace.emit("ckpt_commit", step=step, committed=False,
+                                reason="chaos_crash_before_commit")
             return  # drill: died pre-COMMIT — payload left uncommitted
         if target != final:
             # Retire the old checkpoint only now that its replacement is
@@ -367,6 +374,8 @@ class CheckpointManager:
             os.replace(target, final)
         self._write_manifest(step, final)
         _unlink(self._inflight_path(step))
+        if self.trace is not None:
+            self.trace.emit("ckpt_commit", step=step, committed=True)
         if self.chaos is not None:
             self.chaos.on_checkpoint_saved(step, final)
 

@@ -44,6 +44,8 @@ from trustworthy_dl_tpu.chaos.injector import FaultInjector, \
 from trustworthy_dl_tpu.engine.step import StepMetrics
 from trustworthy_dl_tpu.engine.trainer import DistributedTrainer, \
     TrainingState
+from trustworthy_dl_tpu.obs.events import EventType
+from trustworthy_dl_tpu.obs.registry import get_registry
 
 logger = logging.getLogger(__name__)
 
@@ -63,13 +65,23 @@ class TrainingSupervisor:
     0 disables sleeping, which is what drills and tests want.
     ``handle_signals=True`` installs a SIGTERM handler (main thread only)
     so a real preemption notice takes the save-on-signal path.
+
+    ``obs`` optionally threads an :class:`obs.ObsSession` through the
+    whole recovery ladder: every guard trip / retry / rollback / restart
+    is emitted as a trace event, recovery counters land in the metrics
+    registry, and the flight recorder is dumped NEXT TO THE CHECKPOINTS
+    on rollback, guard trip and preemption — the post-mortem artifact a
+    recovery claim is checked against.  Construction also calls
+    ``trainer.attach_obs(obs)`` so trainer- and supervisor-side events
+    share one trace.
     """
 
     def __init__(self, trainer: DistributedTrainer, *,
                  max_retries: int = 2, rollback_after: int = 3,
                  max_restarts: int = 3, backoff_base_s: float = 0.0,
                  chaos: Optional[FaultInjector] = None,
-                 handle_signals: bool = False):
+                 handle_signals: bool = False,
+                 obs: Any = None):
         if max_retries < 0 or rollback_after < 1 or max_restarts < 0:
             raise ValueError(
                 "max_retries >= 0, rollback_after >= 1, max_restarts >= 0"
@@ -81,6 +93,7 @@ class TrainingSupervisor:
         self.backoff_base_s = backoff_base_s
         self.chaos = chaos
         self.handle_signals = handle_signals
+        self.obs = obs
 
         self.retries = 0
         self.rollbacks = 0
@@ -92,10 +105,24 @@ class TrainingSupervisor:
         self._preempt_flag = False
         self._old_handler: Any = None
 
+        # Recovery counters live in the process-wide registry whether or
+        # not a full ObsSession is attached — one export surface for the
+        # numbers report() also returns.
+        registry = obs.registry if obs is not None else get_registry()
+        self._counters = registry.counter(
+            "tddl_supervisor_actions_total",
+            "Supervisor recovery-ladder actions, by action",
+            labels=("action",),
+        )
+
         trainer.step_guard = self
         if chaos is not None:
             trainer.chaos = chaos
             trainer.checkpointer.chaos = chaos
+        if obs is not None:
+            trainer.attach_obs(obs)
+            if chaos is not None:
+                chaos.trace = obs.trace
 
     # -- step guard --------------------------------------------------------
 
@@ -133,8 +160,21 @@ class TrainingSupervisor:
             int(np.asarray(metrics.finite).sum()),
             int(np.asarray(metrics.finite).size), self.max_retries,
         )
+        if self.obs is not None:
+            self.obs.trace.emit(
+                EventType.GUARD_TRIP, step=trainer.global_step,
+                loss=float(np.asarray(metrics.loss)),
+                grad_norm=float(np.asarray(metrics.grad_norm)),
+                finite_nodes=int(np.asarray(metrics.finite).sum()),
+            )
+        self._counters.inc(action="guard_trip")
         for attempt in range(self.max_retries):
             self.retries += 1
+            self._counters.inc(action="retry")
+            if self.obs is not None:
+                self.obs.trace.emit(EventType.SUPERVISOR_RETRY,
+                                    step=trainer.global_step,
+                                    attempt=attempt + 1)
             if self.backoff_base_s > 0:
                 time.sleep(self.backoff_base_s * (2 ** attempt))
             trainer.state, metrics = trainer._train_step(
@@ -147,6 +187,15 @@ class TrainingSupervisor:
                 return metrics
         self.bad_steps += 1
         self._bad_streak += 1
+        self._counters.inc(action="bad_step")
+        if self.obs is not None and self._bad_streak == 1:
+            # One dump per incident (the streak's first definitively-bad
+            # step), not per bad step — bounded post-mortems; the
+            # rollback, if it comes, writes its own.
+            self.obs.dump_flight(
+                "guard_trip", step=trainer.global_step,
+                directory=trainer.config.checkpoint_dir,
+            )
         if self._bad_streak >= self.rollback_after:
             self._rollback(trainer)
         return None
@@ -165,6 +214,7 @@ class TrainingSupervisor:
         # freeing a still-being-written output buffer mid-restore races the
         # async runtime (observed as heap corruption on the CPU client).
         jax.block_until_ready(trainer.state)
+        bad_step = trainer.global_step  # where the run was when it broke
         candidates = trainer.checkpointer.verified_steps()
         if not candidates:
             raise RuntimeError(
@@ -196,6 +246,18 @@ class TrainingSupervisor:
         self.rollbacks += 1
         self.rollback_steps.append(trainer.global_step)
         self._bad_streak = 0
+        self._counters.inc(action="rollback")
+        if self.obs is not None:
+            self.obs.trace.emit(
+                EventType.SUPERVISOR_ROLLBACK, step=bad_step,
+                restored_step=trainer.global_step,
+            )
+            self.obs.dump_flight(
+                "rollback", step=trainer.global_step,
+                directory=trainer.config.checkpoint_dir,
+                extra={"bad_step": bad_step,
+                       "restored_step": trainer.global_step},
+            )
 
     # -- restart loop ------------------------------------------------------
 
@@ -234,6 +296,7 @@ class TrainingSupervisor:
                     avg_loss = trainer.train_epoch(train_dataloader, epoch)
                 except (SimulatedPreemption, PreemptionSignal) as exc:
                     self.preemptions += 1
+                    self._counters.inc(action="preemption")
                     logger.warning(
                         "Supervisor: preemption during epoch %d (%s) — "
                         "saving state", epoch, exc,
@@ -245,8 +308,17 @@ class TrainingSupervisor:
                     trainer.global_step = int(np.asarray(
                         trainer.state.step
                     ))
+                    if self.obs is not None:
+                        self.obs.trace.emit(EventType.PREEMPTION,
+                                            step=trainer.global_step,
+                                            epoch=epoch)
                     trainer.save_checkpoint()
                     trainer.checkpointer.wait()
+                    if self.obs is not None:
+                        self.obs.dump_flight(
+                            "preemption", step=trainer.global_step,
+                            directory=trainer.config.checkpoint_dir,
+                        )
                     if self.restarts >= self.max_restarts:
                         raise RuntimeError(
                             f"restart budget exhausted "
@@ -254,7 +326,12 @@ class TrainingSupervisor:
                             f"{exc}"
                         ) from exc
                     self.restarts += 1
+                    self._counters.inc(action="restart")
                     trainer.load_checkpoint()
+                    if self.obs is not None:
+                        self.obs.trace.emit(EventType.SUPERVISOR_RESTART,
+                                            step=trainer.global_step,
+                                            restart=self.restarts)
                     logger.info(
                         "Supervisor: auto-resume %d/%d from step %d",
                         self.restarts, self.max_restarts,

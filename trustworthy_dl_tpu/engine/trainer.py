@@ -49,6 +49,7 @@ from trustworthy_dl_tpu.engine.step import StepMetrics, \
     build_node_eval_step, \
     build_train_step
 from trustworthy_dl_tpu.models.factory import ModelFactory
+from trustworthy_dl_tpu.obs.events import EventType
 from trustworthy_dl_tpu.trust.manager import TrustManager
 from trustworthy_dl_tpu.trust.state import NodeStatus
 from trustworthy_dl_tpu.utils.metrics import MetricsCollector
@@ -260,6 +261,13 @@ class DistributedTrainer:
         # rejects the step (the trainer must not account it).
         self.chaos: Any = None
         self.step_guard: Any = None
+        # Telemetry (obs/): an ObsSession attached via ``attach_obs``.
+        # Per-run like chaos/step_guard — a reset detaches it so a stale
+        # session never records a fresh run's events against old
+        # correlation ids.  ``_last_status`` backs the trust-transition
+        # event stream (emit on change, not per step).
+        self.obs: Any = None
+        self._last_status: Optional[np.ndarray] = None
         # A supervisor also wires its injector into the checkpointer's
         # commit hooks; detach that too on reset, or a previous run's
         # UNFIRED checkpoint faults would fire in the next clean run.
@@ -267,6 +275,7 @@ class DistributedTrainer:
         # exists.)
         if hasattr(self, "checkpointer"):
             self.checkpointer.chaos = None
+            self.checkpointer.trace = None
 
     def initialize(self, seed: Optional[int] = None) -> TrainState:
         """Init params/optimizer/world-view.  Params are replicated over the
@@ -433,6 +442,41 @@ class DistributedTrainer:
                 for i in range(min(len(mask), len(self.node_map)))
             }
 
+    def attach_obs(self, session: Any) -> None:
+        """Install an :class:`obs.ObsSession`: step/trust/detection/
+        checkpoint events flow to its trace bus (and flight recorder),
+        and the step loop feeds its phase timer.  Also wires the
+        checkpointer and any already-installed chaos injector so commit
+        and fault events share the run's correlation ids, and re-binds
+        the metrics collector onto the session's (per-run) registry."""
+        self.obs = session
+        self.checkpointer.trace = session.trace
+        self.metrics_collector.bind_registry(session.registry)
+        if self.chaos is not None:
+            self.chaos.trace = session.trace
+
+    def _obs_note_model_info(self, node_batch: Dict[str, Any]) -> None:
+        """Lazily give the step timer what MFU needs: param count and
+        work units per step (tokens for LMs, samples for vision)."""
+        timer = self.obs.step_timer
+        if timer.has_model_info:
+            return
+        first = node_batch.get("input")
+        if first is None:
+            first = next(iter(node_batch.values()))
+        if self.model.kind == "lm":
+            # [n, b, T] (node split) or [B, T] (pipeline): size = tokens.
+            units = int(np.prod(first.shape))
+        elif self.config.parallelism == "model":
+            units = int(first.shape[0])
+        else:
+            units = int(first.shape[0] * first.shape[1])
+        timer.set_model_info(
+            self.model.num_params(self.state.params), units,
+            model_kind=self.model.kind,
+            num_chips=len(list(self.mesh.devices.flat)),
+        )
+
     # ------------------------------------------------------------------
     # Batch plumbing
     # ------------------------------------------------------------------
@@ -571,6 +615,9 @@ class DistributedTrainer:
             dataloader = PrefetchLoader(dataloader,
                                         depth=self.config.prefetch_depth)
         self._active_loader = dataloader
+        timer = self.obs.step_timer if self.obs is not None else None
+        if timer is not None:
+            timer.discard_step()  # anchor the first step's "data" lap
 
         for batch_idx, batch in enumerate(dataloader):
             self.global_step += 1
@@ -594,7 +641,12 @@ class DistributedTrainer:
             node_batch = self._node_batch(batch)
             if node_batch is None:  # stale undersized batch mid-transition
                 self.global_step -= 1
+                if timer is not None:
+                    timer.discard_step()
                 continue
+            if timer is not None:
+                self._obs_note_model_info(node_batch)
+                timer.lap("data")  # loader + host assembly + shard place
             with step_annotation(self.global_step):
                 self.state, metrics = self._train_step(
                     self.state, node_batch, self.attack_plan
@@ -609,17 +661,29 @@ class DistributedTrainer:
                 if metrics is None:
                     # Step rejected (non-finite / wedged) — possibly rolled
                     # back to a verified checkpoint (global_step restored by
-                    # load_checkpoint).  Nothing to account.
+                    # load_checkpoint).  Nothing to account.  A rejected
+                    # step's wall time (retries, rollback restore) would
+                    # poison the phase distribution — drop it.
+                    if timer is not None:
+                        timer.discard_step()
                     continue
             self.metrics_collector.tick()
-            loss = float(metrics.loss)
+            loss = float(metrics.loss)  # host sync closes the device step
+            if timer is not None:
+                timer.lap("compute")  # dispatch + fused device step + sync
             self._record_batch(metrics, epoch, loss)
             self._maybe_readmit()
+            if timer is not None:
+                timer.lap("detection")  # host verdicts/incident records
             epoch_loss += loss
             num_batches += 1
 
             if self.global_step % self.config.checkpoint_interval == 0:
                 self.save_checkpoint()
+            if timer is not None:
+                timer.lap("checkpoint")
+                timer.finish_step()
+                self.obs.on_step(self.global_step)
             if batch_idx % 10 == 0:
                 logger.info("Epoch %d, Batch %d, Loss: %.4f",
                             epoch, batch_idx, loss)
@@ -671,6 +735,28 @@ class DistributedTrainer:
         attacked = np.asarray(metrics.attacked)
         trust = np.asarray(metrics.trust_scores)
         id_of = self.node_map  # coordinate -> original node id
+        if self.obs is not None:
+            self.obs.trace.emit(
+                EventType.TRAIN_STEP, step=self.global_step, epoch=epoch,
+                loss=loss,
+                grad_norm=float(np.asarray(metrics.grad_norm)),
+                system_trust=float(np.asarray(metrics.system_trust)),
+            )
+            # Trust-state transitions: emitted on CHANGE (keyed by
+            # original identity), not per step — the trace stays joinable
+            # on step id without carrying n gauges per row.
+            status_now = np.asarray(metrics.status)
+            prev = self._last_status
+            if prev is not None and len(prev) == len(status_now):
+                for coord in np.nonzero(status_now != prev)[0]:
+                    self.obs.trace.emit(
+                        EventType.TRUST_TRANSITION, step=self.global_step,
+                        node=int(id_of[int(coord)]),
+                        from_status=NodeStatus(int(prev[coord])).name,
+                        to_status=NodeStatus(int(status_now[coord])).name,
+                        trust=float(trust[int(coord)]),
+                    )
+            self._last_status = status_now.copy()
         self.metrics_collector.collect_batch_metrics(
             {
                 "loss": loss,
@@ -735,6 +821,11 @@ class DistributedTrainer:
                     "cannot attribute", self.global_step,
                 )
                 self.training_state = TrainingState.UNDER_ATTACK
+                if self.obs is not None:
+                    self.obs.trace.emit(
+                        EventType.FLEET_ALERT, step=self.global_step,
+                        median_grad_norm=opened.get("median_grad_norm"),
+                    )
 
         # Host incidents fire only on confirmed evidence: debounced verdicts
         # (metrics.attacked already folds in sustained norm-verification
@@ -783,8 +874,10 @@ class DistributedTrainer:
                 evict_and_reshard,
             )
 
+            evict_record = None
             if elastic_supported(self.config):
-                record = evict_and_reshard(self, evict_coords)
+                record = evict_record = evict_and_reshard(self,
+                                                          evict_coords)
                 record["step"] = self.global_step
                 self.reassignment_history.append(record)
                 for orig in record["evicted_nodes"]:
@@ -800,7 +893,8 @@ class DistributedTrainer:
                     restaff_pipeline,
                 )
 
-                record = restaff_pipeline(self, evict_coords)
+                record = evict_record = restaff_pipeline(self,
+                                                         evict_coords)
                 record["step"] = self.global_step
                 self.reassignment_history.append(record)
                 for orig in record["evicted_nodes"]:
@@ -808,6 +902,12 @@ class DistributedTrainer:
                     # identity re-enters the restaff candidate pool
                     # (_maybe_readmit).
                     self._evicted_at[int(orig)] = self.global_step
+            if evict_record is not None and self.obs is not None:
+                self.obs.trace.emit(
+                    EventType.ELASTIC_EVICT, step=self.global_step,
+                    nodes=[int(n) for n in evict_record["evicted_nodes"]],
+                    live_nodes=self.config.num_nodes,
+                )
 
     def _maybe_readmit(self) -> None:
         """Re-admit evicted coordinates whose cool-off has elapsed
@@ -840,6 +940,12 @@ class DistributedTrainer:
             self._resize_loader()
         elif cfg.parallelism == "model":
             self._readmit_stages(due)
+        if self.obs is not None:
+            self.obs.trace.emit(
+                EventType.ELASTIC_READMIT, step=self.global_step,
+                nodes=[int(n) for n in due],
+                live_nodes=self.config.num_nodes,
+            )
 
     def _readmit_stages(self, due: Sequence[int]) -> None:
         """Model-mode return path: cooled-off evicted stage identities
@@ -926,6 +1032,14 @@ class DistributedTrainer:
         ds["total_detections"] += 1
         ds["attack_types"][attack_type] += 1
         ds["true_positives" if is_tp else "false_positives"] += 1
+        if self.obs is not None:
+            self.obs.trace.emit(
+                EventType.DETECTION_VERDICT, step=self.global_step,
+                node=int(node_id), attack_type=attack_type,
+                ground_truth_positive=is_tp,
+                out_score=float(np.asarray(metrics.out_score)[coord]),
+                grad_score=float(np.asarray(metrics.grad_score)[coord]),
+            )
         self.attack_history.append(
             {
                 "node_id": node_id,
@@ -1142,6 +1256,10 @@ class DistributedTrainer:
             self.state, self.global_step,
             block=not self.config.async_checkpoint,
         )
+        if self.obs is not None:
+            self.obs.trace.emit(EventType.CKPT_SAVE, step=self.global_step,
+                                path=path,
+                                blocking=not self.config.async_checkpoint)
         if already:
             logger.warning(
                 "Checkpoint step %d already existed; keeping its sidecar "
@@ -1338,6 +1456,12 @@ class DistributedTrainer:
                 for k, ids in meta.get("idle_pool", {}).items()
             }
         self.global_step = int(self.state.step)
+        # A restore redraws the fleet's status rows; transition tracking
+        # must re-anchor or the first post-resume step emits bogus diffs.
+        self._last_status = None
+        if self.obs is not None:
+            self.obs.trace.emit(EventType.CKPT_RESTORE, step=step,
+                                restored_step=self.global_step)
         self.sync_host_state()
         return self.state
 

@@ -115,11 +115,16 @@ def run_pipeline_study(
             logger.info("study: S=%d M=%d", s, m)
             cells.append(_measure_cell(s, m, batch,
                                        steps, model_overrides or {}))
+    from trustworthy_dl_tpu.obs.meta import run_metadata
+
     results = {
         "config": {"batch": batch, "steps": steps,
                    "stage_counts": list(stage_counts),
                    "microbatches": list(microbatches),
                    "model": dict(TINY)},
+        # Platform/jax-version stamp (VERDICT weak #5): schedule timings
+        # are meaningless without the hardware that produced them.
+        "run_metadata": run_metadata(),
         "cells": cells,
         "wall_time_s": time.time() - t0,
     }

@@ -71,6 +71,7 @@ class ExperimentRunner:
         self.val_loader = None
         self.epoch_records: List[Dict[str, Any]] = []
         self._step_records_cache: Optional[List[Dict[str, Any]]] = None
+        self.obs: Optional[Any] = None
         logger.info("ExperimentRunner initialized: %s", config.experiment_name)
 
     # ------------------------------------------------------------------
@@ -81,6 +82,13 @@ class ExperimentRunner:
         self.trainer = DistributedTrainer(
             self.training_config, model_overrides=self.model_overrides
         )
+        # Unified telemetry: every experiment run carries a trace, a
+        # metrics snapshot and the step-time/MFU report under
+        # <output_dir>/obs, and experiment_results.json embeds the report.
+        from trustworthy_dl_tpu.obs import ObsSession
+
+        self.obs = ObsSession(str(self.output_dir / "obs"))
+        self.trainer.attach_obs(self.obs)
         if self.config.attack_enabled:
             attack_config = AttackConfig(
                 attack_types=list(self.config.attack_types),
@@ -318,9 +326,12 @@ class ExperimentRunner:
             }),
             "detection_quality": self._detection_quality(),
         }
+        from trustworthy_dl_tpu.obs.meta import run_metadata
+
         return {
             "experiment_config": dataclasses.asdict(self.config),
             "training_config": dataclasses.asdict(self.training_config),
+            "run_metadata": run_metadata(),
             "epoch_records": self.epoch_records,
             "attack_history": self.trainer.attack_history,
             "reassignment_history": self.trainer.reassignment_history,
@@ -328,6 +339,10 @@ class ExperimentRunner:
             "final_attack_statistics": attack_stats,
             "training_stats": self.trainer.get_training_stats(),
             "experiment_summary": summary,
+            # Step-time breakdown + MFU for THIS run (obs/report.py);
+            # the standalone copy lands at <output_dir>/obs/.
+            "obs_report": (self.obs.step_timer.report()
+                           if self.obs is not None else None),
         }
 
     def _step_records(self) -> List[Dict[str, Any]]:
@@ -656,6 +671,8 @@ class ExperimentRunner:
         logger.info("Experiment report generated")
 
     def _cleanup(self) -> None:
+        if self.obs is not None:
+            self.obs.finalize()  # snapshot + obs_report.json + close trace
         if self.trainer is not None:
             self.trainer.cleanup()
         if self.attacker is not None:
@@ -728,7 +745,10 @@ def run_threshold_sweep(base: ExperimentConfig,
                         **runner_kwargs: Any) -> Dict[str, Any]:
     """BASELINE config 5: repeat an experiment across trust thresholds and
     aggregate detection quality per threshold."""
-    sweep: Dict[str, Any] = {"thresholds": {}, "base": base.experiment_name}
+    from trustworthy_dl_tpu.obs.meta import run_metadata
+
+    sweep: Dict[str, Any] = {"thresholds": {}, "base": base.experiment_name,
+                             "run_metadata": run_metadata()}
     for threshold in thresholds:
         config = dataclasses.replace(
             base,

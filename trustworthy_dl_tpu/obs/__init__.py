@@ -1,0 +1,56 @@
+"""obs — unified telemetry: metrics registry, structured trace bus,
+flight recorder, and the step-time/MFU reporter (SURVEY §5.1 tracing,
+§6 measurement contract).
+
+The framework trains, serves and self-heals; this package makes it
+*explain itself*: every claim the repo publishes — detection overhead,
+recovery counts, step-time, MFU — is backed by an emitted,
+machine-readable record rather than a builder-transcribed number.
+
+Four pieces, composable separately and bundled by :class:`ObsSession`:
+
+* :mod:`obs.registry` — process-wide counters/gauges/histograms with
+  labels, JSON snapshot + Prometheus text export.  Absorbs the ad-hoc
+  metrics previously scattered over ``utils/metrics.py``,
+  ``serve/engine.py`` (TTFT/ITL/occupancy), ``engine/supervisor.py``
+  (retries/rollbacks/restarts) and ``chaos/injector.py`` (faults).
+* :mod:`obs.events` — typed JSONL trace events with monotonic
+  timestamps and step/request correlation ids, validated against a
+  per-type schema.
+* :mod:`obs.recorder` — a bounded ring buffer of recent events the
+  supervisor dumps next to the checkpoint directory on rollback, guard
+  trip or preemption, so every recovery has a post-mortem artifact.
+* :mod:`obs.report` — named-phase step-time breakdown + model-FLOPs
+  utilization (MFU), written as ``obs_report.json``; also the shared
+  ``run_metadata()`` stamp every experiment artifact carries.
+
+Metric naming convention: ``tddl_<subsystem>_<what>[_unit]`` —
+e.g. ``tddl_train_loss``, ``tddl_serve_ttft_seconds``,
+``tddl_supervisor_rollbacks_total``.
+"""
+
+from trustworthy_dl_tpu.obs.events import EVENT_SCHEMAS, EventType, TraceBus
+from trustworthy_dl_tpu.obs.meta import run_metadata
+from trustworthy_dl_tpu.obs.recorder import FlightRecorder
+from trustworthy_dl_tpu.obs.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from trustworthy_dl_tpu.obs.report import PHASES, StepTimeReporter, \
+    mfu_from_throughput, peak_flops_per_chip
+from trustworthy_dl_tpu.obs.session import ObsSession
+
+__all__ = [
+    "EVENT_SCHEMAS",
+    "EventType",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "ObsSession",
+    "PHASES",
+    "StepTimeReporter",
+    "TraceBus",
+    "get_registry",
+    "mfu_from_throughput",
+    "peak_flops_per_chip",
+    "run_metadata",
+]

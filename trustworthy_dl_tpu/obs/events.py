@@ -1,0 +1,210 @@
+"""Structured trace bus: typed JSONL events with monotonic timestamps
+and step/request correlation ids.
+
+Every event is one flat JSON object::
+
+    {"seq": 17, "t": 1722700000.123, "t_mono": 8.201,
+     "type": "train_step", "step": 12, "loss": 2.31, ...}
+
+``seq`` is a per-bus monotone counter (total order even when wall clocks
+collide), ``t`` wall-clock epoch seconds (cross-process alignment),
+``t_mono`` ``time.monotonic()`` (intra-process durations immune to NTP
+steps).  Training-side events correlate on ``step`` (the trainer's
+global step), serving-side events on ``request_id`` — a reader joins
+``train_step`` ↔ ``detection_verdict`` ↔ ``ckpt_save`` rows on the step
+id, and ``serve_submit`` ↔ ``serve_retire`` on the request id.
+
+Event types and their required fields are declared in
+:data:`EVENT_SCHEMAS`; ``TraceBus.emit`` validates against it so a
+malformed emission fails at the producer (loudly, in tests) instead of
+corrupting the post-mortem record a recovery depends on.  Extra fields
+are always allowed — schemas are a floor, not a ceiling.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import threading
+import time
+from typing import Any, Dict, IO, Optional
+
+
+class EventType(str, enum.Enum):
+    """Everything the framework can say about itself.  README
+    §Observability carries the same catalog as a table."""
+
+    # Run lifecycle
+    RUN_START = "run_start"
+    RUN_END = "run_end"
+    METRICS_SNAPSHOT = "metrics_snapshot"
+    # Training
+    TRAIN_STEP = "train_step"
+    TRUST_TRANSITION = "trust_transition"
+    DETECTION_VERDICT = "detection_verdict"
+    FLEET_ALERT = "fleet_alert"
+    ELASTIC_EVICT = "elastic_evict"
+    ELASTIC_READMIT = "elastic_readmit"
+    # Checkpointing
+    CKPT_SAVE = "ckpt_save"
+    CKPT_COMMIT = "ckpt_commit"
+    CKPT_RESTORE = "ckpt_restore"
+    # Supervisor recovery ladder
+    GUARD_TRIP = "guard_trip"
+    SUPERVISOR_RETRY = "supervisor_retry"
+    SUPERVISOR_ROLLBACK = "supervisor_rollback"
+    SUPERVISOR_RESTART = "supervisor_restart"
+    PREEMPTION = "preemption"
+    FLIGHT_DUMP = "flight_dump"
+    # Chaos
+    CHAOS_FAULT = "chaos_fault"
+    # Serving request lifecycle
+    SERVE_SUBMIT = "serve_submit"
+    SERVE_ADMIT = "serve_admit"
+    SERVE_RETIRE = "serve_retire"
+    SERVE_QUARANTINE = "serve_quarantine"
+
+
+#: type -> {"requires": base correlation keys, "fields": required extras}.
+EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
+    EventType.RUN_START: {"requires": (), "fields": ()},
+    EventType.RUN_END: {"requires": (), "fields": ()},
+    EventType.METRICS_SNAPSHOT: {"requires": (), "fields": ("path",)},
+    EventType.TRAIN_STEP: {"requires": ("step",),
+                           "fields": ("loss", "grad_norm")},
+    EventType.TRUST_TRANSITION: {
+        "requires": ("step",),
+        "fields": ("node", "from_status", "to_status"),
+    },
+    EventType.DETECTION_VERDICT: {
+        "requires": ("step",), "fields": ("node", "attack_type"),
+    },
+    EventType.FLEET_ALERT: {"requires": ("step",), "fields": ()},
+    EventType.ELASTIC_EVICT: {"requires": ("step",), "fields": ("nodes",)},
+    EventType.ELASTIC_READMIT: {"requires": ("step",),
+                                "fields": ("nodes",)},
+    EventType.CKPT_SAVE: {"requires": ("step",), "fields": ("path",)},
+    EventType.CKPT_COMMIT: {"requires": ("step",),
+                            "fields": ("committed",)},
+    EventType.CKPT_RESTORE: {"requires": ("step",), "fields": ()},
+    EventType.GUARD_TRIP: {
+        "requires": ("step",),
+        "fields": ("loss", "grad_norm", "finite_nodes"),
+    },
+    EventType.SUPERVISOR_RETRY: {"requires": ("step",),
+                                 "fields": ("attempt",)},
+    EventType.SUPERVISOR_ROLLBACK: {
+        "requires": ("step",), "fields": ("restored_step",),
+    },
+    EventType.SUPERVISOR_RESTART: {"requires": ("step",),
+                                   "fields": ("restart",)},
+    EventType.PREEMPTION: {"requires": ("step",), "fields": ()},
+    EventType.FLIGHT_DUMP: {"requires": (), "fields": ("path", "reason")},
+    EventType.CHAOS_FAULT: {"requires": ("step",), "fields": ("kind",)},
+    EventType.SERVE_SUBMIT: {"requires": ("request_id",),
+                             "fields": ("prompt_len", "max_new_tokens")},
+    EventType.SERVE_ADMIT: {"requires": ("request_id",),
+                            "fields": ("slot",)},
+    EventType.SERVE_RETIRE: {"requires": ("request_id",),
+                             "fields": ("status", "tokens")},
+    EventType.SERVE_QUARANTINE: {"requires": ("request_id",),
+                                 "fields": ("slot",)},
+}
+
+
+def validate_event(event: Dict[str, Any]) -> None:
+    """Raise ValueError when ``event`` violates its type's schema."""
+    try:
+        etype = EventType(event.get("type"))
+    except ValueError:
+        raise ValueError(f"unknown event type {event.get('type')!r}")
+    schema = EVENT_SCHEMAS[etype]
+    for key in schema["requires"]:
+        if event.get(key) is None:
+            raise ValueError(
+                f"{etype.value} event requires correlation id {key!r}"
+            )
+    missing = [f for f in schema["fields"] if f not in event]
+    if missing:
+        raise ValueError(
+            f"{etype.value} event missing required field(s) {missing}"
+        )
+
+
+class TraceBus:
+    """Emits validated events to (any of) a JSONL file, a flight
+    recorder, and the metrics registry's event counter.
+
+    With no sinks configured the bus still validates and counts —
+    instrumented code never branches on whether tracing is on; it only
+    guards on ``bus is not None`` for the cost of building the dict.
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 recorder: Any = None, registry: Any = None,
+                 validate: bool = True):
+        self.jsonl_path = str(jsonl_path) if jsonl_path else None
+        self.recorder = recorder
+        self.validate = validate
+        self._file: Optional[IO[str]] = None
+        self._closed = False
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._counter = None
+        if registry is not None:
+            self._counter = registry.counter(
+                "tddl_obs_events_total", "Trace events emitted, by type",
+                labels=("type",),
+            )
+
+    def emit(self, type: Any, *, step: Optional[int] = None,
+             request_id: Optional[int] = None, **data: Any
+             ) -> Dict[str, Any]:
+        etype = type.value if isinstance(type, EventType) else str(type)
+        event: Dict[str, Any] = {
+            "seq": 0,  # patched under the lock below
+            "t": time.time(),
+            "t_mono": time.monotonic(),
+            "type": etype,
+        }
+        if step is not None:
+            event["step"] = int(step)
+        if request_id is not None:
+            event["request_id"] = int(request_id)
+        event.update(data)
+        if self.validate:
+            validate_event(event)
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            # After close() the file never reopens: a straggler event
+            # (e.g. an async checkpoint COMMIT joining during cleanup)
+            # still reaches the recorder/counter but must not land in
+            # the file after its final run_end line — nor leak a handle.
+            if self.jsonl_path is not None and not self._closed:
+                if self._file is None:
+                    self._file = open(self.jsonl_path, "a", buffering=1)
+                self._file.write(json.dumps(event) + "\n")
+        if self.recorder is not None:
+            self.recorder.record(event)
+        if self._counter is not None:
+            self._counter.inc(type=etype)
+        return event
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def read_jsonl(path: str) -> list:
+    """Load a trace file back into event dicts (reader-side helper)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events

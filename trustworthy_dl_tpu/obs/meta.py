@@ -1,0 +1,76 @@
+"""Run-metadata stamp shared by every artifact writer.
+
+VERDICT weak #5: experiment artifacts shipped without platform /
+jax-version metadata, so a published number could not be tied to the
+hardware that produced it (MLPerf-style run stamping — PAPERS.md).
+``run_metadata()`` is the one shared helper; the fast-tier contract test
+(tests/test_obs.py) fails any ``experiments/`` or ``bench.py`` artifact
+writer that does not reference it.
+
+Device discovery is cached per process (``jax.devices()`` initialises
+the backend — call this only where the backend is already expected to be
+live, e.g. bench's watchdogged inner body, never its probe-first parent)
+and degrades to ``platform: "unavailable"`` instead of raising: a
+metadata stamp must never be the reason an artifact is lost.
+"""
+
+from __future__ import annotations
+
+import functools
+import platform as _platform
+import sys
+import time
+from typing import Any, Dict
+
+RUN_METADATA_SCHEMA = "tddl-obs-v1"
+
+#: Keys every stamped artifact must carry (the contract test checks the
+#: helper is used; unit tests check the helper emits these).
+RUN_METADATA_KEYS = (
+    "schema", "platform", "device_kind", "num_devices", "jax_version",
+    "python_version", "framework_version", "hostname", "timestamp",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _device_info() -> Dict[str, Any]:
+    """Backend identity, resolved once per process."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "num_devices": len(devices),
+            "jax_version": jax.__version__,
+        }
+    except Exception as exc:  # dead backend must not kill the artifact
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:
+            jax_version = "unknown"
+        return {
+            "platform": "unavailable",
+            "device_kind": "unknown",
+            "num_devices": 0,
+            "jax_version": jax_version,
+            "backend_error": f"{type(exc).__name__}: {str(exc)[:120]}",
+        }
+
+
+def run_metadata() -> Dict[str, Any]:
+    """The metadata block every published JSON artifact embeds."""
+    from trustworthy_dl_tpu import __version__
+
+    meta = {
+        "schema": RUN_METADATA_SCHEMA,
+        "python_version": sys.version.split()[0],
+        "framework_version": __version__,
+        "hostname": _platform.node(),
+        "timestamp": time.time(),
+    }
+    meta.update(_device_info())
+    return meta

@@ -1,0 +1,128 @@
+"""ObsSession — the one handle a run threads through its layers.
+
+Bundles the four telemetry pieces with a shared output directory::
+
+    session = ObsSession("runs/exp7/obs", metrics_snapshot_every=50)
+    trainer.attach_obs(session)          # events + phase timing
+    TrainingSupervisor(trainer, obs=session, ...)  # recovery events + dumps
+    ...
+    session.finalize()                   # snapshot + obs_report.json
+
+Artifacts under ``obs_dir``:
+
+* ``trace.jsonl`` — the structured event stream (obs/events.py)
+* ``metrics_snapshot.json`` — latest registry snapshot (rewritten at the
+  ``metrics_snapshot_every`` step cadence and at finalize)
+* ``metrics.prom`` — Prometheus text exposition of the same registry
+* ``obs_report.json`` — step-time breakdown + MFU (obs/report.py)
+* ``flight_*.json`` — flight-recorder dumps (obs/recorder.py); the
+  supervisor writes its incident dumps next to the *checkpoints*
+  instead, via ``dump_flight(directory=...)``
+
+``obs_dir=None`` is a valid in-memory mode: events still flow to the
+flight recorder and metrics to the registry; only the files are skipped.
+
+Each session owns a FRESH registry by default (pass ``registry=`` to
+share one): the snapshot a run publishes must describe *that run*, and
+the process-wide default registry accumulates across every run in the
+process (repeated experiment cells, threshold sweeps) — summed counters
+and cross-run percentiles presented as one run's metrics would be
+silently wrong.  ``trainer.attach_obs`` re-binds the trainer's
+collector onto the session registry for the same reason.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from trustworthy_dl_tpu.obs.events import EventType, TraceBus
+from trustworthy_dl_tpu.obs.recorder import FlightRecorder
+from trustworthy_dl_tpu.obs.registry import MetricsRegistry
+from trustworthy_dl_tpu.obs.report import StepTimeReporter
+
+logger = logging.getLogger(__name__)
+
+
+class ObsSession:
+    def __init__(self, obs_dir: Optional[str] = None, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder_capacity: int = 2048,
+                 metrics_snapshot_every: int = 0,
+                 validate_events: bool = True):
+        self.obs_dir = str(obs_dir) if obs_dir else None
+        if self.obs_dir:
+            os.makedirs(self.obs_dir, exist_ok=True)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.recorder = FlightRecorder(recorder_capacity)
+        self.trace = TraceBus(
+            os.path.join(self.obs_dir, "trace.jsonl")
+            if self.obs_dir else None,
+            recorder=self.recorder, registry=self.registry,
+            validate=validate_events,
+        )
+        self.step_timer = StepTimeReporter(registry=self.registry)
+        self.metrics_snapshot_every = int(metrics_snapshot_every)
+        self._finalized = False
+        self.trace.emit(EventType.RUN_START, obs_dir=self.obs_dir)
+
+    # -- cadence hooks -----------------------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Called by the trainer once per accounted step."""
+        if (self.metrics_snapshot_every > 0
+                and step % self.metrics_snapshot_every == 0):
+            self.snapshot_metrics(step=step)
+
+    # -- artifacts ---------------------------------------------------------
+
+    def snapshot_metrics(self, step: Optional[int] = None
+                         ) -> Optional[str]:
+        if not self.obs_dir:
+            return None
+        path = os.path.join(self.obs_dir, "metrics_snapshot.json")
+        self.registry.snapshot_to_json(
+            path, extra={"step": step} if step is not None else None
+        )
+        with open(os.path.join(self.obs_dir, "metrics.prom"), "w") as f:
+            f.write(self.registry.prometheus_text())
+        self.trace.emit(EventType.METRICS_SNAPSHOT, step=step, path=path)
+        return path
+
+    def dump_flight(self, reason: str, step: Optional[int] = None,
+                    directory: Optional[str] = None,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Optional[str]:
+        """Dump the ring buffer; ``directory`` defaults to ``obs_dir``
+        (the supervisor passes the checkpoint dir so the post-mortem
+        lands next to the state it explains)."""
+        directory = directory or self.obs_dir
+        if not directory:
+            return None
+        path = self.recorder.dump(directory, reason, step=step, extra=extra)
+        # Emitted AFTER the dump so the dump never contains its own
+        # announcement but the trace records where it went.
+        self.trace.emit(EventType.FLIGHT_DUMP, step=step, path=path,
+                        reason=reason)
+        return path
+
+    def write_report(self) -> Optional[Dict[str, Any]]:
+        if not self.obs_dir:
+            return self.step_timer.report()
+        path = os.path.join(self.obs_dir, "obs_report.json")
+        report = self.step_timer.write(path)
+        logger.info("obs: report written to %s (%d steps)", path,
+                    report.get("num_steps", 0))
+        return report
+
+    def finalize(self) -> None:
+        """Final snapshot + report + close the trace file.  Idempotent."""
+        if self._finalized:
+            return
+        self._finalized = True
+        self.snapshot_metrics()
+        self.write_report()
+        self.trace.emit(EventType.RUN_END)  # last event in the trace
+        self.trace.close()

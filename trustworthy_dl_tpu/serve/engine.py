@@ -40,6 +40,8 @@ import numpy as np
 
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.obs.events import EventType
+from trustworthy_dl_tpu.obs.registry import get_registry
 from trustworthy_dl_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     SlotTask,
@@ -139,7 +141,8 @@ class ServingEngine:
                  monitor: Optional[OutputMonitor] = None,
                  enable_monitor: bool = True,
                  metrics: Optional[MetricsCollector] = None,
-                 chaos: Any = None):
+                 chaos: Any = None, trace: Any = None,
+                 registry: Any = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -153,7 +156,29 @@ class ServingEngine:
         self.monitor = monitor if monitor is not None else (
             OutputMonitor() if enable_monitor else None
         )
-        self.metrics = metrics or MetricsCollector()
+        # ``trace``: optional obs TraceBus — the request lifecycle
+        # (submit → admit → retire/quarantine) correlates on request_id.
+        # Registry metrics are always on (per-iteration gauges ride the
+        # collector's absorption; counters/latency histograms are the
+        # serving SLO surface).
+        self.trace = trace
+        if registry is None:
+            registry = get_registry()
+        self.metrics = metrics or MetricsCollector(namespace="serve",
+                                                   registry=registry)
+        self._req_counter = registry.counter(
+            "tddl_serve_requests_total",
+            "Requests retired/shed, by terminal status", labels=("status",),
+        )
+        self._tok_counter = registry.counter(
+            "tddl_serve_tokens_total", "Tokens emitted"
+        )
+        self._ttft_hist = registry.histogram(
+            "tddl_serve_ttft_seconds", "Submit -> first token"
+        )
+        self._itl_hist = registry.histogram(
+            "tddl_serve_itl_seconds", "Inter-token latency"
+        )
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue: Deque[tuple] = deque()   # (task, request)
         self._inflight: Dict[int, tuple] = {}  # request_id -> (task, req, t)
@@ -194,6 +219,7 @@ class ServingEngine:
             )
         if len(self._queue) >= self.queue_limit:
             self.rejected += 1
+            self._req_counter.inc(status="rejected")
             return None
         request_id = self._next_id
         self._next_id += 1
@@ -210,6 +236,10 @@ class ServingEngine:
         )
         self._queue.append((task, request))
         self._submit_t[request_id] = time.perf_counter()
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_SUBMIT, request_id=request_id,
+                            prompt_len=int(prompt.size),
+                            max_new_tokens=int(request.max_new_tokens))
         return request_id
 
     # -- iteration loop ----------------------------------------------------
@@ -236,6 +266,9 @@ class ServingEngine:
             self._inflight[rid] = (task, request)
             t_tok = time.perf_counter()
             self._timing[rid] = [t_tok]
+            if self.trace is not None:
+                self.trace.emit(EventType.SERVE_ADMIT, request_id=rid,
+                                slot=int(task.slot))
             self._stream(request, rid, task.emitted[-1])
             emitted += 1
             if task.done:
@@ -257,6 +290,8 @@ class ServingEngine:
             elif expired:
                 self._finish(task, request, "deadline_exceeded")
         self._tokens_emitted += emitted
+        if emitted:
+            self._tok_counter.inc(emitted)
 
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
@@ -285,6 +320,12 @@ class ServingEngine:
                         request_id=task.request_id, tokens=[],
                         status="no_capacity", ttft_s=None, itl_s=[],
                     )
+                    self._req_counter.inc(status="no_capacity")
+                    if self.trace is not None:
+                        self.trace.emit(EventType.SERVE_RETIRE,
+                                        request_id=task.request_id,
+                                        status="no_capacity", tokens=0,
+                                        admitted=False)
                 break
             self.step()
             it += 1
@@ -315,6 +356,11 @@ class ServingEngine:
                     request_id=rid, tokens=[],
                     status="deadline_exceeded", ttft_s=None, itl_s=[],
                 )
+                self._req_counter.inc(status="deadline_exceeded")
+                if self.trace is not None:
+                    self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
+                                    status="deadline_exceeded", tokens=0,
+                                    admitted=False)
             else:
                 keep.append((task, request))
         self._queue = keep
@@ -339,6 +385,18 @@ class ServingEngine:
             request_id=rid, tokens=list(task.emitted), status=status,
             ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
         )
+        self._req_counter.inc(status=status)
+        if ttft is not None:
+            self._ttft_hist.observe(ttft)
+        for dt in itl:
+            self._itl_hist.observe(dt)
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
+                            status=status, tokens=len(task.emitted),
+                            flagged=flagged, monitor_z=z)
+            if flagged:
+                self.trace.emit(EventType.SERVE_QUARANTINE, request_id=rid,
+                                slot=int(task.slot))
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
             "request_id": rid,

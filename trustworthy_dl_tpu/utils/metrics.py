@@ -7,11 +7,19 @@ Optional TensorBoard export: the reference pinned ``tensorboard``/``wandb``
 in requirements.txt:44-45 but never imported either; here a
 ``tensorboard_dir`` writes real event files (scalars per batch/epoch) via
 torch's SummaryWriter when available, and degrades to a no-op otherwise.
+
+Since the obs PR the collector also feeds the process-wide metrics
+registry (obs/registry.py): numeric batch metrics become
+``tddl_<namespace>_<key>`` gauges (per-node dicts gain a ``node``
+label), ``tick()`` observes ``tddl_<namespace>_step_time_seconds`` —
+so one snapshot/Prometheus surface covers training and serving without
+changing any collector call site.
 """
 
 from __future__ import annotations
 
 import logging
+import re
 import time
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
@@ -19,6 +27,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+# Correlation ids / bookkeeping keys that would be nonsense as gauges
+# (and ``request_id`` would otherwise look like a metric).
+_NON_METRIC_KEYS = frozenset({"timestamp", "step", "epoch", "request_id"})
+_KEY_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def _make_tb_writer(logdir: str):
@@ -36,7 +49,8 @@ class MetricsCollector:
     """Accumulates per-batch metric dicts and summarises them."""
 
     def __init__(self, max_records: int = 100_000,
-                 tensorboard_dir: Optional[str] = None):
+                 tensorboard_dir: Optional[str] = None,
+                 registry: Any = None, namespace: str = "train"):
         self.max_records = max_records
         self.batch_metrics: List[Dict[str, Any]] = []
         self.epoch_metrics: List[Dict[str, Any]] = []
@@ -44,6 +58,47 @@ class MetricsCollector:
         self._last_tick: Optional[float] = None
         self._tb = _make_tb_writer(tensorboard_dir) if tensorboard_dir \
             else None
+        # Registry absorption: default to the process-wide registry so
+        # every collector (trainer, serving engine) lands on one export
+        # surface; pass an explicit registry for isolation in tests.
+        if registry is None:
+            from trustworthy_dl_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        self._ns = _KEY_SANITIZE.sub("_", namespace)
+        self.bind_registry(registry)
+
+    def bind_registry(self, registry: Any) -> None:
+        """Re-point the export surface at ``registry`` (the trainer's
+        ``attach_obs`` calls this so an ObsSession's per-run snapshots
+        are not contaminated by the process-wide default registry)."""
+        self._registry = registry
+        self._gauges: Dict[str, Any] = {}
+        self._tick_hist = registry.histogram(
+            f"tddl_{self._ns}_step_time_seconds",
+            "step/iteration wall time",
+        )
+
+    def _registry_gauge(self, key: str, value: Any,
+                        node: Optional[Any] = None) -> None:
+        name = f"tddl_{self._ns}_{_KEY_SANITIZE.sub('_', key)}"
+        cache_key = (name, node is not None)
+        gauge = self._gauges.get(cache_key)
+        try:
+            if gauge is None:
+                gauge = self._registry.gauge(
+                    name, labels=("node",) if node is not None else ()
+                )
+                self._gauges[cache_key] = gauge
+            if node is not None:
+                gauge.set(float(value), node=node)
+            else:
+                gauge.set(float(value))
+        except ValueError:
+            # Name/kind collision or cardinality bound: the record list
+            # is the source of truth — never let export kill training.
+            logger.debug("metrics: registry rejected %s", name,
+                         exc_info=True)
 
     def _tb_scalars(self, prefix: str, record: Dict[str, Any],
                     step: int) -> None:
@@ -66,6 +121,15 @@ class MetricsCollector:
         self.batch_metrics.append(record)
         self._tb_scalars("batch", record,
                          int(record.get("step", len(self.batch_metrics))))
+        for key, value in record.items():
+            if key in _NON_METRIC_KEYS:
+                continue
+            if isinstance(value, (int, float)):
+                self._registry_gauge(key, value)
+            elif isinstance(value, dict):  # per-node maps -> node label
+                for sub, v in value.items():
+                    if isinstance(v, (int, float)):
+                        self._registry_gauge(key, v, node=sub)
 
     def collect_epoch_metrics(self, metrics: Dict[str, Any]) -> None:
         record = dict(metrics)
@@ -88,7 +152,9 @@ class MetricsCollector:
         """Step-time histogram support (SURVEY §5.1)."""
         now = time.perf_counter()
         if self._last_tick is not None:
-            self._step_times.append(now - self._last_tick)
+            dt = now - self._last_tick
+            self._step_times.append(dt)
+            self._tick_hist.observe(dt)
         self._last_tick = now
 
     def step_time_stats(self) -> Dict[str, float]:

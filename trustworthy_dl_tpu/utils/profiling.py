@@ -5,18 +5,27 @@ The reference has neither: only wall-clock epoch timers
 requirements but never imported (requirements.txt:44-45).  Race detection
 (§5.2) does not apply to the SPMD design — there is no shared mutable state
 inside the compiled step — so the debug story here is numerical: XLA-level
-NaN trapping plus the step-time histogram in utils/metrics.py.
+NaN trapping plus the step-time histogram in obs/report.py.
 
 * ``trace(log_dir)`` — context manager around ``jax.profiler.trace``;
   produces TensorBoard/Perfetto-loadable device+host traces of everything
   dispatched inside.
 * ``step_annotation(step)`` — ``StepTraceAnnotation`` so per-step slices are
   attributed in the trace timeline.
+* ``phase_annotation(name)`` — ``TraceAnnotation`` carrying one of the
+  canonical ``obs.report.PHASES`` names, so the XLA timeline and the
+  host-side ``obs_report.json`` breakdown use the same vocabulary.
 * ``enable_nan_debugging()`` — flips ``jax_debug_nans``: any NaN produced by
   a jitted computation re-runs un-jitted and raises FloatingPointError at
   the exact primitive.  Training-time detection of *adversarial* non-finite
   gradients does NOT rely on this (the verifier's finite flag handles that
   in-step); this is a developer mode for debugging the framework itself.
+
+All annotations are **no-op-safe**: constructing or entering one outside
+an active profiler session (or on a backend whose profiler plugin is
+broken) degrades to a null context instead of raising — the trainer's
+hot loop annotates every step, and an instrumentation shim must never be
+the thing that kills a run.
 
 Wired into DistributedTrainer via TrainingConfig.profile_dir /
 TrainingConfig.debug_nans.
@@ -30,6 +39,11 @@ import os
 from typing import Iterator, Optional
 
 import jax
+
+from trustworthy_dl_tpu.obs.report import PHASES  # canonical phase names
+
+__all__ = ["PHASES", "enable_nan_debugging", "phase_annotation",
+           "step_annotation", "trace"]
 
 logger = logging.getLogger(__name__)
 
@@ -48,9 +62,49 @@ def trace(log_dir: Optional[str]) -> Iterator[None]:
     logger.info("profiler: trace written to %s", log_dir)
 
 
-def step_annotation(step: int):
-    """Label one train step in the trace timeline."""
-    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+class _SafeAnnotation:
+    """Wraps a jax.profiler annotation so that construction, entry and
+    exit failures (no active profiler session, missing plugin) all
+    degrade to a no-op.  Re-entrant per instance is not supported —
+    build one per ``with`` block, as the factories below do."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, factory, *args, **kwargs):
+        try:
+            self._ctx = factory(*args, **kwargs)
+        except Exception:
+            self._ctx = None
+
+    def __enter__(self):
+        if self._ctx is not None:
+            try:
+                self._ctx.__enter__()
+            except Exception:
+                self._ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            try:
+                return bool(self._ctx.__exit__(*exc))
+            except Exception:
+                pass
+        return False
+
+
+def step_annotation(step: int) -> _SafeAnnotation:
+    """Label one train step in the trace timeline (no-op-safe)."""
+    return _SafeAnnotation(jax.profiler.StepTraceAnnotation, "train_step",
+                           step_num=step)
+
+
+def phase_annotation(name: str) -> _SafeAnnotation:
+    """Label a host-side phase in the trace timeline with one of the
+    canonical ``obs.report.PHASES`` names (no-op-safe)."""
+    if name not in PHASES:
+        raise ValueError(f"unknown phase {name!r}; one of {PHASES}")
+    return _SafeAnnotation(jax.profiler.TraceAnnotation, name)
 
 
 def enable_nan_debugging(enabled: bool = True) -> None:
