@@ -24,7 +24,13 @@ TDDL_BENCH_ATTN (model default), TDDL_BENCH_ACCUM (grad accumulation
 microbatches, 1).  Optional legs: TDDL_BENCH_LONGCTX=1 (flash vs XLA
 long-context A/B), TDDL_BENCH_GEN=1 (decode), TDDL_BENCH_SERVE=1
 (continuous-batching offered-load sweep), TDDL_BENCH_CHAOS=1 (seeded
-chaos survival sweep through the self-healing supervisor).
+chaos survival sweep through the self-healing supervisor),
+TDDL_BENCH_ASYNC=1 (async host-pipeline A/B: trainer loop at
+async_host_depth 0 vs default, tokens/sec + obs phase shares).
+Infra knobs: TDDL_BENCH_PROBE_TIMEOUT (backend liveness probe seconds,
+default 180; a successful probe is cached for the process),
+TDDL_BENCH_COMPILE_CACHE=1 (persistent XLA compilation cache under
+TDDL_BENCH_OBS_DIR, so repeat runs skip recompiles).
 
 ``--config <preset>`` selects a BASELINE.md benchmark-matrix shape
 (`--config list` prints them); env overrides still apply on top.  The
@@ -42,6 +48,11 @@ import time
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# Successful backend-probe result, cached per process (count, platform):
+# one slow init must not skip a whole multi-leg sweep that re-probes.
+_PROBE_CACHE = None
 
 
 # BASELINE.md benchmark-matrix presets (configs 1-4 shapes + extras), so
@@ -423,6 +434,10 @@ def bench_chaos() -> "list[dict]":
         model_name="gpt2", dataset_name="openwebtext", batch_size=16,
         num_nodes=4, learning_rate=3e-3, detector_warmup=4,
         checkpoint_interval=5, checkpoint_dir=ckpt_dir, num_epochs=epochs,
+        # FaultPlan.predict's retry/rollback arithmetic assumes the
+        # synchronous step guard; the async pipeline's lagged guard skips
+        # in-place retries (engine/async_host.py).
+        async_host_depth=0,
     )
     trainer = DistributedTrainer(config, model_overrides=tiny)
     dl = get_dataloader("openwebtext", batch_size=16, seq_len=32,
@@ -475,6 +490,82 @@ def bench_chaos() -> "list[dict]":
         rows.append(row)
     shutil.rmtree(ckpt_dir, ignore_errors=True)
     return rows
+
+
+def bench_async() -> "dict | None":
+    """Async host-pipeline A/B (TDDL_BENCH_ASYNC=1): the REAL trainer host
+    loop (``train_epoch``) at ``async_host_depth=0`` (every step blocks on
+    the host pulls) vs the config default (bounded in-flight dispatch,
+    lagged host drain) — tokens/sec and the obs phase shares per arm, so
+    the record shows the blocked-on-host time collapsing.  LM-only (the
+    headline row); one trainer is built and the arms share its compiled
+    step via ``reset_for_run``.
+
+    Env: TDDL_BENCH_ASYNC_STEPS (measured steps per arm; default
+    TDDL_BENCH_STEPS), plus the usual TDDL_BENCH_MODEL/NODES/BATCH/SEQ
+    shape overrides."""
+    import dataclasses
+
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.data import get_dataloader
+    from trustworthy_dl_tpu.obs import ObsSession
+
+    model = os.environ.get("TDDL_BENCH_MODEL", "gpt2")
+    if not model.startswith("gpt"):
+        log("async A/B skipped: defined for the LM headline row "
+            f"(TDDL_BENCH_MODEL={model})")
+        return None
+    num_nodes = int(os.environ.get("TDDL_BENCH_NODES", "4"))
+    per_node_batch = int(os.environ.get("TDDL_BENCH_BATCH", "16"))
+    seq_len = int(os.environ.get("TDDL_BENCH_SEQ", "512"))
+    steps = int(os.environ.get(
+        "TDDL_BENCH_ASYNC_STEPS", os.environ.get("TDDL_BENCH_STEPS", "20")))
+    n_chips = int(os.environ.get("_TDDL_BENCH_NCHIPS", "1"))
+    batch_size = num_nodes * per_node_batch
+    tokens_per_step = batch_size * seq_len
+    default_depth = TrainingConfig().async_host_depth
+
+    trainer, _, _ = _build_bench_trainer(True, model, num_nodes,
+                                         per_node_batch, seq_len)
+    vocab = trainer.model.config.vocab_size
+    warm_dl = get_dataloader("openwebtext", batch_size=batch_size,
+                             seq_len=seq_len, vocab_size=vocab,
+                             num_examples=batch_size * 3)
+    dl = get_dataloader("openwebtext", batch_size=batch_size,
+                        seq_len=seq_len, vocab_size=vocab,
+                        num_examples=batch_size * steps)
+
+    arms = {}
+    for label, depth in (("sync", 0), ("async", default_depth)):
+        trainer.config = dataclasses.replace(trainer.config,
+                                             async_host_depth=depth)
+        trainer.reset_for_run()
+        trainer.attach_obs(ObsSession(None))  # warmup arm — discarded
+        trainer.train_epoch(warm_dl, 0)
+        session = ObsSession(None)
+        trainer.attach_obs(session)
+        t0 = time.perf_counter()
+        trainer.train_epoch(dl, 1)
+        elapsed = time.perf_counter() - t0
+        phases = session.step_timer.report().get("phases", {})
+        arms[label] = {
+            "async_host_depth": depth,
+            "tokens_per_s_per_chip": round(
+                steps * tokens_per_step / elapsed / n_chips, 1),
+            "steps_per_s": round(steps / elapsed, 3),
+            "phase_fractions": {
+                name: round(stats["fraction"], 4)
+                for name, stats in phases.items()
+            },
+        }
+        log(f"async A/B [{label} depth={depth}]: "
+            f"{arms[label]['steps_per_s']:.3f} steps/s, phases "
+            f"{arms[label]['phase_fractions']}")
+    speedup = (arms["async"]["tokens_per_s_per_chip"]
+               / max(arms["sync"]["tokens_per_s_per_chip"], 1e-9))
+    arms["speedup"] = round(speedup, 4)
+    log(f"async A/B speedup (depth {default_depth} vs 0): {speedup:.4f}x")
+    return arms
 
 
 def bench_generate() -> None:
@@ -561,22 +652,31 @@ def main() -> None:
     def _probe_backend():
         # The tunnel has a documented total-wedge mode where backend init
         # hangs >10 min inside native code — a SIGALRM can't interrupt
-        # that, so the probe runs in a SUBPROCESS with a hard timeout.
+        # that, so the probe runs in a SUBPROCESS with a hard timeout
+        # (TDDL_BENCH_PROBE_TIMEOUT seconds, default 180 — raise it for
+        # slow-init backends instead of losing the round to a skip).
         # Only after the probe proves the backend answers does this
-        # process touch jax itself.
+        # process touch jax itself.  A SUCCESSFUL probe is cached for the
+        # process: multi-leg sweeps re-entering main() must not re-pay
+        # (or re-risk) the init just because one probe was slow.
+        global _PROBE_CACHE
+        if _PROBE_CACHE is not None:
+            return _PROBE_CACHE
+        timeout = float(os.environ.get("TDDL_BENCH_PROBE_TIMEOUT", "180"))
         proc = subprocess.run(
             [sys.executable, "-c",
              "import jax, json; "
              "print(json.dumps([jax.device_count(), "
              "jax.devices()[0].platform]))"],
-            capture_output=True, text=True, timeout=180,
+            capture_output=True, text=True, timeout=timeout,
         )
         if proc.returncode != 0:
             tail = proc.stderr.strip().splitlines()
             raise RuntimeError(tail[-1] if tail else
                                f"probe rc={proc.returncode}")
         count, name = json.loads(proc.stdout.strip().splitlines()[-1])
-        return max(int(count), 1), name
+        _PROBE_CACHE = max(int(count), 1), name
+        return _PROBE_CACHE
 
     n_chips = platform = None
     last_err = None
@@ -668,6 +768,22 @@ def _inner_main() -> None:
         # (tests/test_bench_contract.py) without a real dead backend.
         log("FAKE_WEDGE: sleeping forever (watchdog should kill this)")
         time.sleep(10 ** 6)
+
+    if os.environ.get("TDDL_BENCH_COMPILE_CACHE") == "1":
+        # Persistent XLA compilation cache for the whole measured body:
+        # repeat sweeps skip recompiles of identical SPMD programs.  The
+        # cache lives under the obs dir when one is set (self-contained
+        # run artifacts), else a stable temp path.
+        import tempfile
+
+        from trustworthy_dl_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        cache_dir = os.environ.get("TDDL_BENCH_COMPILE_CACHE_DIR") or \
+            os.path.join(os.environ.get("TDDL_BENCH_OBS_DIR")
+                         or tempfile.gettempdir(), "tddl_bench_jax_cache")
+        log(f"persistent compilation cache: {enable_persistent_cache(cache_dir)}")
 
     is_lm = model.startswith("gpt")
     log(f"bench: {model} nodes={num_nodes} batch/node={per_node_batch} "
@@ -767,6 +883,9 @@ def _inner_main() -> None:
     chaos_records = None
     if os.environ.get("TDDL_BENCH_CHAOS") == "1":
         chaos_records = bench_chaos()
+    async_records = None
+    if os.environ.get("TDDL_BENCH_ASYNC") == "1":
+        async_records = bench_async()
 
     record = {
         "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"
@@ -787,6 +906,8 @@ def _inner_main() -> None:
         record["serve"] = serve_records
     if chaos_records is not None:
         record["chaos"] = chaos_records
+    if async_records is not None:
+        record["async"] = async_records
     obs_dir = os.environ.get("TDDL_BENCH_OBS_DIR")
     if obs_dir:
         # Attach the per-run obs report next to whatever artifact set the

@@ -58,7 +58,11 @@ def main() -> None:
         model_name="gpt2", dataset_name="openwebtext",
         batch_size=8, num_nodes=4, learning_rate=3e-3,
         detector_warmup=4, checkpoint_interval=5,
-        checkpoint_dir=ckpt_dir, num_epochs=epochs,
+        checkpoint_dir=ckpt_dir,
+        # FaultPlan.predict's retry/rollback arithmetic assumes the
+        # synchronous step guard; the async pipeline's lagged guard
+        # skips in-place retries (engine/async_host.py).
+        async_host_depth=0, num_epochs=epochs,
     )
     trainer = DistributedTrainer(config, model_overrides=dict(TINY))
     dl = get_dataloader("openwebtext", batch_size=8, seq_len=16,

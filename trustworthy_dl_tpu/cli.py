@@ -44,6 +44,20 @@ def build_parser() -> argparse.ArgumentParser:
                         help="disable the in-step attack detector")
     parser.add_argument("--steps-per-epoch", type=int, default=50,
                         help="synthetic-data epoch length")
+    parser.add_argument("--async-host-depth", type=int, default=None,
+                        help="steps kept in flight by the async host "
+                             "pipeline (engine/async_host.py): dispatch "
+                             "runs up to this many steps ahead of the "
+                             "host bookkeeping, which drains lagged "
+                             "through one packed device->host copy per "
+                             "step; 0 = fully synchronous (config "
+                             "default: 2).  Deterministic chaos drills "
+                             "asserting exact retry counts need 0")
+    parser.add_argument("--compile-cache", action="store_true",
+                        help="enable JAX's persistent compilation cache "
+                             "under the run dir (<obs-dir or "
+                             "checkpoint-dir>/jax_cache) so repeat runs "
+                             "skip recompiles of identical SPMD programs")
     # Self-healing supervisor (engine/supervisor.py) + chaos drills.
     parser.add_argument("--supervise", action="store_true",
                         help="wrap training in the self-healing supervisor: "
@@ -97,6 +111,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "learning_rate": args.learning_rate,
             "parallelism": args.parallelism,
             "checkpoint_dir": args.checkpoint_dir,
+            "async_host_depth": args.async_host_depth,
         }.items() if v is not None
     }
     if args.no_detection:
@@ -105,6 +120,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         config = load_config(args.config, **overrides)
     else:
         config = TrainingConfig(**overrides)
+    if args.compile_cache:
+        import dataclasses
+        import os
+
+        run_dir = args.obs_dir or config.checkpoint_dir
+        config = dataclasses.replace(
+            config,
+            compilation_cache_dir=os.path.join(run_dir, "jax_cache"),
+        )
 
     trainer = DistributedTrainer(config)
     trainer.initialize()

@@ -103,6 +103,29 @@ class TrainingConfig:
     # (native gathers) while batch k trains on device.  0 disables.
     prefetch_depth: int = 2
     detector_warmup: int = 10          # min history before verdicts (:91,:126)
+    # Async host pipeline (engine/async_host.py): keep up to this many
+    # steps in flight — each step's host-facing metrics are packed into ONE
+    # flat device array whose device→host copy starts asynchronously, and
+    # the host bookkeeping (detector history feed, trust mirror, incident
+    # records, step guard) drains up to this many steps behind the
+    # dispatch frontier, so the accelerator never idles waiting for Python.
+    # 0 = fully synchronous (the pre-pipeline behavior: every step blocks
+    # on ~10 separate device→host pulls before the next dispatch).
+    # Semantics at depth K>0 are identical on the healthy path (same
+    # losses/trust/incidents, just observed up to K steps late); supervisor
+    # guard trips within the in-flight window roll back to the newest
+    # verified checkpoint, which by construction predates the window
+    # (checkpoint saves force a full drain first) — see README
+    # §Performance.  Deterministic chaos drills that assert exact retry
+    # counts (FaultPlan.predict) must run at depth 0: the lagged guard
+    # skips in-place retries.
+    async_host_depth: int = 2
+    # Persistent XLA compilation cache (jax_compilation_cache_dir): repeat
+    # runs of identical SPMD programs skip recompiles.  None = off (the
+    # default); set a path (conventionally under the run dir) to enable —
+    # cli.py --compile-cache and bench.py TDDL_BENCH_COMPILE_CACHE=1 wire
+    # it for their run dirs.
+    compilation_cache_dir: Optional[str] = None
     # Epoch-cadence host intelligence — the reference defined these but never
     # called them (SURVEY §7.5: trust_manager.py:333; attack_detector.py:381).
     adaptive_thresholds: bool = True   # trust_manager.adaptive_threshold_adjustment
@@ -200,6 +223,11 @@ class TrainingConfig:
             raise ValueError(
                 "remat_policy must be 'block' or 'attention', "
                 f"got {self.remat_policy!r}"
+            )
+        if self.async_host_depth < 0:
+            raise ValueError(
+                "async_host_depth must be >= 0 (0 = synchronous), "
+                f"got {self.async_host_depth}"
             )
 
 

@@ -369,8 +369,10 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     )
     trainer._eval_step = jax.jit(build_node_eval_step(trainer.model))
     trainer.state = new_state
-    trainer.attack_plan = trainer.attack_plan._replace(
-        target_mask=trainer.attack_plan.target_mask[np.asarray(keep)]
+    trainer.attack_plan = trainer._place_plan(
+        trainer.attack_plan._replace(
+            target_mask=trainer.attack_plan.target_mask[np.asarray(keep)]
+        )
     )
     evicted_ids = [trainer.node_map[i] for i in drop]
     trainer.node_map = [trainer.node_map[i] for i in keep]
@@ -540,8 +542,8 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         [bool(trainer._plan_bits.get(nid, False))
          for nid in trainer.node_map], bool,
     )
-    trainer.attack_plan = trainer.attack_plan._replace(
-        target_mask=jnp.asarray(bits)
+    trainer.attack_plan = trainer._place_plan(
+        trainer.attack_plan._replace(target_mask=jnp.asarray(bits))
     )
 
     for nid in node_ids:

@@ -326,8 +326,8 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
     trainer._idle_pool = new_pool
     bits = np.array([bool(trainer._plan_bits.get(nid, False))
                      for nid in new_map], bool)
-    trainer.attack_plan = trainer.attack_plan._replace(
-        target_mask=jnp.asarray(bits)
+    trainer.attack_plan = trainer._place_plan(
+        trainer.attack_plan._replace(target_mask=jnp.asarray(bits))
     )
 
     record = {

@@ -1,12 +1,15 @@
+from trustworthy_dl_tpu.engine.async_host import AsyncHostPipeline
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
 from trustworthy_dl_tpu.engine.state import MonitorState, TrainState, init_monitor_state, init_train_state, update_monitor
-from trustworthy_dl_tpu.engine.step import StepMetrics, build_eval_step, build_train_step
+from trustworthy_dl_tpu.engine.step import HostMetricsPacker, StepMetrics, build_eval_step, build_train_step
 from trustworthy_dl_tpu.engine.supervisor import PreemptionSignal, TrainingSupervisor
 from trustworthy_dl_tpu.engine.trainer import DistributedTrainer, TrainingState
 
 __all__ = [
+    "AsyncHostPipeline",
     "CheckpointManager",
+    "HostMetricsPacker",
     "DistributedTrainer",
     "MonitorState",
     "PreemptionSignal",

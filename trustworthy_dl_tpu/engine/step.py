@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, poison_batch, \
@@ -230,6 +231,100 @@ class StepMetrics(NamedTuple):
     # majority-attack backstop; None when the step doesn't compute it
     # (pipeline mode, verification off).
     fleet_alert: Any = None
+
+
+class HostMetricsPacker:
+    """Packs the host-facing slice of a step's outputs into ONE flat f32
+    device array so the per-step device→host traffic is a single transfer
+    whose copy can start asynchronously (``copy_to_host_async``) while the
+    next step dispatches — the engine of the async host pipeline
+    (engine/async_host.py).
+
+    The synchronous host path pulls ~10 separate arrays per step
+    (``float(metrics.loss)`` + per-field ``np.asarray`` in
+    ``_record_batch``), each a blocking round-trip.  The packer instead
+    concatenates every ``StepMetrics`` leaf (plus the post-step
+    ``fleet_raw_streak``, which the drain needs at its *step-time* value —
+    by drain time ``trainer.state`` has moved on) into one vector inside a
+    tiny jitted program, and ``unpack`` restores the exact original
+    dtypes/shapes host-side, so the drained metrics are bit-identical to
+    what the synchronous path would have read.
+
+    All packed dtypes survive the f32 round-trip exactly: bool → {0.0, 1.0}
+    → bool, and the i32 fields (status, attack_type) hold values far below
+    2**24.  The layout is frozen from a template step's structure; a
+    topology change (elastic eviction/readmission) changes the node count,
+    which ``matches`` detects so the pipeline rebuilds the packer.
+    """
+
+    def __init__(self, metrics: StepMetrics, fleet_streak: Any = None):
+        self._layout: list = []  # (key, shape, size, dtype)
+        offset = 0
+        for key, leaf in self._leaves(metrics, fleet_streak):
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            self._layout.append((key, tuple(leaf.shape), size,
+                                 np.dtype(leaf.dtype)))
+            offset += size
+        self.total = offset
+        self.num_nodes = int(metrics.trust_scores.shape[0])
+        self._jit_pack = jax.jit(self._pack_impl)
+
+    @staticmethod
+    def _leaves(metrics: StepMetrics, fleet_streak: Any):
+        """Deterministic (key, array) walk shared by layout and pack."""
+        for name in StepMetrics._fields:
+            value = getattr(metrics, name)
+            if name == "model_aux":
+                for k in sorted(value or {}):
+                    yield f"model_aux:{k}", value[k]
+            elif value is not None:
+                yield name, value
+        if fleet_streak is not None:
+            yield "fleet_raw_streak", fleet_streak
+
+    def matches(self, metrics: StepMetrics, fleet_streak: Any = None) -> bool:
+        """Same structure/shapes as the template this packer was built on?"""
+        probe = [(k, tuple(v.shape)) for k, v in
+                 self._leaves(metrics, fleet_streak)]
+        return probe == [(k, s) for k, s, _, _ in self._layout]
+
+    def _pack_impl(self, metrics: StepMetrics, fleet_streak: Any
+                   ) -> jax.Array:
+        parts = [leaf.astype(jnp.float32).reshape(-1)
+                 for _, leaf in self._leaves(metrics, fleet_streak)]
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def pack(self, metrics: StepMetrics, fleet_streak: Any = None
+             ) -> jax.Array:
+        """One flat f32[total] device array; dispatch only, no host sync."""
+        packed = self._jit_pack(metrics, fleet_streak)
+        # Start the device→host copy now so it overlaps the next step's
+        # dispatch/execution; by drain time np.asarray is (near) free.
+        copy_async = getattr(packed, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+        return packed
+
+    def unpack(self, flat: np.ndarray) -> Tuple[StepMetrics, Any]:
+        """(StepMetrics with numpy leaves, fleet_raw_streak or None) from
+        the pulled flat vector — original dtypes and shapes restored."""
+        flat = np.asarray(flat)
+        fields: Dict[str, Any] = {"model_aux": None, "fleet_alert": None}
+        aux: Dict[str, Any] = {}
+        streak = None
+        offset = 0
+        for key, shape, size, dtype in self._layout:
+            chunk = flat[offset:offset + size].astype(dtype).reshape(shape)
+            offset += size
+            if key.startswith("model_aux:"):
+                aux[key.split(":", 1)[1]] = chunk
+            elif key == "fleet_raw_streak":
+                streak = chunk
+            else:
+                fields[key] = chunk
+        if aux:
+            fields["model_aux"] = aux
+        return StepMetrics(**fields), streak
 
 
 def build_train_step(

@@ -28,6 +28,16 @@ healthy state — "verified" means integrity-verified AND
 taken-while-training-was-sane.  Wire a ``chaos.FaultInjector`` through the
 constructor to drill the whole ladder deterministically
 (``examples/chaos_drill.py``).
+
+Async drain contract: under the trainer's async host pipeline
+(``TrainingConfig.async_host_depth`` > 0, engine/async_host.py) the
+guard runs LAGGED — ``after_step(..., lagged=True)`` arrives up to K
+steps after the step executed, with ``trainer.state`` already at the
+dispatch frontier.  Rung 2 (in-place retries) is skipped in that mode
+and a rollback lands on a checkpoint that predates the whole in-flight
+window (saves force a full drain, so every verified checkpoint covers a
+guard-accepted prefix).  Drills asserting ``FaultPlan.predict``'s exact
+retry counts must run at depth 0.
 """
 
 from __future__ import annotations
@@ -140,25 +150,48 @@ class TrainingSupervisor:
         finite = np.asarray(metrics.finite)
         return bool(finite.size) and not bool(finite.any())
 
+    #: The async drain (engine/async_host.py) checks this attribute to
+    #: know it may pass ``lagged=True`` — duck-typed guards without it
+    #: keep receiving the original three-argument call.
+    lagged_aware = True
+
     def after_step(self, trainer: DistributedTrainer, node_batch: Any,
-                   metrics: StepMetrics) -> Optional[StepMetrics]:
+                   metrics: StepMetrics, lagged: bool = False
+                   ) -> Optional[StepMetrics]:
         """Trainer step-guard hook.  Returns the metrics the trainer should
         account, or None when the step was rejected (and possibly rolled
         back — ``trainer.global_step`` then already points at the restored
-        step)."""
+        step).
+
+        ``lagged=True`` is the async-pipeline drain contract
+        (``TrainingConfig.async_host_depth`` > 0): the verdict arrives up
+        to K steps after the step ran, with ``trainer.state`` already at
+        the dispatch frontier.  In that mode the in-place retry rung is
+        SKIPPED — re-running a K-step-old batch against the frontier state
+        is not the same computation, and with corrupted state it would
+        only burn the retry budget — so a bad lagged step counts
+        immediately toward the rollback streak.  The rollback target is
+        still sound: checkpoint saves force a full drain first, so the
+        newest verified checkpoint always predates the in-flight window
+        (the K-step rollback caveat — README §Performance).  Deterministic
+        drills asserting ``FaultPlan.predict``'s exact retry counts must
+        therefore run at depth 0."""
         if self._preempt_flag:
             self._preempt_flag = False
             raise PreemptionSignal("SIGTERM received")
         if not self._is_bad(metrics):
             self._bad_streak = 0
             return metrics
+        retries = 0 if lagged else self.max_retries
         logger.warning(
             "Supervisor: bad step %d (loss=%s, grad_norm=%s, "
-            "finite_nodes=%d/%d) — retrying up to %d time(s)",
+            "finite_nodes=%d/%d)%s — retrying up to %d time(s)",
             trainer.global_step, float(np.asarray(metrics.loss)),
             float(np.asarray(metrics.grad_norm)),
             int(np.asarray(metrics.finite).sum()),
-            int(np.asarray(metrics.finite).size), self.max_retries,
+            int(np.asarray(metrics.finite).size),
+            " [lagged verdict: in-place retries skipped]" if lagged else "",
+            retries,
         )
         if self.obs is not None:
             self.obs.trace.emit(
@@ -168,7 +201,7 @@ class TrainingSupervisor:
                 finite_nodes=int(np.asarray(metrics.finite).sum()),
             )
         self._counters.inc(action="guard_trip")
-        for attempt in range(self.max_retries):
+        for attempt in range(retries):
             self.retries += 1
             self._counters.inc(action="retry")
             if self.obs is not None:

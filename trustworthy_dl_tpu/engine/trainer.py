@@ -89,6 +89,12 @@ class DistributedTrainer:
         self.training_state = TrainingState.INITIALIZING
         if config.debug_nans:
             enable_nan_debugging()
+        if config.compilation_cache_dir:
+            from trustworthy_dl_tpu.utils.compile_cache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(config.compilation_cache_dir)
 
         # Epoch-cadence ML tier, gated once on sklearn availability:
         # without it the refit is a permanent no-op, so the per-step
@@ -268,6 +274,13 @@ class DistributedTrainer:
         # event stream (emit on change, not per step).
         self.obs: Any = None
         self._last_status: Optional[np.ndarray] = None
+        # Async host pipeline (engine/async_host.py): while a LAGGED step
+        # drains, ``_drain_ctx`` carries that step's packed fleet-norm
+        # streak (the live state is up to K steps ahead) and collects
+        # elastic evictions for deferred application at the frontier.
+        # None whenever the synchronous path runs — per-run state like
+        # chaos/step_guard so a reset can never leak a stale context.
+        self._drain_ctx: Any = None
         # A supervisor also wires its injector into the checkpointer's
         # commit hooks; detach that too on reset, or a previous run's
         # UNFIRED checkpoint faults would fire in the next clean run.
@@ -332,6 +345,9 @@ class DistributedTrainer:
             canary=canary,
         ))
         self.training_state = TrainingState.TRAINING
+        # The default (null) plan rides every step dispatch too — commit
+        # it to the mesh once, like set_attack_plan does for real plans.
+        self.attack_plan = self._place_plan(self.attack_plan)
         return self.state
 
     def reset_for_run(self, seed: Optional[int] = None) -> TrainState:
@@ -419,6 +435,32 @@ class DistributedTrainer:
         )
         return state._replace(**placed, **shared, **scalars)
 
+    def _place_plan(self, plan: AttackPlan) -> AttackPlan:
+        """Commit the attack plan's leaves onto the mesh ONCE, in the
+        layout the compiled step infers (per-node [n] rows over the node
+        axis, scalars replicated).  An uncommitted plan is re-placed by
+        the runtime at EVERY dispatch — an implicit per-step transfer the
+        async pipeline's transfer-guard test pins out of the hot path."""
+        mesh = self.mesh
+        if len(list(mesh.devices.flat)) <= 1:
+            return plan
+        node_axis = STAGE_AXIS if self.config.parallelism == "model" else \
+            DATA_AXIS
+        axis_size = dict(
+            zip(mesh.axis_names, mesh.devices.shape)
+        ).get(node_axis, 1)
+        n = self.config.num_nodes
+        repl = NamedSharding(mesh, P())
+
+        def place(leaf):
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n \
+                    and axis_size > 1 and n % axis_size == 0:
+                spec = P(node_axis, *([None] * (leaf.ndim - 1)))
+                return jax.device_put(leaf, NamedSharding(mesh, spec))
+            return jax.device_put(leaf, repl)
+
+        return jax.tree_util.tree_map(place, plan)
+
     def set_attack_plan(self, plan: AttackPlan,
                         target_ids: Optional[Sequence[int]] = None) -> None:
         """Install the experiment's fault-injection schedule.
@@ -428,7 +470,7 @@ class DistributedTrainer:
         before activation): the coordinate-space mask cannot carry their
         bit, and without it a later readmission would wrongly re-enter
         them as clean."""
-        self.attack_plan = plan
+        self.attack_plan = self._place_plan(plan)
         if target_ids is not None:
             targets = {int(i) for i in target_ids}
             self._plan_bits = {
@@ -619,74 +661,144 @@ class DistributedTrainer:
         if timer is not None:
             timer.discard_step()  # anchor the first step's "data" lap
 
-        for batch_idx, batch in enumerate(dataloader):
-            self.global_step += 1
-            if self._per_node_batch is None and \
-                    self.config.parallelism != "model":
-                lead = min(arr.shape[0] for arr in batch.values())
-                accum = max(self.config.grad_accum_steps, 1)
-                per = lead // (self.config.num_nodes * accum)
-                if per > 0:
-                    self._per_node_batch = per
-            if self.chaos is not None:
-                # Fault-injection hooks (chaos/injector.py): a lost batch
-                # (simulated data-iterator failure) rides the stale-batch
-                # skip path; on_step_start may stall (straggler) or raise
-                # SimulatedPreemption for the supervisor to catch.
-                batch = self.chaos.on_batch(self.global_step, batch)
-                if batch is None:
+        # Async host pipeline (engine/async_host.py): at depth K > 0 the
+        # loop below dispatches step k+1 before step k's host-facing
+        # metrics have landed — the bookkeeping drains lagged through the
+        # same host path, and the mandatory full drains (checkpoint saves,
+        # epoch end via the finally, guard rollbacks, elastic transitions,
+        # preemption unwind) keep the verified-checkpoint semantics exact.
+        pipe = None
+        depth = max(int(getattr(self.config, "async_host_depth", 0)), 0)
+        if depth > 0:
+            from trustworthy_dl_tpu.engine.async_host import (
+                AsyncHostPipeline,
+            )
+
+            pipe = AsyncHostPipeline(self, depth)
+
+        try:
+            for batch_idx, batch in enumerate(dataloader):
+                self.global_step += 1
+                if self._per_node_batch is None and \
+                        self.config.parallelism != "model":
+                    lead = min(arr.shape[0] for arr in batch.values())
+                    accum = max(self.config.grad_accum_steps, 1)
+                    per = lead // (self.config.num_nodes * accum)
+                    if per > 0:
+                        self._per_node_batch = per
+                if self.chaos is not None:
+                    # Fault-injection hooks (chaos/injector.py): a lost
+                    # batch (simulated data-iterator failure) rides the
+                    # stale-batch skip path; on_step_start may stall
+                    # (straggler) or raise SimulatedPreemption for the
+                    # supervisor to catch.
+                    batch = self.chaos.on_batch(self.global_step, batch)
+                    if batch is None:
+                        self.global_step -= 1
+                        continue
+                    self.chaos.on_step_start(self.global_step)
+                node_batch = self._node_batch(batch)
+                if node_batch is None:  # stale undersized batch
                     self.global_step -= 1
-                    continue
-                self.chaos.on_step_start(self.global_step)
-            node_batch = self._node_batch(batch)
-            if node_batch is None:  # stale undersized batch mid-transition
-                self.global_step -= 1
-                if timer is not None:
-                    timer.discard_step()
-                continue
-            if timer is not None:
-                self._obs_note_model_info(node_batch)
-                timer.lap("data")  # loader + host assembly + shard place
-            with step_annotation(self.global_step):
-                self.state, metrics = self._train_step(
-                    self.state, node_batch, self.attack_plan
-                )
-            if self.chaos is not None:
-                self.state, metrics = self.chaos.on_step_end(
-                    self.global_step, self.state, metrics
-                )
-            if self.step_guard is not None:
-                metrics = self.step_guard.after_step(self, node_batch,
-                                                     metrics)
-                if metrics is None:
-                    # Step rejected (non-finite / wedged) — possibly rolled
-                    # back to a verified checkpoint (global_step restored by
-                    # load_checkpoint).  Nothing to account.  A rejected
-                    # step's wall time (retries, rollback restore) would
-                    # poison the phase distribution — drop it.
                     if timer is not None:
                         timer.discard_step()
                     continue
-            self.metrics_collector.tick()
-            loss = float(metrics.loss)  # host sync closes the device step
-            if timer is not None:
-                timer.lap("compute")  # dispatch + fused device step + sync
-            self._record_batch(metrics, epoch, loss)
-            self._maybe_readmit()
-            if timer is not None:
-                timer.lap("detection")  # host verdicts/incident records
-            epoch_loss += loss
-            num_batches += 1
+                if timer is not None:
+                    self._obs_note_model_info(node_batch)
+                    timer.lap("data")  # loader + host assembly + placement
+                with step_annotation(self.global_step):
+                    self.state, metrics = self._train_step(
+                        self.state, node_batch, self.attack_plan
+                    )
+                if self.chaos is not None:
+                    self.state, metrics = self.chaos.on_step_end(
+                        self.global_step, self.state, metrics
+                    )
 
-            if self.global_step % self.config.checkpoint_interval == 0:
-                self.save_checkpoint()
-            if timer is not None:
-                timer.lap("checkpoint")
-                timer.finish_step()
-                self.obs.on_step(self.global_step)
-            if batch_idx % 10 == 0:
-                logger.info("Epoch %d, Batch %d, Loss: %.4f",
-                            epoch, batch_idx, loss)
+                if pipe is not None:
+                    # Asynchronous accounting: pack + start the D2H copy,
+                    # then drain only what has fallen out of the window.
+                    # Guard checks / records / readmission run lagged
+                    # inside the drain.
+                    pipe.push(epoch, batch_idx, node_batch, metrics,
+                              self.state)
+                    dispatched = self.global_step
+                    if timer is not None:
+                        timer.lap("compute")  # dispatch only — no sync
+                    pipe.drain()
+                    ckpt_step = dispatched % \
+                        self.config.checkpoint_interval == 0
+                    if ckpt_step:
+                        pipe.drain(0)  # mandatory full drain before a save
+                    if timer is not None:
+                        # Both drains land here: blocked-on-lagged-metrics
+                        # time is the "host" phase even on save steps (the
+                        # save itself is the "checkpoint" lap below).
+                        timer.lap("host")
+                    if ckpt_step:
+                        # Save only when the frontier step survived the
+                        # drain intact: a rollback moved the counter (and
+                        # re-saving the checkpoint just restored would be
+                        # pure waste), and a guard-rejected frontier step
+                        # must not be enshrined as "verified".
+                        if self.global_step == dispatched and \
+                                pipe.last_rejected_step != dispatched:
+                            self.save_checkpoint()
+                    if timer is not None:
+                        timer.lap("checkpoint")
+                        if pipe.consume_rejection():
+                            # Same contract as the synchronous path: a
+                            # rejected step's wall time (rollback restore)
+                            # would poison the phase distribution.
+                            timer.discard_step()
+                        else:
+                            timer.finish_step()
+                        self.obs.on_step(self.global_step)
+                    continue
+
+                # Synchronous path (async_host_depth=0): every step blocks
+                # on the host pulls before the next dispatch.
+                if self.step_guard is not None:
+                    metrics = self.step_guard.after_step(self, node_batch,
+                                                         metrics)
+                    if metrics is None:
+                        # Step rejected (non-finite / wedged) — possibly
+                        # rolled back to a verified checkpoint (global_step
+                        # restored by load_checkpoint).  Nothing to
+                        # account.  A rejected step's wall time (retries,
+                        # rollback restore) would poison the phase
+                        # distribution — drop it.
+                        if timer is not None:
+                            timer.discard_step()
+                        continue
+                self.metrics_collector.tick()
+                loss = float(metrics.loss)  # host sync closes the step
+                if timer is not None:
+                    timer.lap("compute")  # dispatch + device step + sync
+                self._record_batch(metrics, epoch, loss)
+                self._maybe_readmit()
+                if timer is not None:
+                    timer.lap("detection")  # host verdicts/incidents
+                epoch_loss += loss
+                num_batches += 1
+
+                if self.global_step % self.config.checkpoint_interval == 0:
+                    self.save_checkpoint()
+                if timer is not None:
+                    timer.lap("checkpoint")
+                    timer.finish_step()
+                    self.obs.on_step(self.global_step)
+                if batch_idx % 10 == 0:
+                    logger.info("Epoch %d, Batch %d, Loss: %.4f",
+                                epoch, batch_idx, loss)
+        finally:
+            if pipe is not None:
+                # Mandatory full drain: epoch aggregation, the epoch-end
+                # host sync below, and — on a preemption/supervisor unwind
+                # — the save-on-signal all need a caught-up host view.
+                pipe.drain(0)
+                epoch_loss += pipe.epoch_loss
+                num_batches += pipe.num_batches
 
         # Epoch-cadence host sync: reporting objects absorb device state.
         self.sync_host_state()
@@ -801,7 +913,12 @@ class DistributedTrainer:
         # training-state machine flips to UNDER_ATTACK.
         fleet_alert = getattr(metrics, "fleet_alert", None)
         if fleet_alert is not None:
-            streak = getattr(self.state, "fleet_raw_streak", None)
+            if self._drain_ctx is not None:
+                # Lagged drain: the live state is up to K steps ahead of
+                # this record — use the streak packed with the step itself.
+                streak = self._drain_ctx.fleet_streak
+            else:
+                streak = getattr(self.state, "fleet_raw_streak", None)
             streak = int(np.asarray(streak)[0]) if streak is not None else 0
             opened = self._fleet_tracker.update(
                 bool(np.asarray(fleet_alert)), streak, self.global_step,
@@ -867,6 +984,23 @@ class DistributedTrainer:
                     exclude=flagged_ids,
                 )
                 evict_coords.append(int(coord))
+        if self._drain_ctx is not None:
+            # Lagged drain (async pipeline): resharding mid-window would
+            # orphan the in-flight entries' packed metrics (their node
+            # count predates the surgery) — collect the coordinates and
+            # let the pipeline apply them at the frontier after its
+            # mandatory full drain.
+            self._drain_ctx.evict_coords.update(evict_coords)
+        else:
+            self._apply_evictions(evict_coords)
+
+    def _apply_evictions(self, evict_coords: Sequence[int]) -> None:
+        """Elastic reaction to confirmed compromises: evict the flagged
+        mesh coordinates and reshard (or restaff the pipeline).  Split out
+        of ``_record_batch`` so the async drain can defer it to a
+        full-drain point; the synchronous path calls it immediately with
+        identical semantics."""
+        evict_coords = list(evict_coords)
         if (evict_coords and self.config.elastic_resharding
                 and len(evict_coords) < self.config.num_nodes):
             from trustworthy_dl_tpu.elastic.reassignment import (
@@ -908,6 +1042,18 @@ class DistributedTrainer:
                     nodes=[int(n) for n in evict_record["evicted_nodes"]],
                     live_nodes=self.config.num_nodes,
                 )
+
+    def _readmit_due(self) -> bool:
+        """Cheap predicate: would ``_maybe_readmit`` act right now?  The
+        async drain polls this to decide when a readmission (a topology
+        change) forces a mandatory full drain — without paying the import
+        and record machinery on every step."""
+        cfg = self.config
+        if not (cfg.elastic_resharding and cfg.readmit_after_steps > 0
+                and self._evicted_at):
+            return False
+        return any(self.global_step - when >= cfg.readmit_after_steps
+                   for when in self._evicted_at.values())
 
     def _maybe_readmit(self) -> None:
         """Re-admit evicted coordinates whose cool-off has elapsed
@@ -1376,7 +1522,10 @@ class DistributedTrainer:
         self.node_map = [int(i) for i in meta["node_map"]]
         # Any attack plan was shaped for the constructor's node count;
         # injection targets are per-run anyway — reset, caller re-plans.
-        self.attack_plan = null_plan(n)
+        # Placed on the rebuilt mesh here (initialize() would re-place it
+        # too, but the invariant "attack_plan is always mesh-committed"
+        # must not depend on which caller runs next).
+        self.attack_plan = self._place_plan(null_plan(n))
         self.state = None  # template must be rebuilt with the new shapes
 
     def load_checkpoint(self, step: Optional[int] = None) -> TrainState:

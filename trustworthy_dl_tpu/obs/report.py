@@ -10,9 +10,14 @@ Phase semantics (the canonical names in :data:`PHASES`):
 
 * Host-measurable phases — ``data`` (loader + host batch assembly +
   shard placement), ``compute`` (dispatch + device execution of the
-  fused step, synced at the loss read), ``detection`` (host-side
-  verdict processing / incident records), ``host_sync``,
-  ``checkpoint`` — are accounted by :class:`StepTimeReporter` per step.
+  fused step, synced at the loss read; dispatch-only under the async
+  host pipeline), ``detection`` (host-side verdict processing /
+  incident records, synchronous loop), ``host`` (async-pipeline drain:
+  time blocked on the lagged metrics landing + the host bookkeeping —
+  the number the pipeline exists to collapse; compare it across
+  ``async_host_depth`` 0 vs K in ``bench.py``'s ``TDDL_BENCH_ASYNC=1``
+  A/B), ``host_sync``, ``checkpoint`` — are accounted by
+  :class:`StepTimeReporter` per step.
 * Device-internal phases — ``forward``, ``backward``, ``optimizer`` —
   live *inside* the one jitted program and are only separable in the
   XLA trace timeline; ``utils.profiling.phase_annotation`` uses the
@@ -40,7 +45,7 @@ import numpy as np
 
 #: Canonical phase names — host-measured and trace-timeline both.
 PHASES = ("data", "forward", "backward", "optimizer", "detection",
-          "host_sync", "compute", "checkpoint", "other")
+          "host", "host_sync", "compute", "checkpoint", "other")
 
 #: Peak dense bf16 FLOP/s per chip by jax ``device_kind`` (marketing
 #: peaks; MFU denominators, not guarantees).  Matched by substring so

@@ -14,7 +14,10 @@ Artifacts under ``obs_dir``:
 * ``metrics_snapshot.json`` — latest registry snapshot (rewritten at the
   ``metrics_snapshot_every`` step cadence and at finalize)
 * ``metrics.prom`` — Prometheus text exposition of the same registry
-* ``obs_report.json`` — step-time breakdown + MFU (obs/report.py)
+* ``obs_report.json`` — step-time breakdown + MFU (obs/report.py).
+  Under the async host pipeline (``async_host_depth`` > 0) the report's
+  ``host`` phase is the time the loop blocked on lagged metrics + host
+  bookkeeping — the dispatch-gap number the pipeline collapses
 * ``flight_*.json`` — flight-recorder dumps (obs/recorder.py); the
   supervisor writes its incident dumps next to the *checkpoints*
   instead, via ``dump_flight(directory=...)``

@@ -98,6 +98,16 @@ def _logit_signals(logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return entropy, top2[:, 0] - top2[:, 1]
 
 
+def _pack_step_outputs(next_tok: jax.Array, ent: jax.Array,
+                       margin: jax.Array) -> jax.Array:
+    """[3, B] f32 host-facing pack — token ids, entropies, margins in ONE
+    array so the scheduler pays a single device→host pull per step
+    instead of three (and the copy can start asynchronously while the
+    host books the previous tick).  Token ids survive the f32 round-trip
+    exactly: vocab sizes (GPT-2: 50257) sit far below 2**24."""
+    return jnp.stack([next_tok.astype(jnp.float32), ent, margin])
+
+
 def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
                   view: Any, tokens: jax.Array, real_len: jax.Array,
                   slot: jax.Array, key: jax.Array, temp: jax.Array,
@@ -106,7 +116,9 @@ def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
     [P] (local cache, width P), write the K/V into the slot row, and sample
     the first token from the logits at ``real_len - 1`` (the prompt's last
     REAL position — the bucket padding beyond it is causally invisible to
-    it and is overwritten before any decode step can attend to it)."""
+    it and is overwritten before any decode step can attend to it).
+    Host-facing scalars (token, entropy, margin) come back as one packed
+    f32[3, 1] — a single sync per admission, not three."""
     bucket = tokens.shape[0]
     local = gen.init_cache(cfg, 1, bucket)
     logits, local = gen._apply_with_cache(
@@ -118,9 +130,9 @@ def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
     new_v = jax.lax.dynamic_update_slice(
         slot_v, local.v.astype(slot_v.dtype), (0, slot, 0, 0, 0)
     )
-    token = _sample_tokens(logits, key[None], temp[None], greedy[None])[0]
+    token = _sample_tokens(logits, key[None], temp[None], greedy[None])
     ent, margin = _logit_signals(logits)
-    return new_k, new_v, token, ent[0], margin[0]
+    return new_k, new_v, _pack_step_outputs(token, ent, margin)
 
 
 def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
@@ -129,12 +141,13 @@ def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
     """THE fused decode step: one token for every slot, live or not.
     ``lengths`` i32[MAX_SLOTS] are the per-slot write offsets — the vector
     ``start`` path of models/generate._block_with_cache, so serving decode
-    and batch generate share one numerics source."""
+    and batch generate share one numerics source.  Host-facing outputs
+    ride one packed f32[3, MAX_SLOTS] — a single pull per decode tick."""
     cache = gen.KVCache(k=slot_k, v=slot_v, length=lengths)
     logits, cache = gen._apply_with_cache(view, tokens[:, None], cache, cfg)
     next_tok = _sample_tokens(logits, keys, temps, greedy)
     ent, margin = _logit_signals(logits)
-    return next_tok, cache.k, cache.v, ent, margin
+    return _pack_step_outputs(next_tok, ent, margin), cache.k, cache.v
 
 
 _PROGRAMS: Dict[str, Any] = {}
@@ -253,7 +266,7 @@ class ContinuousBatchingScheduler:
             return False
         padded = np.zeros(bucket, np.int32)
         padded[:p] = task.prompt
-        new_k, new_v, token, ent, margin = _programs()["prefill"](
+        new_k, new_v, packed = _programs()["prefill"](
             self.cfg, self.kv.k, self.kv.v, self.view,
             jnp.asarray(padded), jnp.asarray(p, jnp.int32),
             jnp.asarray(slot, jnp.int32),
@@ -263,6 +276,8 @@ class ContinuousBatchingScheduler:
         )
         self.kv = SlotKV(k=new_k, v=new_v)
         task.slot = slot
+        # ONE host sync per admission: token/entropy/margin land together.
+        token, ent, margin = np.asarray(packed)[:, 0]
         task._record(int(token), float(ent), float(margin))
         self.lengths[slot] = p
         self.tasks[slot] = task
@@ -286,19 +301,23 @@ class ContinuousBatchingScheduler:
             keys[slot] = task.keys[len(task.emitted)]
             temps[slot] = max(task.temperature, 1e-6)
             greedy[slot] = task.greedy
-        next_tok, new_k, new_v, ent, margin = _programs()["decode"](
+        packed, new_k, new_v = _programs()["decode"](
             self.cfg, self.kv.k, self.kv.v, self.view,
             jnp.asarray(tokens), jnp.asarray(self.lengths),
             jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
         )
         self.kv = SlotKV(k=new_k, v=new_v)
-        next_tok = np.asarray(next_tok)
-        ent = np.asarray(ent)
-        margin = np.asarray(margin)
-        ticked: List[SlotTask] = []
-        for slot, task in self.tasks.items():
-            # The decode step wrote this slot's token K/V at lengths[slot].
+        # ONE host pull for the whole tick (the cache stays on device);
+        # the per-slot feed below reads the already-landed numpy rows.
+        host = np.asarray(packed)
+        next_tok, ent, margin = host[0], host[1], host[2]
+        live = list(self.tasks.items())
+        # The decode step wrote each live slot's token K/V at
+        # lengths[slot]; batch the offset bump before the record feed.
+        for slot, _ in live:
             self.lengths[slot] += 1
+        ticked: List[SlotTask] = []
+        for slot, task in live:
             task._record(int(next_tok[slot]), float(ent[slot]),
                          float(margin[slot]))
             ticked.append(task)

@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache wiring.
+
+Repeat runs of this framework compile the SAME SPMD programs (the fused
+trusted step, eval step, serve prefill/decode) from scratch every
+process start — minutes of wall time on big models, pure waste for
+sweeps, bench A/Bs and CI.  JAX ships a persistent on-disk cache
+(``jax_compilation_cache_dir``); this module is the one switch the
+config/CLI/bench layers flip, so the thresholds stay consistent
+everywhere (the test suite's conftest has used the same settings since
+round 5 — this generalises it to runs).
+
+Off by default: ``TrainingConfig.compilation_cache_dir=None``.  Enable
+with a path under the run directory (``cli.py --compile-cache``,
+``bench.py`` ``TDDL_BENCH_COMPILE_CACHE=1``) — cache entries are keyed
+by program + compiler fingerprint, so a shared directory is safe but a
+run-local one keeps artifacts self-contained.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_ENABLED_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: str) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (created if missing).  Idempotent; re-pointing at a different
+    directory logs the switch.  Returns the active cache dir."""
+    global _ENABLED_DIR
+    import jax
+
+    cache_dir = os.path.abspath(str(cache_dir))
+    if _ENABLED_DIR == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache everything that takes >= 1 s to compile, however small the
+    # serialized entry — the fused step dominates, but serve's bucketed
+    # prefill programs are many and individually cheap-ish.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if _ENABLED_DIR is not None:
+        logger.info("compilation cache re-pointed: %s -> %s",
+                    _ENABLED_DIR, cache_dir)
+    else:
+        logger.info("persistent compilation cache enabled at %s", cache_dir)
+    _ENABLED_DIR = cache_dir
+    return cache_dir
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory enabled via :func:`enable_persistent_cache`, or
+    None when the cache was never switched on by this module."""
+    return _ENABLED_DIR
