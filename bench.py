@@ -26,7 +26,10 @@ long-context A/B), TDDL_BENCH_GEN=1 (decode), TDDL_BENCH_SERVE=1
 (continuous-batching offered-load sweep), TDDL_BENCH_CHAOS=1 (seeded
 chaos survival sweep through the self-healing supervisor),
 TDDL_BENCH_ASYNC=1 (async host-pipeline A/B: trainer loop at
-async_host_depth 0 vs default, tokens/sec + obs phase shares).
+async_host_depth 0 vs default, tokens/sec + obs phase shares),
+TDDL_BENCH_QUANT=1 (int8 KV quantization A/B: model-dtype vs int8 KV
+pool at EQUAL HBM budget — slots, KV bytes and tokens/s per arm;
+TDDL_BENCH_QUANT_W8=1 adds weight-only int8 to the quantized arm).
 Infra knobs: TDDL_BENCH_PROBE_TIMEOUT (backend liveness probe seconds,
 default 180; a successful probe is cached for the process),
 TDDL_BENCH_COMPILE_CACHE=1 (persistent XLA compilation cache under
@@ -568,6 +571,105 @@ def bench_async() -> "dict | None":
     return arms
 
 
+def bench_quant() -> "dict | None":
+    """int8 quantization A/B (TDDL_BENCH_QUANT=1): serving throughput at
+    an EQUAL HBM BUDGET — the budget is what the baseline (model-dtype)
+    KV pool of TDDL_BENCH_QUANT_SLOTS slots costs; the int8 arm admits
+    ``floor(budget / bytes_per_slot_int8)`` slots (>= 1.5x at GPT-2 head
+    dims: 2*(Dh+4) int8+scale bytes vs 2*2*Dh bf16 bytes per cached
+    position).  Both arms drain the same seeded closed-loop workload;
+    the record reports slots, KV bytes and tokens/s per arm plus the
+    slot and throughput ratios.  TDDL_BENCH_QUANT_W8=1 additionally
+    puts weight-only int8 under the quantized arm (off by default so
+    the A/B isolates the KV tier).
+
+    Env: TDDL_BENCH_QUANT_MODEL (gpt2), TDDL_BENCH_QUANT_SLOTS (8),
+    TDDL_BENCH_QUANT_SEQ (256), TDDL_BENCH_QUANT_REQUESTS (32),
+    TDDL_BENCH_QUANT_NEW (32)."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import (
+        ServeRequest,
+        ServingEngine,
+        kv_bytes_per_slot,
+    )
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_QUANT_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    base_slots = int(os.environ.get("TDDL_BENCH_QUANT_SLOTS", "8"))
+    max_seq = int(os.environ.get("TDDL_BENCH_QUANT_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_QUANT_REQUESTS", "32"))
+    max_new = int(os.environ.get("TDDL_BENCH_QUANT_NEW", "32"))
+    w8 = os.environ.get("TDDL_BENCH_QUANT_W8") == "1"
+
+    import jax.numpy as jnp
+
+    budget = base_slots * kv_bytes_per_slot(cfg, max_seq)
+    int8_slots = budget // kv_bytes_per_slot(cfg, max_seq, jnp.int8)
+    plen_hi = min(64, max_seq - max_new + 1)
+    if plen_hi <= 8:
+        raise ValueError(
+            f"TDDL_BENCH_QUANT_SEQ={max_seq} leaves no room for prompts "
+            f">= 8 tokens at TDDL_BENCH_QUANT_NEW={max_new}"
+        )
+
+    def workload(rng):
+        out = []
+        for _ in range(n_requests):
+            plen = int(rng.integers(8, plen_hi))
+            out.append(ServeRequest(
+                prompt=rng.integers(0, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=int(rng.integers(min(4, max_new),
+                                                max_new + 1)),
+                temperature=0.0,
+            ))
+        return out
+
+    record = {"budget_bytes": int(budget), "arms": {}}
+    arm_defs = (
+        ("base", dict(max_slots=base_slots)),
+        ("int8", dict(max_slots=int(int8_slots), kv_dtype="int8",
+                      weight_dtype="int8" if w8 else "model")),
+    )
+    for label, kw in arm_defs:
+        engine = ServingEngine(params, cfg, max_seq=max_seq,
+                               queue_limit=n_requests,
+                               rng=jax.random.PRNGKey(1), **kw)
+        reqs = workload(np.random.default_rng(0))
+        t0 = time.perf_counter()
+        for req in reqs:
+            engine.submit(req)
+        engine.run_until_idle()
+        elapsed = time.perf_counter() - t0
+        summary = engine.metrics_summary()
+        record["arms"][label] = {
+            "slots": engine.scheduler.allocator.max_slots,
+            "kv_bytes": int(engine.scheduler.kv.pool_bytes),
+            "kv_dtype": engine.kv_dtype,
+            "weight_dtype": engine.weight_dtype,
+            "kv_fallback": engine.kv_fallback_reason,
+            "tokens_per_s": round(summary["tokens_per_s"], 1),
+            "completed": summary["requests_completed"],
+            "wall_s": round(elapsed, 3),
+        }
+        log(f"quant A/B [{label}]: {record['arms'][label]['slots']} "
+            f"slot(s) / {record['arms'][label]['kv_bytes'] / 1e6:.1f} MB "
+            f"KV, {record['arms'][label]['tokens_per_s']:.1f} tok/s "
+            f"({record['arms'][label]['completed']} completed)")
+    base, quant = record["arms"]["base"], record["arms"]["int8"]
+    record["slots_ratio"] = round(quant["slots"] / base["slots"], 3)
+    record["tokens_per_s_ratio"] = round(
+        quant["tokens_per_s"] / max(base["tokens_per_s"], 1e-9), 3)
+    log(f"quant A/B: {record['slots_ratio']}x slots at equal HBM "
+        f"budget ({budget / 1e6:.1f} MB), "
+        f"{record['tokens_per_s_ratio']}x tokens/s")
+    return record
+
+
 def bench_generate() -> None:
     """Optional decode benchmark (TDDL_BENCH_GEN=1): KV-cache generation
     steady-state cost on the full GPT-2.  Diagnostics only — stderr.
@@ -886,6 +988,9 @@ def _inner_main() -> None:
     async_records = None
     if os.environ.get("TDDL_BENCH_ASYNC") == "1":
         async_records = bench_async()
+    quant_records = None
+    if os.environ.get("TDDL_BENCH_QUANT") == "1":
+        quant_records = bench_quant()
 
     record = {
         "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"
@@ -908,6 +1013,8 @@ def _inner_main() -> None:
         record["chaos"] = chaos_records
     if async_records is not None:
         record["async"] = async_records
+    if quant_records is not None:
+        record["quant"] = quant_records
     obs_dir = os.environ.get("TDDL_BENCH_OBS_DIR")
     if obs_dir:
         # Attach the per-run obs report next to whatever artifact set the

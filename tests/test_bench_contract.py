@@ -107,3 +107,34 @@ def test_bench_serve_sweep_records(monkeypatch):
         assert key in row, row
     assert row["completed"] + row["shed"] == 5
     assert row["tokens_per_s"] > 0
+
+
+def test_bench_quant_ab_records(monkeypatch):
+    """bench_quant's equal-HBM A/B on a tiny model: the int8 arm admits
+    >= 1.5x slots inside the baseline pool's byte budget, serves the
+    whole workload, and the record carries the contract keys."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_QUANT_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_QUANT_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_QUANT_REQUESTS", "6")
+    monkeypatch.setenv("TDDL_BENCH_QUANT_NEW", "4")
+    record = bench.bench_quant()
+    assert set(record["arms"]) == {"base", "int8"}
+    base, quant = record["arms"]["base"], record["arms"]["int8"]
+    assert record["slots_ratio"] >= 1.5             # the acceptance bar
+    assert quant["kv_bytes"] <= record["budget_bytes"]  # equal-HBM arm
+    assert quant["kv_fallback"] is None
+    assert base["completed"] == quant["completed"] == 6
+    for row in (base, quant):
+        for key in ("slots", "kv_bytes", "kv_dtype", "weight_dtype",
+                    "tokens_per_s", "wall_s"):
+            assert key in row, row

@@ -21,6 +21,7 @@ _EXPORTS = {
     "AttackConfig": "trustworthy_dl_tpu.core.config",
     "ExperimentConfig": "trustworthy_dl_tpu.core.config",
     "NodeConfig": "trustworthy_dl_tpu.core.config",
+    "ServeConfig": "trustworthy_dl_tpu.core.config",
     "TrainingConfig": "trustworthy_dl_tpu.core.config",
     "load_config": "trustworthy_dl_tpu.core.config",
     "TrustManager": "trustworthy_dl_tpu.trust.manager",

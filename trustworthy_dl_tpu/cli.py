@@ -354,6 +354,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         help="per-request wall-clock deadline")
     parser.add_argument("--no-monitor", action="store_true",
                         help="disable the trust-aware output monitor")
+    parser.add_argument("--kv-dtype", type=str, default="model",
+                        choices=["model", "bfloat16", "float32", "int8"],
+                        help="KV slot-pool storage dtype; int8 stores "
+                             "per-(head, position)-scaled int8 — about "
+                             "half the KV bytes per slot, so ~2x the "
+                             "slots at fixed HBM (parity-gated with "
+                             "automatic fallback to the model dtype; "
+                             "README §Serving/Quantization)")
+    parser.add_argument("--weight-dtype", type=str, default="model",
+                        choices=["model", "int8"],
+                        help="decode-matmul weight tier; int8 halves "
+                             "the weight bytes streamed per decode "
+                             "token (embedding/lm-head stay high "
+                             "precision)")
+    parser.add_argument("--compile-cache", action="store_true",
+                        help="enable JAX's persistent compilation cache "
+                             "under the run dir (<obs-dir or "
+                             "checkpoint-dir>/jax_cache) so repeat "
+                             "serves skip recompiles of the prefill/"
+                             "decode programs (parity with "
+                             "trustworthy-dl-train)")
     parser.add_argument("--obs-dir", type=str, default=None,
                         help="write serving telemetry here: trace.jsonl "
                              "(request lifecycle events correlated by "
@@ -373,7 +394,7 @@ def serve_main(argv: Optional[List[str]] = None,
     import jax
     import numpy as np
 
-    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.core.config import ServeConfig, TrainingConfig
     from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
     from trustworthy_dl_tpu.engine.trainer import DistributedTrainer
     from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
@@ -382,6 +403,22 @@ def serve_main(argv: Optional[List[str]] = None,
     if not args.model.startswith("gpt") or args.model.endswith("-moe"):
         print("serving supports the dense GPT-2 family")
         return 2
+    # Construction-time validation of the serving knobs (loud, before any
+    # model init) — the dtype strings fail here, never at trace time.
+    serve_config = ServeConfig(
+        max_slots=args.max_slots, max_seq=args.max_seq,
+        queue_limit=args.queue_limit,
+        kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+    )
+    if args.compile_cache:
+        import os
+
+        from trustworthy_dl_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        run_dir = args.obs_dir or args.checkpoint_dir
+        enable_persistent_cache(os.path.join(run_dir, "jax_cache"))
     probe = CheckpointManager(args.checkpoint_dir)
     # verified=False: this probe only reads the topology sidecar to
     # refuse pipeline checkpoints — no reason to checksum the whole
@@ -421,14 +458,16 @@ def serve_main(argv: Optional[List[str]] = None,
         from trustworthy_dl_tpu.obs import ObsSession
 
         obs_session = ObsSession(args.obs_dir)
-    engine = ServingEngine(
-        trainer.state.params, cfg,
-        max_slots=args.max_slots, max_seq=args.max_seq,
-        queue_limit=args.queue_limit, enable_monitor=not args.no_monitor,
+    engine = ServingEngine.from_config(
+        trainer.state.params, cfg, serve_config,
+        enable_monitor=not args.no_monitor,
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
         registry=obs_session.registry if obs_session else None,
     )
+    if engine.kv_fallback_reason:
+        print(f"kv_dtype={args.kv_dtype} fell back to the model dtype "
+              f"({engine.kv_fallback_reason})")
     rng = np.random.default_rng(args.seed)
     deadline = args.deadline_ms / 1e3 if args.deadline_ms else None
     submitted = 0

@@ -289,6 +289,41 @@ class ExperimentConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Serving-engine configuration (serve/engine.py).
+
+    The quantization knobs select the KV-pool storage dtype and the
+    decode weight tier (quant/int8.py):
+
+    * ``kv_dtype``: "model" (follow the model compute dtype — the
+      pre-quantization behaviour), "bfloat16", "float32", or "int8"
+      (per-(head, position) scaled int8 — roughly half the KV bytes per
+      slot, so ~2x the slot pool at fixed HBM; parity-gated at engine
+      construction with automatic fallback to "model").
+    * ``weight_dtype``: "model" or "int8" (weight-only int8 for the
+      decode matmuls; embedding/lm-head stay high precision).
+
+    Unknown dtype strings fail HERE, at construction — never at trace
+    time inside a jitted serving program.
+    """
+
+    max_slots: int = 8
+    max_seq: int = 256
+    queue_limit: int = 64
+    kv_dtype: str = "model"
+    weight_dtype: str = "model"
+
+    def __post_init__(self) -> None:
+        from trustworthy_dl_tpu.quant import validate_dtypes
+
+        validate_dtypes(self.kv_dtype, self.weight_dtype)
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if self.max_seq < 1:
+            raise ValueError(f"max_seq must be >= 1, got {self.max_seq}")
+
+
+@dataclass
 class AttackConfig:
     """Adversarial attack configuration (implied module; call sites at
     experiment_runner.py:90-97)."""

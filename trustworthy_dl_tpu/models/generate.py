@@ -46,14 +46,35 @@ class KVCache(NamedTuple):
     # rank is static under jit, so the two spellings trace to different
     # programs but share all the code below.
     length: jax.Array
+    # int8 KV tier (quant/int8.py): when k/v store int8, these hold the
+    # per-(head, position) f32 scales [L, B, H, S]; None selects the
+    # full-precision path.  The presence branch is on pytree STRUCTURE,
+    # resolved at trace time — each engine still compiles exactly one
+    # decode program, and None adds zero leaves to the batch-generate
+    # pytree (its program is bit-identical to the pre-quant one).
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
 
-def init_cache(cfg: gpt2.GPT2Config, batch: int, max_len: int) -> KVCache:
+def init_cache(cfg: gpt2.GPT2Config, batch: int, max_len: int,
+               kv_dtype: Optional[Any] = None) -> KVCache:
+    """``kv_dtype=None`` keeps the model compute dtype; ``jnp.int8``
+    selects the quantized cache (int8 values + f32 per-(head, position)
+    scales, initialised to 0 so untouched rows dequantise to exact
+    zeros, same as the dense zeros of the plain cache)."""
+    kv_dtype = cfg.dtype if kv_dtype is None else kv_dtype
     shape = (cfg.n_layer, batch, cfg.n_head, max_len,
              cfg.n_embd // cfg.n_head)
+    if kv_dtype == jnp.int8:
+        scales = jnp.zeros(shape[:-1], jnp.float32)
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            length=jnp.zeros((), jnp.int32),
+            k_scale=scales, v_scale=scales,
+        )
     return KVCache(
-        k=jnp.zeros(shape, cfg.dtype),
-        v=jnp.zeros(shape, cfg.dtype),
+        k=jnp.zeros(shape, kv_dtype),
+        v=jnp.zeros(shape, kv_dtype),
         length=jnp.zeros((), jnp.int32),
     )
 
@@ -63,46 +84,78 @@ def _split_heads(a: jax.Array, n_head: int) -> jax.Array:
     return a.reshape(b, t, n_head, d // n_head).transpose(0, 2, 1, 3)
 
 
+def _write_cache_rows(layer_kv: jax.Array, new: jax.Array,
+                      start: jax.Array) -> jax.Array:
+    """Write [B, H, T, ...] new rows into the [B, H, S, ...] cache at
+    ``start`` — scalar (all rows aligned) or i32[B] (per-row offsets;
+    the vmap'd dynamic_update_slice lowers to a static-shape scatter)."""
+    new = new.astype(layer_kv.dtype)
+    trail = (0,) * (layer_kv.ndim - 3)
+    if jnp.ndim(start) == 0:
+        return jax.lax.dynamic_update_slice(
+            layer_kv, new, (0, 0, start) + trail
+        )
+    row_update = jax.vmap(
+        lambda cache_row, new_row, off: jax.lax.dynamic_update_slice(
+            cache_row, new_row, (0, off) + trail
+        )
+    )
+    return row_update(layer_kv, new, start)
+
+
 def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
                       layer_v: jax.Array, start: jax.Array,
-                      cfg: gpt2.GPT2Config
-                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                      cfg: gpt2.GPT2Config,
+                      layer_k_scale: Optional[jax.Array] = None,
+                      layer_v_scale: Optional[jax.Array] = None,
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                 Optional[jax.Array], Optional[jax.Array]]:
     """One transformer block over [B, T, D] new positions, attending to
     cached K/V [B, H, S, Dh] plus itself (causal).  ``start`` is the write
     offset — positions [start, start+T) land in the cache.  Scalar
     ``start`` writes every row at the same offset (batch generate);
     ``start`` i32[B] writes each row at its own offset (the serving
-    engine's slotted cache).  Returns (activations, new layer_k, new
-    layer_v)."""
+    engine's slotted cache).
+
+    int8 KV tier: when ``layer_k_scale``/``layer_v_scale`` [B, H, S] are
+    given, the cache stores int8 and new K/V rows are quantized at the
+    write site (symmetric per-(head, position), quant/int8.py).  The
+    reads never materialise a dequantized cache copy: a cached key's
+    scale is constant along the contracted Dh axis, so it multiplies the
+    score AFTER the int8 dot product, and a cached value's scale folds
+    into the attention probabilities before the PV contraction — exact
+    algebra, only the int8 rounding differs from the dense path.
+
+    Returns (activations, layer_k, layer_v, layer_k_scale,
+    layer_v_scale); scales pass through as None on the dense path."""
+    from trustworthy_dl_tpu.quant import int8 as q8
+
     dtype = cfg.dtype
     b, t, d = x.shape
     h = cfg.n_head
     s = layer_k.shape[-2]
+    quantized = layer_k_scale is not None
 
     y = L.layernorm(block["ln_1"], x).astype(dtype)
-    qkv = L.dense(block["attn"]["qkv"], y, dtype)
+    qkv = q8.qdense(block["attn"]["qkv"], y, dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_split_heads(a, h) for a in (q, k, v))  # [B, H, T, Dh]
 
-    if jnp.ndim(start) == 0:
-        layer_k = jax.lax.dynamic_update_slice(
-            layer_k, k.astype(layer_k.dtype), (0, 0, start, 0)
-        )
-        layer_v = jax.lax.dynamic_update_slice(
-            layer_v, v.astype(layer_v.dtype), (0, 0, start, 0)
-        )
+    if quantized:
+        k_q, k_s = q8.quantize_kv(k)                   # int8, f32 [B,H,T]
+        v_q, v_s = q8.quantize_kv(v)
+        layer_k = _write_cache_rows(layer_k, k_q, start)
+        layer_v = _write_cache_rows(layer_v, v_q, start)
+        layer_k_scale = _write_cache_rows(layer_k_scale, k_s, start)
+        layer_v_scale = _write_cache_rows(layer_v_scale, v_s, start)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q,
+                            layer_k.astype(dtype))
+        scores = scores * layer_k_scale[:, :, None, :] / math.sqrt(d // h)
     else:
-        # Per-row write offsets: a batched dynamic_update_slice (one slice
-        # per row) — XLA lowers the vmap to a scatter, still static-shape.
-        row_update = jax.vmap(
-            lambda cache_row, new_row, off: jax.lax.dynamic_update_slice(
-                cache_row, new_row, (0, off, 0)
-            )
-        )
-        layer_k = row_update(layer_k, k.astype(layer_k.dtype), start)
-        layer_v = row_update(layer_v, v.astype(layer_v.dtype), start)
-
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, layer_k) / math.sqrt(d // h)
+        layer_k = _write_cache_rows(layer_k, k, start)
+        layer_v = _write_cache_rows(layer_v, v, start)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, layer_k) \
+            / math.sqrt(d // h)
     # Causal vs cache: query at absolute position start+i may see cache
     # slots [0, start+i].
     if jnp.ndim(start) == 0:
@@ -116,15 +169,19 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
         mask = (k_pos <= q_pos)[:, None]               # [B, 1, T, S]
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, layer_v)
+    if quantized:
+        pv = (probs * layer_v_scale[:, :, None, :]).astype(dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", pv, layer_v.astype(dtype))
+    else:
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, layer_v)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = x + L.dense(block["attn"]["proj"], out, dtype).astype(x.dtype)
+    x = x + q8.qdense(block["attn"]["proj"], out, dtype).astype(x.dtype)
 
     y = L.layernorm(block["ln_2"], x).astype(dtype)
-    y = L.dense(block["mlp"]["fc"], y, dtype)
+    y = q8.qdense(block["mlp"]["fc"], y, dtype)
     y = jax.nn.gelu(y)
-    x = x + L.dense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
-    return x, layer_k, layer_v
+    x = x + q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+    return x, layer_k, layer_v, layer_k_scale, layer_v_scale
 
 
 def _decode_view(params: Params, cfg: gpt2.GPT2Config) -> Params:
@@ -186,15 +243,19 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
 
     def scan_fn(carry, layer):
         x = carry
-        block, lk, lv = layer
-        x, lk, lv = _block_with_cache(block, x, lk, lv, start, cfg)
-        return x, (lk, lv)
+        block, lk, lv, lks, lvs = layer
+        x, lk, lv, lks, lvs = _block_with_cache(block, x, lk, lv, start,
+                                                cfg, lks, lvs)
+        return x, (lk, lv, lks, lvs)
 
     # Rolled layer scan: unrolling was measured SLOWER on v5e decode
     # (1.39 vs 1.24 ms/token b=1) — the rolled body's weight streams
-    # pipeline fine, and the smaller program wins.
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_fn, x, (params["blocks"], cache.k, cache.v)
+    # pipeline fine, and the smaller program wins.  The int8 scale
+    # planes (None on the dense path — zero leaves, same program) ride
+    # the same scan.
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        scan_fn, x,
+        (params["blocks"], cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
     if last_pos is None:
         x_last = x[:, -1:, :]
@@ -208,7 +269,8 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
         logits = (normed.astype(cfg.dtype) @ wte_head.T).astype(
             jnp.float32
         )[:, 0, :]
-    return logits, KVCache(k=new_k, v=new_v, length=start + t)
+    return logits, KVCache(k=new_k, v=new_v, length=start + t,
+                           k_scale=new_ks, v_scale=new_vs)
 
 
 def _exact_topk(logits: jax.Array, k: int, rows: int = 32

@@ -8,9 +8,13 @@ isn't enough.  Current kernels:
   L1/L∞) feeding detect/stats.leafwise_statistics.
 * ``flash_attention`` — blockwise softmax attention, fwd + bwd, O(T·D)
   memory (``attn_impl="flash"`` in the GPT-2 registry).
+* ``fused_dequant_matmul`` — int8-weight dequant matmul tile for the
+  serving engine's weight-only-int8 decode path (quant/): streams int8
+  weight tiles HBM→VMEM, upcasts in-register, scales per output channel.
 """
 
 from trustworthy_dl_tpu.ops.flash_attention import flash_attention
+from trustworthy_dl_tpu.ops.fused_dequant_matmul import dequant_matmul
 from trustworthy_dl_tpu.ops.fused_stats import (
     BLOCK_ROWS,
     LANES,
@@ -21,6 +25,7 @@ from trustworthy_dl_tpu.ops.fused_stats import (
 __all__ = [
     "BLOCK_ROWS",
     "LANES",
+    "dequant_matmul",
     "flash_attention",
     "fused_moments",
     "pallas_enabled",

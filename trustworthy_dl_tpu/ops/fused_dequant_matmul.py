@@ -1,0 +1,107 @@
+"""Pallas TPU kernel: fused weight-dequant matmul for int8 decode.
+
+The weight-only-int8 decode matmul is ``y = (x @ w_q) * scale`` with
+``w_q`` int8 ``[K, N]`` and a per-output-channel f32 ``scale [N]``.
+Left to XLA, the ``w_q.astype(f32)`` convert can materialise a full
+f32 copy of the weight en route to the MXU — which would hand back the
+HBM-bandwidth saving that motivates int8 weights in the first place
+(b<=MAX_SLOTS decode is weight-streaming-bound).  This kernel makes the
+int8 stream explicit: each grid step DMAs one int8 ``[K, TILE_N]``
+weight tile HBM→VMEM (half the bytes of bf16, a quarter of f32),
+upcasts in-register, runs the MXU contraction with f32 accumulation,
+and applies the column scales before the tile leaves VMEM.
+
+Shapes are decode-shaped: ``x [M, K]`` with M = MAX_SLOTS (tiny) rides
+along whole; the grid walks N.  Tiling constraints (f32 sublane 8, lane
+128, int8 sublane 32) gate dispatch — ``dequant_matmul`` falls back to
+the jnp contraction for shapes that do not tile, the same non-tiling
+fallback pattern ``flash_attention`` uses.  The kernel runs anywhere via
+``interpret=True`` (CPU tests); ``pallas_enabled()`` keeps the compiled
+path TPU-only by default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trustworthy_dl_tpu.ops.fused_stats import pallas_enabled
+
+TILE_N = 128
+
+
+def _dq_matmul_kernel(x_ref, wq_ref, scale_ref, out_ref):
+    """One output tile: [M, K] @ int8 [K, TILE_N] * scale [1, TILE_N]."""
+    w = wq_ref[:].astype(jnp.float32)
+    acc = jnp.dot(x_ref[:].astype(jnp.float32), w,
+                  preferred_element_type=jnp.float32)
+    out_ref[:] = acc * scale_ref[0, :][None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dq_matmul_pallas(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                      interpret: bool = False) -> jax.Array:
+    m, k = x.shape
+    n = w_q.shape[1]
+    return pl.pallas_call(
+        _dq_matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=(n // TILE_N,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, TILE_N), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_N), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, TILE_N), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x.astype(jnp.float32), w_q, scale.reshape(1, -1))
+
+
+def dequant_matmul_tiles(m: int, k: int, n: int) -> bool:
+    """Shape gate for the fused tile: N walks in 128-lane tiles and K
+    must satisfy the int8 sublane (32) on the weight tile and the f32
+    lane width on x.  M is NOT gated — ``dequant_matmul`` pads the row
+    dim to the f32 sublane (8), because decode's M is MAX_SLOTS and slot
+    counts are set by HBM budgets, not sublane multiples (the int8
+    sizing itself produces odd counts like 15); gating on M would
+    silently hand the weight-streaming win back on exactly the shapes
+    the tier creates."""
+    return n % TILE_N == 0 and k % 128 == 0 and m > 0
+
+
+def dequant_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    """``[M, K] f* @ int8 [K, N] * f32 [N] -> f32 [M, N]`` with f32
+    accumulation on every path.
+
+    Dispatch mirrors ``fused_stats``: the Pallas tile runs when
+    ``pallas_enabled()`` and the shapes tile (interpret mode off-TPU —
+    tests); anything else takes the jnp contraction, whose numerics the
+    kernel is pinned against in tests/test_quant.py."""
+    m, k = x.shape
+    n = w_q.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if pallas_enabled() and dequant_matmul_tiles(m, k, n):
+        pad = (-m) % 8   # f32 sublane on x/out; M = MAX_SLOTS is tiny
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad, k), x.dtype)], axis=0
+            )
+        out = _dq_matmul_pallas(x, w_q, scale, interpret=interpret)
+        return out[:m] if pad else out
+    acc = jax.lax.dot_general(
+        x.astype(jnp.float32), w_q.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return acc * scale[None, :]

@@ -190,8 +190,16 @@ def build_pipeline_apply(
             current = jnp.where(stage == 0, fresh, state)
             out = apply_local(local_blocks, current)
             # Boundary battery for this tick (zeros batched out when idle).
+            # stop_gradient: the battery is diagnostics, constant under
+            # differentiation by contract (same as ops/fused_moments) —
+            # and keeping it out of the VJP keeps its per-stage scalar
+            # accumulators out of the shard_map residual set, whose spec
+            # check this container's jax (0.4.37) enforces even under
+            # check_rep=False (unreplicated scalar residuals -> a
+            # _SpecError at trace time on dp>1 meshes).
+            out_sg = jax.lax.stop_gradient(out)
             tick_stats = st.tensor_statistics_sampled(
-                out.reshape(-1).astype(jnp.float32), max_sort
+                out_sg.reshape(-1).astype(jnp.float32), max_sort
             )
             tick_stats = jnp.concatenate(
                 [tick_stats,
@@ -199,8 +207,8 @@ def build_pipeline_apply(
                            jnp.float32)]
             )
             stats_sum = stats_sum + jnp.where(active, tick_stats, 0.0)
-            mean_sum = mean_sum + jnp.where(active, jnp.mean(out), 0.0)
-            std_sum = std_sum + jnp.where(active, jnp.std(out), 0.0)
+            mean_sum = mean_sum + jnp.where(active, jnp.mean(out_sg), 0.0)
+            std_sum = std_sum + jnp.where(active, jnp.std(out_sg), 0.0)
             n_active = n_active + active.astype(jnp.float32)
             # Final stage records completed microbatches.
             write = active & (stage == S - 1)

@@ -1,0 +1,52 @@
+"""Quantization tier for the serving engine (beyond-reference).
+
+``int8`` holds the symmetric per-channel primitives plus the two serving
+applications: the int8 KV cache (per-(head, position) scales — halves KV
+bytes per slot, doubling the continuous-batching slot pool at fixed HBM)
+and the weight-only int8 decode view (per-output-channel scales — halves
+the weight bytes streamed per decode token).  The Pallas fused
+dequant-matmul tile lives in ``ops/fused_dequant_matmul.py``; everything
+here is pure jnp and CPU-testable.
+
+Safety: the int8 KV swap is parity-gated (``kv_parity_probe`` — greedy
+tokens must match the full-precision path at engine construction, with
+automatic fallback to the model-dtype pool on failure), and unknown
+dtype knob values fail loudly at config construction
+(``validate_dtypes``).
+"""
+
+from trustworthy_dl_tpu.quant.int8 import (
+    KV_DTYPES,
+    PARITY_MARGIN_TOL,
+    QMAX,
+    WEIGHT_DTYPES,
+    dequantize_int8,
+    is_quantized_dense,
+    kv_parity_probe,
+    qdense,
+    quantize_decode_view,
+    quantize_dense,
+    quantize_int8,
+    quantize_kv,
+    resolve_kv_dtype,
+    validate_dtypes,
+    weight_roundtrip_errors,
+)
+
+__all__ = [
+    "KV_DTYPES",
+    "PARITY_MARGIN_TOL",
+    "QMAX",
+    "WEIGHT_DTYPES",
+    "dequantize_int8",
+    "is_quantized_dense",
+    "kv_parity_probe",
+    "qdense",
+    "quantize_decode_view",
+    "quantize_dense",
+    "quantize_int8",
+    "quantize_kv",
+    "resolve_kv_dtype",
+    "validate_dtypes",
+    "weight_roundtrip_errors",
+]

@@ -17,15 +17,28 @@ answer, shaped for XLA's static-shape world:
   entropy / top-1 margin z-scored against a rolling baseline, with
   anomalous generations quarantining the issuing slot — the inference
   mirror of the training-side trust state machine.
+
+The int8 quantization tier (``quant/``, ``ServeConfig.kv_dtype`` /
+``weight_dtype``) roughly halves KV bytes per slot (per-(head, position)
+scaled int8 K/V — ~2x the slot pool at fixed HBM) and the decode weight
+stream (weight-only int8); the KV swap is parity-gated at engine
+construction with automatic fallback to the model-dtype pool (README
+§Serving/Quantization).
 """
 
+from trustworthy_dl_tpu.core.config import ServeConfig
 from trustworthy_dl_tpu.serve.engine import (
     OutputMonitor,
     ServeRequest,
     ServeResult,
     ServingEngine,
 )
-from trustworthy_dl_tpu.serve.kv_slots import SlotAllocator, SlotKV, init_slots
+from trustworthy_dl_tpu.serve.kv_slots import (
+    SlotAllocator,
+    SlotKV,
+    init_slots,
+    kv_bytes_per_slot,
+)
 from trustworthy_dl_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     choose_bucket,
@@ -35,6 +48,7 @@ from trustworthy_dl_tpu.serve.scheduler import (
 __all__ = [
     "ContinuousBatchingScheduler",
     "OutputMonitor",
+    "ServeConfig",
     "ServeRequest",
     "ServeResult",
     "ServingEngine",
@@ -43,4 +57,5 @@ __all__ = [
     "choose_bucket",
     "default_buckets",
     "init_slots",
+    "kv_bytes_per_slot",
 ]

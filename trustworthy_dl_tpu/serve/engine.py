@@ -39,9 +39,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from trustworthy_dl_tpu.detect import baseline as bl
+from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.obs.events import EventType
 from trustworthy_dl_tpu.obs.registry import get_registry
+from trustworthy_dl_tpu.quant import int8 as q8
+from trustworthy_dl_tpu.serve.kv_slots import kv_bytes_per_slot
 from trustworthy_dl_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     SlotTask,
@@ -142,15 +145,62 @@ class ServingEngine:
                  enable_monitor: bool = True,
                  metrics: Optional[MetricsCollector] = None,
                  chaos: Any = None, trace: Any = None,
-                 registry: Any = None):
+                 registry: Any = None,
+                 kv_dtype: str = "model", weight_dtype: str = "model",
+                 kv_parity_check: bool = True):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
         # replica must lose its slot, not keep serving).
         self.chaos = chaos
         self.cfg = cfg
+        # Quantization tier (quant/int8.py).  Unknown dtype strings fail
+        # HERE; the int8 KV swap is additionally parity-gated: a short
+        # eager greedy-token probe against the full-precision path, with
+        # automatic fallback to the model-dtype pool on failure (the
+        # same always-safe-swap pattern as flash_attention's non-tiling
+        # fallback).  ``kv_parity_check=False`` skips the probe (bench
+        # arms that construct many engines).
+        q8.validate_dtypes(kv_dtype, weight_dtype)
+        self.kv_fallback_reason: Optional[str] = None
+        # The decode view is built at most ONCE here and shared with the
+        # parity probe, the scheduler (its ``view=`` kwarg) and the
+        # weight-error histogram — quantize_decode_view walks every block
+        # matrix, and bench arms construct engines in a loop.
+        base_view = None
+        view = None
+        if weight_dtype == "int8" or (kv_dtype == "int8" and kv_parity_check):
+            base_view = gen._decode_view(params, cfg)
+            view = (q8.quantize_decode_view(params, cfg, view=base_view)
+                    if weight_dtype == "int8" else base_view)
+        if kv_dtype == "int8" and kv_parity_check:
+            if not q8.kv_parity_probe(view, cfg):
+                self.kv_fallback_reason = "kv_parity_probe_failed"
+                kv_dtype = "model"
+                # Keep the HBM budget the int8 sizing planned for: an
+                # operator who filled HBM at int8 bytes/slot must not have
+                # the fallback allocate 2-4x that in the model dtype — on
+                # a budgeted deployment that is an OOM at construction,
+                # the opposite of "always safe".  Shrink the pool to the
+                # slots the int8 byte budget buys at model-dtype cost.
+                int8_bytes = kv_bytes_per_slot(cfg, max_seq, jnp.int8)
+                model_bytes = kv_bytes_per_slot(cfg, max_seq)
+                fallback_slots = max(
+                    1, (max_slots * int8_bytes) // model_bytes
+                )
+                logger.warning(
+                    "int8 KV parity probe failed: falling back to the "
+                    "model-dtype KV pool, shrinking %d -> %d slots to "
+                    "stay inside the int8 pool's HBM budget (safety "
+                    "gate; see README §Serving/Quantization)",
+                    max_slots, fallback_slots,
+                )
+                max_slots = fallback_slots
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         self.scheduler = ContinuousBatchingScheduler(
-            params, cfg, max_slots, max_seq, buckets
+            params, cfg, max_slots, max_seq, buckets,
+            kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
         )
         self.queue_limit = queue_limit
         self.monitor = monitor if monitor is not None else (
@@ -179,6 +229,31 @@ class ServingEngine:
         self._itl_hist = registry.histogram(
             "tddl_serve_itl_seconds", "Inter-token latency"
         )
+        # KV-pool capacity surface: bytes resident (values + scales) and
+        # slot count by storage dtype — the numbers the quantization
+        # A/B moves (int8 ≈ halves bytes/slot → ~2x slots at fixed HBM).
+        kv = self.scheduler.kv
+        kv_dtype_label = str(kv.k.dtype)
+        registry.gauge(
+            "tddl_serve_kv_bytes",
+            "KV slot-pool HBM footprint (values + quant scales)",
+        ).set(float(kv.pool_bytes))
+        registry.gauge(
+            "tddl_serve_slots_total",
+            "KV slots in the pool, by storage dtype", labels=("dtype",),
+        ).set(float(max_slots), dtype=kv_dtype_label)
+        # Quantization-error histogram: per-matrix weight roundtrip
+        # relative errors (weight-only int8) — empty when nothing is
+        # quantized.  Buckets span the int8 regime (~1e-3 rel err).
+        self._quant_err_hist = registry.histogram(
+            "tddl_serve_quant_error",
+            "Relative quantization error (weight roundtrip, per matrix)",
+            buckets=(1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 1.0),
+        )
+        if weight_dtype == "int8":
+            for err in q8.weight_roundtrip_errors(base_view, cfg,
+                                                  qview=view):
+                self._quant_err_hist.observe(err)
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue: Deque[tuple] = deque()   # (task, request)
         self._inflight: Dict[int, tuple] = {}  # request_id -> (task, req, t)
@@ -190,6 +265,23 @@ class ServingEngine:
         self._iteration = 0
         self._tokens_emitted = 0
         self._t_start: Optional[float] = None
+
+    @classmethod
+    def from_config(cls, params: Any, cfg: gpt2.GPT2Config,
+                    serve_config: Any, **kwargs: Any) -> "ServingEngine":
+        """Build an engine from a ``core.config.ServeConfig`` (whose
+        construction already validated the dtype knobs loudly);
+        ``kwargs`` pass through for the non-config surfaces (rng,
+        monitor, trace, registry, ...)."""
+        return cls(
+            params, cfg,
+            max_slots=serve_config.max_slots,
+            max_seq=serve_config.max_seq,
+            queue_limit=serve_config.queue_limit,
+            kv_dtype=serve_config.kv_dtype,
+            weight_dtype=serve_config.weight_dtype,
+            **kwargs,
+        )
 
     # -- submission --------------------------------------------------------
 

@@ -27,7 +27,7 @@ request ever attends to is (re)written before it first becomes visible.
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional, Set
+from typing import Any, List, NamedTuple, Optional, Set
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +36,17 @@ from trustworthy_dl_tpu.models import gpt2
 
 
 class SlotKV(NamedTuple):
-    """Slot-pooled KV arrays; lengths live host-side (scheduler)."""
+    """Slot-pooled KV arrays; lengths live host-side (scheduler).
+
+    int8 tier (quant/int8.py): ``k``/``v`` store int8 and the
+    per-(head, position) f32 scales ride in ``k_scale``/``v_scale``
+    ``[L, MAX_SLOTS, H, MAX_SEQ]``.  None scales = full-precision pool
+    (the pre-quantization layout, byte-for-byte)."""
 
     k: jax.Array  # [L, MAX_SLOTS, H, MAX_SEQ, Dh]
     v: jax.Array  # [L, MAX_SLOTS, H, MAX_SEQ, Dh]
+    k_scale: Optional[jax.Array] = None  # [L, MAX_SLOTS, H, MAX_SEQ]
+    v_scale: Optional[jax.Array] = None
 
     @property
     def max_slots(self) -> int:
@@ -49,16 +56,58 @@ class SlotKV(NamedTuple):
     def max_seq(self) -> int:
         return self.k.shape[3]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
-def init_slots(cfg: gpt2.GPT2Config, max_slots: int, max_seq: int) -> SlotKV:
+    @property
+    def pool_bytes(self) -> int:
+        """Total HBM the pool holds (values + scales) — the number the
+        ``tddl_serve_kv_bytes`` gauge reports."""
+        total = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return total
+
+    @property
+    def bytes_per_slot(self) -> int:
+        return self.pool_bytes // self.max_slots
+
+
+def kv_bytes_per_slot(cfg: gpt2.GPT2Config, max_seq: int,
+                      kv_dtype: Optional[Any] = None) -> int:
+    """Bytes one slot costs under ``kv_dtype`` WITHOUT allocating — the
+    bench A/B sizes its equal-HBM-budget arms with this.  int8 counts
+    1 byte/element plus the 4-byte per-(head, position) scales."""
+    kv_dtype = cfg.dtype if kv_dtype is None else kv_dtype
+    positions = cfg.n_layer * cfg.n_head * max_seq
+    dh = cfg.n_embd // cfg.n_head
+    if kv_dtype == jnp.int8:
+        return 2 * positions * (dh + 4)
+    itemsize = jnp.zeros((), kv_dtype).dtype.itemsize
+    return 2 * positions * dh * itemsize
+
+
+def init_slots(cfg: gpt2.GPT2Config, max_slots: int, max_seq: int,
+               kv_dtype: Optional[Any] = None) -> SlotKV:
+    """``kv_dtype=None`` keeps the model compute dtype; ``jnp.int8``
+    allocates the quantized pool (int8 values + f32 scales, zeros — an
+    untouched row dequantises to exact zeros, same as the dense pool)."""
     if max_seq > cfg.n_positions:
         raise ValueError(
             f"max_seq={max_seq} exceeds the model's position table "
             f"(n_positions={cfg.n_positions})"
         )
+    kv_dtype = cfg.dtype if kv_dtype is None else kv_dtype
     shape = (cfg.n_layer, max_slots, cfg.n_head, max_seq,
              cfg.n_embd // cfg.n_head)
-    return SlotKV(k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype))
+    if kv_dtype == jnp.int8:
+        scales = jnp.zeros(shape[:-1], jnp.float32)
+        return SlotKV(k=jnp.zeros(shape, jnp.int8),
+                      v=jnp.zeros(shape, jnp.int8),
+                      k_scale=scales, v_scale=scales)
+    return SlotKV(k=jnp.zeros(shape, kv_dtype),
+                  v=jnp.zeros(shape, kv_dtype))
 
 
 class SlotAllocator:

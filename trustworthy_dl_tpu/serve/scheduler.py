@@ -40,6 +40,7 @@ import numpy as np
 
 from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.quant import int8 as q8
 from trustworthy_dl_tpu.serve.kv_slots import SlotAllocator, SlotKV, init_slots
 
 logger = logging.getLogger(__name__)
@@ -109,6 +110,7 @@ def _pack_step_outputs(next_tok: jax.Array, ent: jax.Array,
 
 
 def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
+                  slot_k_scale: Any, slot_v_scale: Any,
                   view: Any, tokens: jax.Array, real_len: jax.Array,
                   slot: jax.Array, key: jax.Array, temp: jax.Array,
                   greedy: jax.Array):
@@ -118,36 +120,67 @@ def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
     REAL position — the bucket padding beyond it is causally invisible to
     it and is overwritten before any decode step can attend to it).
     Host-facing scalars (token, entropy, margin) come back as one packed
-    f32[3, 1] — a single sync per admission, not three."""
+    f32[3, 1] — a single sync per admission, not three.
+
+    int8 KV (``slot_*_scale`` not None): the prompt prefills through a
+    FULL-PRECISION local cache (prompt self-attention sees exact K/V, so
+    the first sampled token is bit-identical to the dense engine's), and
+    quantization happens once at the slot write — every scale in
+    [0, bucket) is overwritten, so a reused slot cannot leak a stale
+    scale (pinned by tests/test_quant.py)."""
     bucket = tokens.shape[0]
     local = gen.init_cache(cfg, 1, bucket)
     logits, local = gen._apply_with_cache(
         view, tokens[None, :], local, cfg, last_pos=real_len - 1
     )
-    new_k = jax.lax.dynamic_update_slice(
-        slot_k, local.k.astype(slot_k.dtype), (0, slot, 0, 0, 0)
-    )
-    new_v = jax.lax.dynamic_update_slice(
-        slot_v, local.v.astype(slot_v.dtype), (0, slot, 0, 0, 0)
-    )
+    if slot_k_scale is not None:
+        k_q, k_s = q8.quantize_kv(local.k)      # int8, f32 [L,1,H,bucket]
+        v_q, v_s = q8.quantize_kv(local.v)
+        new_k = jax.lax.dynamic_update_slice(
+            slot_k, k_q, (0, slot, 0, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            slot_v, v_q, (0, slot, 0, 0, 0)
+        )
+        new_ks = jax.lax.dynamic_update_slice(
+            slot_k_scale, k_s, (0, slot, 0, 0)
+        )
+        new_vs = jax.lax.dynamic_update_slice(
+            slot_v_scale, v_s, (0, slot, 0, 0)
+        )
+    else:
+        new_k = jax.lax.dynamic_update_slice(
+            slot_k, local.k.astype(slot_k.dtype), (0, slot, 0, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            slot_v, local.v.astype(slot_v.dtype), (0, slot, 0, 0, 0)
+        )
+        new_ks, new_vs = slot_k_scale, slot_v_scale
     token = _sample_tokens(logits, key[None], temp[None], greedy[None])
     ent, margin = _logit_signals(logits)
-    return new_k, new_v, _pack_step_outputs(token, ent, margin)
+    return new_k, new_v, new_ks, new_vs, _pack_step_outputs(token, ent,
+                                                            margin)
 
 
 def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
+                 slot_k_scale: Any, slot_v_scale: Any,
                  view: Any, tokens: jax.Array, lengths: jax.Array,
                  keys: jax.Array, temps: jax.Array, greedy: jax.Array):
     """THE fused decode step: one token for every slot, live or not.
     ``lengths`` i32[MAX_SLOTS] are the per-slot write offsets — the vector
     ``start`` path of models/generate._block_with_cache, so serving decode
     and batch generate share one numerics source.  Host-facing outputs
-    ride one packed f32[3, MAX_SLOTS] — a single pull per decode tick."""
-    cache = gen.KVCache(k=slot_k, v=slot_v, length=lengths)
+    ride one packed f32[3, MAX_SLOTS] — a single pull per decode tick.
+    int8 KV scales (None on the full-precision pool — the pytree branch
+    is structural, each engine still compiles this exactly once) thread
+    through the same cache."""
+    cache = gen.KVCache(k=slot_k, v=slot_v, length=lengths,
+                        k_scale=slot_k_scale, v_scale=slot_v_scale)
     logits, cache = gen._apply_with_cache(view, tokens[:, None], cache, cfg)
     next_tok = _sample_tokens(logits, keys, temps, greedy)
     ent, margin = _logit_signals(logits)
-    return _pack_step_outputs(next_tok, ent, margin), cache.k, cache.v
+    return (_pack_step_outputs(next_tok, ent, margin), cache.k, cache.v,
+            cache.k_scale, cache.v_scale)
 
 
 _PROGRAMS: Dict[str, Any] = {}
@@ -155,7 +188,10 @@ _PROGRAMS: Dict[str, Any] = {}
 
 def _programs() -> Dict[str, Any]:
     if not _PROGRAMS:
-        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        # Donation covers the KV pool AND its scale planes (args 1-4);
+        # donating a None (full-precision pool has no scales) donates
+        # zero buffers, so one entry serves both tiers.
+        donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" else ()
         _PROGRAMS["prefill"] = jax.jit(
             _prefill_impl, static_argnums=(0,), donate_argnums=donate
         )
@@ -218,13 +254,28 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, params: Any, cfg: gpt2.GPT2Config, max_slots: int,
                  max_seq: int,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 kv_dtype: str = "model", weight_dtype: str = "model",
+                 view: Any = None):
+        q8.validate_dtypes(kv_dtype, weight_dtype)
         self.cfg = cfg
-        # One numerics source with batch generate: the same pre-cast
-        # decode view of the weights (bit-identical by construction — see
-        # models/generate._decode_view).
-        self.view = gen._decode_view(params, cfg)
-        self.kv = init_slots(cfg, max_slots, max_seq)
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
+        if view is not None:
+            # Pre-built decode view (the engine builds it once and shares
+            # it with the parity probe — don't re-cast/re-quantize here).
+            self.view = view
+        elif weight_dtype == "int8":
+            # Weight-only int8 (quant/int8.py): converted ONCE here; the
+            # decode programs stream int8 weight bytes per token.
+            self.view = q8.quantize_decode_view(params, cfg)
+        else:
+            # One numerics source with batch generate: the same pre-cast
+            # decode view of the weights (bit-identical by construction
+            # — see models/generate._decode_view).
+            self.view = gen._decode_view(params, cfg)
+        self.kv = init_slots(cfg, max_slots, max_seq,
+                             kv_dtype=q8.resolve_kv_dtype(kv_dtype, cfg))
         self.allocator = SlotAllocator(max_slots)
         self.buckets = tuple(sorted(buckets or default_buckets(max_seq)))
         if max(self.buckets) > max_seq:
@@ -266,15 +317,16 @@ class ContinuousBatchingScheduler:
             return False
         padded = np.zeros(bucket, np.int32)
         padded[:p] = task.prompt
-        new_k, new_v, packed = _programs()["prefill"](
-            self.cfg, self.kv.k, self.kv.v, self.view,
+        new_k, new_v, new_ks, new_vs, packed = _programs()["prefill"](
+            self.cfg, self.kv.k, self.kv.v,
+            self.kv.k_scale, self.kv.v_scale, self.view,
             jnp.asarray(padded), jnp.asarray(p, jnp.int32),
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(task.keys[0], jnp.uint32),
             jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
             jnp.asarray(task.greedy),
         )
-        self.kv = SlotKV(k=new_k, v=new_v)
+        self.kv = SlotKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         task.slot = slot
         # ONE host sync per admission: token/entropy/margin land together.
         token, ent, margin = np.asarray(packed)[:, 0]
@@ -301,12 +353,13 @@ class ContinuousBatchingScheduler:
             keys[slot] = task.keys[len(task.emitted)]
             temps[slot] = max(task.temperature, 1e-6)
             greedy[slot] = task.greedy
-        packed, new_k, new_v = _programs()["decode"](
-            self.cfg, self.kv.k, self.kv.v, self.view,
+        packed, new_k, new_v, new_ks, new_vs = _programs()["decode"](
+            self.cfg, self.kv.k, self.kv.v,
+            self.kv.k_scale, self.kv.v_scale, self.view,
             jnp.asarray(tokens), jnp.asarray(self.lengths),
             jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
         )
-        self.kv = SlotKV(k=new_k, v=new_v)
+        self.kv = SlotKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         # ONE host pull for the whole tick (the cache stays on device);
         # the per-slot feed below reads the already-landed numpy rows.
         host = np.asarray(packed)
