@@ -38,18 +38,24 @@ def _single_json_line(proc):
     return rec
 
 
-def test_bench_emits_skip_json_when_backend_unavailable():
+def test_bench_emits_skip_json_when_backend_unavailable(tmp_path):
     proc = _run_bench({
         "JAX_PLATFORMS": "bogus",        # unknown backend → init raises
         "PALLAS_AXON_POOL_IPS": "",      # keep the axon hook out of the way
         "TDDL_BENCH_RETRY_SLEEP": "0",   # don't wait out the real backoff
+        # Isolate the probe-success disk cache: a healthy probe persisted
+        # by ANOTHER test (or a real bench round) must not short-circuit
+        # this test's dead-backend path.
+        "TDDL_BENCH_PROBE_CACHE": str(tmp_path / "probe.json"),
     })
     rec = _single_json_line(proc)
     assert rec["skipped"] is True
     assert "backend unavailable" in rec["reason"]
+    # Triage field: no round has ever probed healthy against this cache.
+    assert rec["prior_healthy_probe"] is False
 
 
-def test_bench_serve_leg_keeps_skip_contract():
+def test_bench_serve_leg_keeps_skip_contract(tmp_path):
     """The serve leg rides the same one-line contract: with it enabled and
     the backend dead, bench still emits exactly one skip JSON at rc 0."""
     proc = _run_bench({
@@ -57,12 +63,38 @@ def test_bench_serve_leg_keeps_skip_contract():
         "PALLAS_AXON_POOL_IPS": "",
         "TDDL_BENCH_RETRY_SLEEP": "0",
         "TDDL_BENCH_SERVE": "1",
+        "TDDL_BENCH_PROBE_CACHE": str(tmp_path / "probe.json"),
     })
     rec = _single_json_line(proc)
     assert rec["skipped"] is True
 
 
-def test_bench_watchdog_kills_wedged_body():
+def test_probe_success_cache_round_trips_on_disk(tmp_path, monkeypatch):
+    """The backend-probe success cache persists across PROCESSES: one
+    healthy probe (persisted beside TDDL_BENCH_PROBE_TIMEOUT handling)
+    must stop later rounds from re-probing into 3x180 s timeouts.  Host
+    contract for the read/write pair; a corrupt file degrades to
+    'no prior probe', never an exception."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    cache = tmp_path / "probe.json"
+    monkeypatch.setenv("TDDL_BENCH_PROBE_CACHE", str(cache))
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    assert bench._read_probe_cache() is None        # fresh: no prior probe
+    bench._write_probe_cache(8, "tpu")
+    assert cache.exists()
+    assert bench._read_probe_cache() == (8, "tpu")  # what a later round sees
+    # A probe taken under a different backend selection is stale — a cpu
+    # debug round must not label the next TPU round cpu/1-chip.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench._read_probe_cache() is None
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    cache.write_text("not json{")
+    assert bench._read_probe_cache() is None        # corrupt -> re-probe
+
+
+def test_bench_watchdog_kills_wedged_body(tmp_path):
     """Post-probe wedge regression (bench.py watchdog): a backend that
     answers the liveness probe but hangs inside the measured body must
     still produce the one-line skip JSON at rc 0 — the body runs in a
@@ -75,6 +107,9 @@ def test_bench_watchdog_kills_wedged_body():
         "TDDL_BENCH_RETRY_SLEEP": "0",
         "TDDL_BENCH_FAKE_WEDGE": "1",
         "TDDL_BENCH_WATCHDOG": "3",
+        # Keep this test's HEALTHY probe out of the shared disk cache —
+        # it must not leak into the dead-backend tests' runs.
+        "TDDL_BENCH_PROBE_CACHE": str(tmp_path / "probe.json"),
     }, timeout=300)
     rec = _single_json_line(proc)
     assert rec["skipped"] is True
@@ -107,6 +142,48 @@ def test_bench_serve_sweep_records(monkeypatch):
         assert key in row, row
     assert row["completed"] + row["shed"] == 5
     assert row["tokens_per_s"] > 0
+
+
+def test_bench_paged_ab_records(monkeypatch):
+    """bench_paged's equal-HBM paged-vs-stripe A/B on a tiny model: the
+    paged arm's concurrent-request capacity beats the stripe arm >= 1.5x
+    inside the stripe pool's byte budget (THE acceptance bar), and the
+    shared-prefix leg records a positive radix-cache hit rate."""
+    import pytest
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    pytest.importorskip("jax")
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_PAGED_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_BLOCK", "16")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_REQUESTS", "6")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_NEW", "4")
+    record = bench.bench_paged()
+    assert set(record["arms"]) == {"stripe", "paged"}
+    stripe, paged = record["arms"]["stripe"], record["arms"]["paged"]
+    # Short-request mix at equal HBM: tokens-bounded admission must beat
+    # request-bounded admission on concurrently active requests.
+    assert record["capacity_ratio"] >= 1.5          # the acceptance bar
+    assert paged["kv_bytes"] <= record["budget_bytes"]  # equal-HBM arm
+    assert paged["peak_tokens_in_flight"] >= stripe["peak_tokens_in_flight"]
+    assert stripe["completed"] == paged["completed"] == 6
+    for row in (stripe, paged):
+        for key in ("kv_bytes", "peak_active_requests",
+                    "peak_tokens_in_flight", "tokens_per_s", "wall_s"):
+            assert key in row, row
+    # Shared-prefix leg: the radix cache actually shared.
+    prefix = record["prefix"]
+    assert prefix["hit_rate"] > 0
+    assert prefix["tokens_reused"] > 0
+    assert prefix["completed"] == 6
 
 
 def test_bench_quant_ab_records(monkeypatch):

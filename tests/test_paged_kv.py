@@ -1,0 +1,535 @@
+"""Paged KV pool (trustworthy_dl_tpu/serve/kv_slots.py + the paged
+scheduler/engine path): block-table KV with prefix sharing and chunked
+prefill — occupancy bounded by tokens, not requests.
+
+Fast tier, ``paged`` marker.  Host contracts: block alloc/free/COW
+refcount lifecycle, quarantine-of-a-slot releases only UNSHARED blocks,
+out-of-blocks backpressure (and prefix-cache eviction under admission
+pressure), radix insert/lookup/LRU-eviction, pool-sizing math, and the
+``ServeConfig(paged=False)`` warn-don't-drop contract.  The compile-once
+cell jits the tiny 2-layer GPT-2 (seconds, the test_quant pattern) and
+pins that block-table churn never recompiles the fused decode step.
+
+Slow tier: THE smoke — heterogeneous requests with a shared multi-block
+prefix through the paged ``ServingEngine``, streams bit-identical to the
+legacy stripe engine and to batch ``generate()``, prefix hits > 0."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.core.config import ServeConfig
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models.generate import generate
+from trustworthy_dl_tpu.obs.registry import MetricsRegistry
+from trustworthy_dl_tpu.serve import (
+    BlockAllocator,
+    PagedBatchingScheduler,
+    PrefixCache,
+    ServeRequest,
+    ServingEngine,
+    init_paged_pool,
+    kv_bytes_per_slot,
+    kv_bytes_per_token,
+    paged_pool_blocks,
+)
+from trustworthy_dl_tpu.serve.kv_slots import TRASH_BLOCK
+from trustworthy_dl_tpu.serve.scheduler import SlotTask, request_key_stream
+
+pytestmark = pytest.mark.paged
+
+# vocab_size deliberately differs from tests/test_serve.py's 97 and
+# tests/test_quant.py's 101: the prefill/decode jit caches are
+# process-global (scheduler._PROGRAMS), so an identical config would let
+# another file's run pre-warm the programs this file's strict
+# compile-once pin measures (and vice versa).
+CFG = gpt2.GPT2Config(vocab_size=103, n_positions=64, n_layer=2, n_embd=32,
+                      n_head=4, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _task(rid, prompt, max_new, temperature=0.0):
+    return SlotTask(
+        request_id=rid, prompt=np.asarray(prompt, np.int32),
+        max_new_tokens=max_new, temperature=temperature,
+        keys=request_key_stream(jax.random.PRNGKey(100 + rid), max_new),
+    )
+
+
+# --------------------------------------------------------------------------
+# Fast tier: host-side contracts (no device program runs)
+# --------------------------------------------------------------------------
+
+
+def test_block_allocator_cow_refcount_lifecycle():
+    alloc = BlockAllocator(4)
+    got = alloc.alloc(2)
+    assert len(got) == 2 and alloc.free_count == 2
+    # Physical id 0 is the reserved trash block — never handed out.
+    assert TRASH_BLOCK not in got
+    assert all(alloc.refcount(b) == 1 for b in got)
+    assert alloc.alloc(3) is None          # backpressure, not an error
+    assert alloc.alloc(0) == []
+    # COW sharing: a second holder increfs; releases peel one ref each.
+    a, b = got
+    alloc.incref(a)
+    assert alloc.refcount(a) == 2
+    assert alloc.release(a) == "shared"    # one holder remains
+    assert alloc.release(a) == "freed"
+    assert alloc.release(b) == "freed"
+    assert alloc.free_count == 4 and alloc.in_use == 0
+    with pytest.raises(ValueError):
+        alloc.release(a)                   # double free
+    with pytest.raises(ValueError):
+        alloc.incref(a)                    # incref of unallocated block
+
+
+def test_block_quarantine_spares_shared_blocks():
+    alloc = BlockAllocator(4)
+    shared, private = alloc.alloc(2)
+    alloc.incref(shared)                   # e.g. the prefix cache holds it
+    # Quarantine releases: a still-shared block merely decrefs, only the
+    # block whose LAST holder was the flagged request leaves the pool.
+    assert alloc.release(shared, quarantine=True) == "shared"
+    assert alloc.release(private, quarantine=True) == "quarantined"
+    assert alloc.quarantined == {private}
+    assert alloc.free_count == 2           # private is NOT free
+    assert alloc.alloc(3) is None          # and cannot be re-handed out
+    alloc.unquarantine(private)
+    assert alloc.free_count == 3 and alloc.quarantined == set()
+
+
+def test_scheduler_quarantine_impounds_only_private_blocks(params):
+    """Admission, sharing and quarantine-retirement are pure host work —
+    quarantining a slot impounds the request's PRIVATE blocks while a
+    prefix other holders share stays resident; release_quarantine returns
+    the impounded blocks with the decode row."""
+    sched = PagedBatchingScheduler(params, CFG, max_slots=3, max_seq=16,
+                                   block_size=4, num_blocks=8)
+    prompt = list(range(1, 13))            # 12 tokens = 3 full blocks
+    a = _task(0, prompt, 4)
+    assert sched.admit(a)                  # 16 tokens total -> 4 blocks
+    assert sched.blocks.free_count == 4
+    # Publish A's full prompt blocks (what finishing its prefill does).
+    sched.prefix.insert(prompt, sched.tables[a.slot][:3])
+    b = _task(1, prompt, 4)
+    assert sched.admit(b)                  # shares 2 blocks, allocs 2
+    shared = sched.tables[b.slot][:2]
+    private = sched.tables[b.slot][2:]
+    assert shared == sched.tables[a.slot][:2]
+    assert sched.prefix_hits == 1
+    assert sched.prefix_tokens_reused == 8
+    assert sched.blocks.free_count == 2
+
+    sched.retire(b, quarantine=True)
+    assert b.slot not in sched.tasks
+    # Shared prefix blocks survive (A + the cache still hold them);
+    # only B's private blocks are impounded with the row.
+    assert sched.blocks.quarantined == set(private)
+    assert all(sched.blocks.refcount(blk) >= 2 for blk in shared)
+    assert sched.blocks.free_count == 2    # impounded, not freed
+    assert sched.allocator.capacity == 2
+
+    sched.release_quarantine(b.slot)
+    assert sched.blocks.quarantined == set()
+    assert sched.blocks.free_count == 4
+    assert sched.allocator.capacity == 3
+
+
+def test_out_of_blocks_backpressure_leaks_nothing(params):
+    sched = PagedBatchingScheduler(params, CFG, max_slots=4, max_seq=16,
+                                   block_size=4, num_blocks=6)
+    a = _task(0, list(range(8)), 4)        # 12 tokens -> 3 blocks
+    b = _task(1, list(range(8)), 4)
+    assert sched.admit(a) and sched.admit(b)
+    assert sched.blocks.free_count == 0
+    c = _task(2, list(range(8)), 4)
+    assert not sched.admit(c)              # out of blocks: backpressure
+    assert c.slot == -1                    # task untouched
+    assert sched.allocator.free_count == 2  # claimed row was returned
+    assert sched.blocks.in_use == 6        # nothing leaked either way
+    sched.retire(a)                        # frees 3 blocks
+    assert sched.admit(c)
+    # Oversized requests stay a loud error, not backpressure.
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        sched.admit(_task(3, list(range(14)), 4))
+
+
+def test_prefix_cache_insert_lookup_refcounts():
+    blocks = BlockAllocator(8)
+    ids = blocks.alloc(3)
+    cache = PrefixCache(4, blocks)
+    tokens = list(range(100, 112))         # 12 tokens = 3 full blocks
+    assert cache.insert(tokens, ids) == ids  # cache increfs each -> rc 2
+    assert cache.insert(tokens, ids) == []   # refresh, never duplicate
+    assert len(cache) == 3
+    # Lookup increfs every matched block on behalf of the caller.
+    assert cache.lookup(tokens, 2) == ids[:2]
+    assert blocks.refcount(ids[0]) == 3
+    assert blocks.refcount(ids[2]) == 2    # beyond max_blocks: untouched
+    assert cache.lookup([7, 7, 7, 7, 7], 2) == []
+    # A diverging tail still reuses the matching full-block prefix.
+    assert cache.lookup(tokens[:8] + [999] * 4, 3) == ids[:2]
+
+
+def test_prefix_cache_eviction_lru_skips_live_blocks():
+    blocks = BlockAllocator(8)
+    ids = blocks.alloc(3)
+    cache = PrefixCache(4, blocks)
+    tokens = list(range(100, 112))
+    cache.insert(tokens, ids)
+    hold = cache.lookup(tokens, 2)         # a "live request" shares 2
+    for b in ids:
+        blocks.release(b)                  # the owning request retires
+    # Only the leaf with no live holder (ids[2]) may be evicted; the
+    # shared blocks are pinned by the lookup's refs, the interior nodes
+    # by their cached extensions.
+    assert cache.evict(3) == 1
+    assert blocks.refcount(ids[2]) == 0 and len(cache) == 2
+    for b in hold:
+        blocks.release(b)                  # live holders retire
+    assert cache.evict(8) == 2             # leaf-first unwinds the chain
+    assert len(cache) == 0 and blocks.free_count == 8
+    # LRU order: the least recently touched single-block prefix goes
+    # first.
+    a = blocks.alloc(1)
+    b = blocks.alloc(1)
+    lru = PrefixCache(2, blocks)
+    lru.insert([1, 2], a)
+    lru.insert([3, 4], b)
+    blocks.release(a[0])
+    blocks.release(b[0])                   # cache is the sole holder
+    for blk in lru.lookup([1, 2], 1):      # touch [1, 2] -> [3, 4] is LRU
+        blocks.release(blk)
+    assert lru.evict(1) == 1
+    assert blocks.refcount(b[0]) == 0 and len(lru) == 1
+    assert lru.lookup([1, 2], 1) != []
+
+
+def test_quarantine_purges_published_prefix_blocks(params):
+    """A flagged request's own PUBLISHED prompt blocks leave the prefix
+    cache and are impounded with its row — without the purge their cache
+    reference keeps them 'shared' at quarantine-retire, and a later
+    same-prefix request would decode straight off suspect KV with no
+    prefill."""
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=16,
+                                   block_size=4, num_blocks=8)
+    prompt = list(range(1, 13))            # 3 full blocks
+    a = _task(0, prompt, 4)
+    assert sched.admit(a)                  # 4 blocks total
+    # What _advance_prefill does at prefill completion: publish and
+    # remember the publication.
+    sched._published[a.slot] = sched.prefix.insert(
+        prompt, sched.tables[a.slot][:3])
+    table = list(sched.tables[a.slot])
+    sched.retire(a, quarantine=True)
+    # ALL of A's blocks are impounded — published prompt blocks
+    # included — and its cache entries are gone.
+    assert sched.blocks.quarantined == set(table)
+    assert len(sched.prefix) == 0
+    b = _task(1, prompt, 4)
+    assert sched.admit(b)                  # fresh blocks, full prefill
+    assert sched.prefix_hits == 0          # nothing suspect was reused
+    assert not (set(sched.tables[b.slot]) & set(table))
+
+
+def test_prefix_purge_cascades_to_extension_nodes():
+    """Purging a prefix node also drops the cached extensions hanging
+    off it (unreachable once the parent is gone), releasing the cache's
+    reference on each — no orphaned nodes leaking block refs."""
+    blocks = BlockAllocator(4)
+    base = blocks.alloc(2)                 # published by request X
+    ext = blocks.alloc(1)                  # published by request Y
+    cache = PrefixCache(4, blocks)
+    tokens = list(range(200, 212))
+    assert cache.insert(tokens[:8], base) == base
+    assert cache.insert(tokens, base + ext) == ext  # child of base[1]
+    assert len(cache) == 3
+    assert cache.purge(set(base)) == 3     # both + the cascaded child
+    assert len(cache) == 0
+    assert blocks.refcount(base[0]) == 1   # only X's table ref remains
+    assert blocks.refcount(ext[0]) == 1    # cascade released Y's cache ref
+
+
+def test_admission_evicts_prefix_cache_under_pressure(params):
+    """A full pool with cache-only blocks evicts the prefix cache to
+    admit new work — cached prefixes are a best-effort accelerant, never
+    a capacity reservation."""
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=8,
+                                   block_size=4, num_blocks=2)
+    ids = sched.blocks.alloc(2)
+    sched.prefix.insert(list(range(50, 58)), ids)
+    for b in ids:
+        sched.blocks.release(b)            # cache is the sole holder
+    assert sched.blocks.free_count == 0
+    t = _task(0, [1, 2, 3, 4], 4)          # 8 tokens -> needs 2 blocks
+    assert sched.admit(t)                  # evicted its way in
+    assert len(sched.prefix) == 0
+    assert sched.blocks.in_use == 2
+
+
+def test_pool_sizing_helpers():
+    """kv_bytes_per_token is the budgeting primitive both layouts share;
+    the deprecated per-slot wrapper and the paged block sizing agree with
+    the pools they describe (trash block included — honest HBM math)."""
+    dh = CFG.n_embd // CFG.n_head
+    heads = CFG.n_layer * CFG.n_head
+    assert kv_bytes_per_token(CFG) == 2 * heads * dh * 4        # f32
+    assert kv_bytes_per_token(CFG, jnp.int8) == 2 * heads * (dh + 4)
+    assert kv_bytes_per_slot(CFG, 48) == 48 * kv_bytes_per_token(CFG)
+    # A budget of exactly N blocks' bytes buys N-1 usable (+1 trash).
+    bpt = kv_bytes_per_token(CFG)
+    assert paged_pool_blocks(CFG, 6 * 16 * bpt, 16) == 5
+    pool = init_paged_pool(CFG, 5, 16)
+    assert pool.num_blocks == 5 and pool.block_size == 16
+    assert pool.pool_bytes == 6 * 16 * bpt  # trash block counted
+    assert pool.pool_bytes <= 6 * 16 * bpt  # fits the budget it was
+    # int8 pool pages values AND per-(head, position) scales identically,
+    # so the quant capacity win compounds with paging.
+    q = init_paged_pool(CFG, 5, 16, kv_dtype=jnp.int8)
+    assert q.quantized
+    assert q.pool_bytes == 6 * 16 * kv_bytes_per_token(CFG, jnp.int8)
+    assert q.k_scale.shape == (CFG.n_layer, 6, CFG.n_head, 16)
+
+
+def test_int8_kv_defaults_to_full_prompt_prefill(params):
+    """Under int8 KV the default prefill chunk is the WHOLE prompt: a
+    chunked continuation would attend to the previous chunk's
+    already-quantized blocks, while the stripe int8 engine prefills the
+    whole prompt through a full-precision local cache — parity holds on
+    the one-chunk path.  An explicit chunk opts back into chunking."""
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=32,
+                                   block_size=8, kv_dtype="int8")
+    assert sched.chunk == 32
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=32,
+                                   block_size=8, kv_dtype="int8",
+                                   prefill_chunk=8)
+    assert sched.chunk == 8
+    # Model-dtype pools keep the bounded auto chunk (min(64, max_seq)
+    # rounded to a block multiple — 32 for this tiny geometry).
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=32,
+                                   block_size=8)
+    assert sched.chunk == 32
+
+
+def test_serve_config_paged_false_warns_not_drops():
+    """Satellite contract: paged knobs on a paged=False config must WARN
+    loudly (the legacy stripe pool has no block pool) — silently dropping
+    them would mask an operator error.  Bad paged geometry fails at
+    construction, where the operator typed it."""
+    for kwargs in (dict(block_size=32), dict(num_blocks=12),
+                   dict(prefix_cache=False), dict(prefill_chunk=32)):
+        with pytest.warns(UserWarning, match="ignores paged-pool knob"):
+            ServeConfig(paged=False, **kwargs)
+    # Plain legacy opt-out (no knobs touched) stays silent.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ServeConfig(paged=False)
+        ServeConfig()                      # paged default is warning-free
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        ServeConfig(max_seq=40, block_size=16)
+    with pytest.raises(ValueError, match="num_blocks"):
+        ServeConfig(max_seq=64, block_size=16, num_blocks=2)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServeConfig(max_seq=64, block_size=16, prefill_chunk=24)
+
+
+def test_engine_validates_geometry_and_routes_config(params):
+    """Engines built without a config hit the same loud geometry check,
+    and from_config threads every paged knob through."""
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        ServingEngine(params, CFG, max_seq=40, block_size=16)
+    # The paged pool enforces the model's position-table depth just like
+    # init_slots does for the stripe pool — a too-deep max_seq would
+    # otherwise silently gather clamped position embeddings.
+    with pytest.raises(ValueError, match="position table"):
+        ServingEngine(params, CFG, max_seq=128, block_size=16)
+    cfg = ServeConfig(max_slots=2, max_seq=32, block_size=8,
+                      num_blocks=10, prefix_cache=False, prefill_chunk=16)
+    engine = ServingEngine.from_config(params, CFG, cfg)
+    sched = engine.scheduler
+    assert isinstance(sched, PagedBatchingScheduler)
+    assert sched.block_size == 8 and sched.num_blocks == 10
+    assert sched.prefix is None and sched.chunk == 16
+    # Default pool sizing: max_slots full stripes — paged-by-default is
+    # a strict superset of the stripe pool before any knob is touched.
+    default = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                            block_size=8)
+    assert default.scheduler.num_blocks == 2 * (32 // 8)
+
+
+def test_compile_once_under_block_table_churn(params):
+    """THE pin: block tables are traced VALUES — admissions, retirements,
+    block reuse, prefix hits and chunked prefill across two heterogeneous
+    waves never recompile the fused paged decode step."""
+    registry = MetricsRegistry()
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           block_size=8, prefill_chunk=8, queue_limit=32,
+                           registry=registry)
+    before = engine.scheduler.decode_cache_size()
+    rng = np.random.default_rng(3)
+    shared = rng.integers(0, CFG.vocab_size, 9).tolist()  # > one block
+    waves = 0
+    for wave in range(2):                  # second wave reuses freed blocks
+        for i in range(4):
+            plen = int(rng.integers(3, 13))  # crosses the 8-pos chunk
+            prompt = (shared + [int(i)] if i % 2 == 0
+                      else rng.integers(0, CFG.vocab_size, plen).tolist())
+            rid = engine.submit(ServeRequest(
+                prompt=prompt, max_new_tokens=int(rng.integers(1, 5))))
+            assert rid is not None
+            waves += 1
+    results = engine.run_until_idle()
+    assert len(results) == waves
+    assert all(r.status == "completed" for r in results.values())
+    assert engine.scheduler.decode_cache_size() - before == 1
+    # The shared prompt actually exercised the radix cache, and the
+    # paged gauges ride the registry snapshot (obs satellite).
+    summary = engine.metrics_summary()
+    assert summary["prefix_hits"] >= 1
+    assert summary["prefix_hit_rate"] > 0
+    snap = registry.snapshot()["metrics"]
+    assert "tddl_serve_blocks_in_use" in snap
+    assert "tddl_serve_tokens_in_flight" in snap
+    assert registry.get("tddl_serve_prefix_hits_total").value() == float(
+        summary["prefix_hits"]
+    )
+
+
+def test_quarantined_blocks_starving_pool_sheds_queue(params):
+    """Liveness under block starvation: a flagged request's impounded
+    blocks can starve the pool while decode rows remain free — the
+    engine must shed the unservable queue as no_capacity, not spin to
+    the iteration bound, and release_quarantine must restore service."""
+
+    class FlagAll:
+        def observe(self, entropies, margins):
+            return True, 99.0
+
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           block_size=8, prefill_chunk=8, num_blocks=4,
+                           prefix_cache=False, monitor=FlagAll())
+    rid_a = engine.submit(ServeRequest(prompt=list(range(1, 17)),
+                                       max_new_tokens=16))  # all 4 blocks
+    rid_b = engine.submit(ServeRequest(prompt=[1, 2, 3, 4],
+                                       max_new_tokens=4))   # needs 2
+    results = engine.run_until_idle()
+    assert results[rid_a].flagged
+    assert engine.scheduler.blocks.quarantined != set()
+    # One decode row is still free — the old all-rows-quarantined guard
+    # would not have tripped; the block pool is what starved.
+    assert engine.scheduler.allocator.free_count >= 1
+    assert results[rid_b].status == "no_capacity"
+    engine.monitor = None
+    for slot in list(engine.quarantined_slots):
+        engine.release_quarantine(slot)
+    rid = engine.submit(ServeRequest(prompt=[5, 6, 7], max_new_tokens=2))
+    assert engine.run_until_idle()[rid].status == "completed"
+
+
+def test_mid_prefill_deadline_expiry_releases_blocks(params):
+    """A deadline that passes while a long prompt is mid-chunked-prefill
+    retires the request (empty output) instead of burning the remaining
+    chunk programs; its row and every claimed block come back."""
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           block_size=8, prefill_chunk=8)
+    req = ServeRequest(prompt=list(range(1, 25)), max_new_tokens=4,
+                       deadline_s=30.0)
+    rid = engine.submit(req)
+    engine.step()                      # admit + first chunk only
+    assert rid in engine._inflight and rid not in engine.results
+    req.deadline_s = -1.0              # force expiry mid-prefill
+    engine.step()
+    res = engine.results[rid]
+    assert res.status == "deadline_exceeded"
+    assert res.tokens == [] and res.ttft_s is None
+    assert engine.scheduler.allocator.free_count == 2
+    assert engine.scheduler.blocks.in_use == 0  # nothing was published
+    assert not engine._inflight
+
+
+# --------------------------------------------------------------------------
+# Slow tier: the parity smoke
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_paged_smoke_bit_identical_to_stripe_and_generate(params):
+    """THE acceptance smoke: heterogeneous requests — several sharing a
+    multi-block prompt prefix, prompts longer than the prefill chunk, a
+    temperature-sampled stream — through the paged engine (3 decode rows,
+    chunked prefill interleaved with decode) and the legacy stripe engine.
+    Every request's tokens must be BIT-IDENTICAL across the two engines
+    and to batch generate(); the paged run must actually share (prefix
+    hits > 0) and compile its decode step exactly once."""
+    rng = np.random.default_rng(11)
+    common = rng.integers(0, CFG.vocab_size, 20).tolist()  # 2 full blocks
+    sample_key = jax.random.PRNGKey(42)
+
+    def build_requests():
+        reqs = [ServeRequest(prompt=common + [5], max_new_tokens=2)]
+        for i in range(4):                 # heterogeneous fillers
+            plen = 3 + 4 * i               # 3, 7, 11, 15: spans chunks
+            reqs.append(ServeRequest(
+                prompt=[(7 * i + j) % CFG.vocab_size for j in range(plen)],
+                max_new_tokens=3 + i))
+        # Same-prefix requests queued BEHIND the fillers: they admit
+        # after the first common prompt's prefill published its blocks.
+        reqs.append(ServeRequest(prompt=common + [9, 9], max_new_tokens=4))
+        reqs.append(ServeRequest(prompt=common + [3, 1, 4],
+                                 max_new_tokens=3))
+        reqs.append(ServeRequest(prompt=[2, 71, 8, 28], max_new_tokens=6,
+                                 temperature=0.8, rng=sample_key))
+        return reqs
+
+    outputs = {}
+    engines = {}
+    for label, kwargs in (
+        ("paged", dict(block_size=8, prefill_chunk=16)),
+        ("stripe", dict(paged=False)),
+    ):
+        engine = ServingEngine(params, CFG, max_slots=3, max_seq=48,
+                               queue_limit=32, rng=jax.random.PRNGKey(5),
+                               **kwargs)
+        before = engine.scheduler.decode_cache_size()
+        for req in build_requests():
+            engine.submit(req)
+        results = engine.run_until_idle()
+        assert len(results) == 8
+        assert all(r.status == "completed" for r in results.values())
+        assert engine.scheduler.decode_cache_size() - before == 1
+        outputs[label] = {rid: r.tokens for rid, r in results.items()}
+        engines[label] = engine
+
+    # Bit-identical across the two memory disciplines, request by request.
+    assert outputs["paged"] == outputs["stripe"]
+
+    # And to batch generate() under the same keys.
+    for rid, req in enumerate(build_requests()):
+        ref = generate(params, CFG,
+                       jnp.asarray([list(req.prompt)], jnp.int32),
+                       req.max_new_tokens, temperature=req.temperature,
+                       rng=(req.rng if req.rng is not None
+                            else jax.random.fold_in(jax.random.PRNGKey(5),
+                                                    rid)))
+        ref_tokens = np.asarray(ref)[0, len(req.prompt):].tolist()
+        assert outputs["paged"][rid] == ref_tokens, f"request {rid}"
+
+    # The sharing was real: later common-prefix admissions reused cached
+    # blocks and prefilled only their suffix.
+    summary = engines["paged"].metrics_summary()
+    assert summary["prefix_hits"] >= 2
+    assert summary["prefix_tokens_reused"] >= 2 * 2 * 8
+    assert summary["prefix_hit_rate"] > 0
+    # After the drain only the radix cache still references blocks.
+    sched = engines["paged"].scheduler
+    assert sched.blocks.in_use == len(sched.prefix)
+    assert summary["peak_tokens_in_flight"] > 0

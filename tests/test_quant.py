@@ -225,15 +225,23 @@ def test_parity_failure_falls_back_to_model_dtype(params, monkeypatch):
     fallback."""
     monkeypatch.setattr("trustworthy_dl_tpu.quant.int8.kv_parity_probe",
                         lambda *a, **k: False)
+    # Paged (default) pool: the BLOCK count shrinks to what the int8
+    # byte budget buys at model-dtype cost (6 int8 blocks * 192 B/token
+    # // 512 B/token = 2, clamped to the one-full-sequence floor of 3).
     engine = ServingEngine(params, CFG, max_slots=2, max_seq=48,
                            kv_dtype="int8")
     assert engine.kv_fallback_reason == "kv_parity_probe_failed"
     assert engine.kv_dtype == "model"
     assert not engine.scheduler.kv.quantized
-    # HBM budget kept: the pool shrinks to what the int8 byte budget
-    # buys at model-dtype cost (2 int8 slots -> floor clamps to the
-    # 1-slot minimum here; a pool sized above the floor stays inside
-    # the budget exactly).
+    assert engine.scheduler.kv.num_blocks == 3
+    rid = engine.submit(ServeRequest(prompt=[1, 2, 3], max_new_tokens=2))
+    assert engine.run_until_idle()[rid].status == "completed"
+    # Legacy stripe pool: the SLOT count shrinks (2 int8 slots -> floor
+    # clamps to the 1-slot minimum here; a pool sized above the floor
+    # stays inside the budget exactly).
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=48,
+                           kv_dtype="int8", paged=False)
+    assert engine.kv_fallback_reason == "kv_parity_probe_failed"
     assert engine.scheduler.kv.max_slots == 1
     rid = engine.submit(ServeRequest(prompt=[1, 2, 3], max_new_tokens=2))
     assert engine.run_until_idle()[rid].status == "completed"

@@ -354,6 +354,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         help="per-request wall-clock deadline")
     parser.add_argument("--no-monitor", action="store_true",
                         help="disable the trust-aware output monitor")
+    parser.add_argument("--legacy-stripe", action="store_true",
+                        help="use the legacy per-request stripe KV pool "
+                             "instead of the paged block pool (escape "
+                             "hatch; paged is the default — occupancy "
+                             "bounded by tokens in flight, not request "
+                             "count; README §Serving)")
+    parser.add_argument("--block-size", type=int, default=16,
+                        help="paged-pool token positions per KV block "
+                             "(--max-seq must be a multiple)")
+    parser.add_argument("--num-blocks", type=int, default=None,
+                        help="usable paged-pool blocks; default sizes "
+                             "the pool to --max-slots full stripes")
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="disable the radix prefix cache (requests "
+                             "sharing a prompt prefix otherwise reuse "
+                             "already-filled blocks copy-on-write)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="prompt positions fed per chunked-prefill "
+                             "tick (multiple of --block-size; default "
+                             "auto) — bounds how long one admission can "
+                             "stall the fused decode step")
     parser.add_argument("--kv-dtype", type=str, default="model",
                         choices=["model", "bfloat16", "float32", "int8"],
                         help="KV slot-pool storage dtype; int8 stores "
@@ -409,6 +430,10 @@ def serve_main(argv: Optional[List[str]] = None,
         max_slots=args.max_slots, max_seq=args.max_seq,
         queue_limit=args.queue_limit,
         kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+        paged=not args.legacy_stripe, block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        prefix_cache=not args.no_prefix_cache,
+        prefill_chunk=args.prefill_chunk,
     )
     if args.compile_cache:
         import os
@@ -494,7 +519,9 @@ def serve_main(argv: Optional[List[str]] = None,
     print(f"served {submitted} request(s) on {args.max_slots} slot(s)")
     for key in ("requests_completed", "requests_deadline_exceeded",
                 "requests_flagged", "tokens_emitted", "tokens_per_s",
-                "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms"):
+                "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms",
+                "peak_tokens_in_flight", "blocks_in_use",
+                "prefix_hits", "prefix_hit_rate"):
         if key in summary:
             value = summary[key]
             shown = f"{value:.3f}" if isinstance(value, float) else value

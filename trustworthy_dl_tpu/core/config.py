@@ -11,6 +11,7 @@ and the YAML loader honours the README schema for real.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -303,8 +304,26 @@ class ServeConfig:
     * ``weight_dtype``: "model" or "int8" (weight-only int8 for the
       decode matmuls; embedding/lm-head stay high precision).
 
-    Unknown dtype strings fail HERE, at construction — never at trace
-    time inside a jitted serving program.
+    The paged-pool knobs select the KV memory discipline (the default
+    since the paged-KV PR; README §Serving):
+
+    * ``paged``: block-pooled KV with per-slot block tables — occupancy
+      bounded by tokens in flight, not request count.  ``False`` is the
+      legacy per-request stripe pool escape hatch.
+    * ``block_size``: token positions per block (``max_seq`` must be a
+      multiple).
+    * ``num_blocks``: usable pool blocks; ``None`` sizes the pool to
+      ``max_slots`` full stripes (a strict superset of the stripe pool).
+    * ``prefix_cache``: radix prefix cache — requests sharing a prompt
+      prefix reuse already-filled blocks copy-on-write.
+    * ``prefill_chunk``: positions fed per chunked-prefill tick (a
+      multiple of ``block_size``); ``None`` auto-sizes.
+
+    Unknown dtype strings and bad paged geometry fail HERE, at
+    construction — never at trace time inside a jitted serving program.
+    Paged knobs set on a ``paged=False`` config WARN loudly (the legacy
+    path has no block pool — silent dropping would mask an operator
+    error), but construction proceeds.
     """
 
     max_slots: int = 8
@@ -312,15 +331,43 @@ class ServeConfig:
     queue_limit: int = 64
     kv_dtype: str = "model"
     weight_dtype: str = "model"
+    paged: bool = True
+    block_size: int = 16
+    num_blocks: Optional[int] = None
+    prefix_cache: bool = True
+    prefill_chunk: Optional[int] = None
 
     def __post_init__(self) -> None:
         from trustworthy_dl_tpu.quant import validate_dtypes
+        from trustworthy_dl_tpu.serve.kv_slots import validate_paged_geometry
 
         validate_dtypes(self.kv_dtype, self.weight_dtype)
         if self.max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
         if self.max_seq < 1:
             raise ValueError(f"max_seq must be >= 1, got {self.max_seq}")
+        if self.paged:
+            validate_paged_geometry(self.max_seq, self.block_size,
+                                    self.num_blocks, self.prefill_chunk)
+        else:
+            paged_knobs = ("block_size", "num_blocks", "prefix_cache",
+                           "prefill_chunk")
+            # Compare against the dataclass field defaults themselves —
+            # a hand-written (name, default) table here would be a third
+            # copy of the defaults that could silently drift.
+            ignored = [
+                f.name for f in dataclasses.fields(self)
+                if f.name in paged_knobs
+                and getattr(self, f.name) != f.default
+            ]
+            if ignored:
+                warnings.warn(
+                    f"ServeConfig(paged=False) ignores paged-pool knob(s) "
+                    f"{', '.join(ignored)}: the legacy stripe pool has no "
+                    f"block pool, no prefix cache and no chunked prefill. "
+                    f"Drop paged=False or drop the knob(s).",
+                    UserWarning, stacklevel=2,
+                )
 
 
 @dataclass

@@ -257,20 +257,154 @@ def _apply_with_cache(params: Params, tokens: jax.Array, cache: KVCache,
         scan_fn, x,
         (params["blocks"], cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
+    logits = _final_logits(params, x, cfg, last_pos)
+    return logits, KVCache(k=new_k, v=new_v, length=start + t,
+                           k_scale=new_ks, v_scale=new_vs)
+
+
+def _final_logits(params: Params, x: jax.Array, cfg: gpt2.GPT2Config,
+                  last_pos: Optional[jax.Array]) -> jax.Array:
+    """Project ONE position's activations to logits [B, V] — the shared
+    tail of the dense and paged cache paths.  ``last_pos=None`` keeps the
+    static [-1] slice (batch generate); a traced value selects the real
+    last prompt position under bucket/chunk padding."""
     if last_pos is None:
         x_last = x[:, -1:, :]
     else:
         x_last = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
     wte_head = params.get("wte_head")
     if wte_head is None:
-        logits = gpt2.unembed(params, x_last, cfg)[:, 0, :]  # [B, V]
+        return gpt2.unembed(params, x_last, cfg)[:, 0, :]  # [B, V]
+    normed = L.layernorm(params["ln_f"], x_last)
+    return (normed.astype(cfg.dtype) @ wte_head.T).astype(jnp.float32)[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV read/write path (serve/kv_slots.PagedKV pools).
+#
+# The paged pool stores K/V in fixed-size token blocks [NB, H, BLOCK, Dh]
+# per layer; a slot's logical cache is reassembled by gathering its block
+# table (i32 per-slot physical ids — traced VALUES, so block churn never
+# recompiles).  The attention core is the untouched _block_with_cache:
+# the gathered view is numerically the same [R, H, S, Dh] cache the
+# stripe engine holds resident (valid positions carry identical values;
+# garbage positions are masked to exactly-zero probabilities), so paged
+# decode is bit-identical to stripe decode by construction.  After the
+# core runs, the rows it wrote into the view are extracted and scattered
+# back into the pool at (physical block, offset); positions outside the
+# slot's table land in the reserved trash block 0.
+# ---------------------------------------------------------------------------
+
+
+def _paged_gather(layer_pool: jax.Array, table: jax.Array) -> jax.Array:
+    """[NB, H, BLOCK, Dh] (or scale [NB, H, BLOCK]) pool slice + block
+    table [R, NBPS] -> contiguous per-row view [R, H, NBPS*BLOCK(, Dh)]."""
+    g = layer_pool[table]                       # [R, NBPS, H, BLOCK(, Dh)]
+    if g.ndim == 5:
+        g = g.transpose(0, 2, 1, 3, 4)          # [R, H, NBPS, BLOCK, Dh]
+        return g.reshape(g.shape[0], g.shape[1], -1, g.shape[-1])
+    g = g.transpose(0, 2, 1, 3)                 # [R, H, NBPS, BLOCK]
+    return g.reshape(g.shape[0], g.shape[1], -1)
+
+
+def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
+                 pool_v_l: jax.Array, table: jax.Array, start: jax.Array,
+                 cfg: gpt2.GPT2Config,
+                 pool_ks_l: Optional[jax.Array] = None,
+                 pool_vs_l: Optional[jax.Array] = None,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                            Optional[jax.Array], Optional[jax.Array]]:
+    """One transformer block over [R, T, D] new positions against a PAGED
+    layer pool: gather each row's view through ``table``, run the dense
+    ``_block_with_cache`` core on it (one numerics source for generate,
+    stripe serve and paged serve), then scatter the newly written rows
+    back into the pool.  ``start`` follows the dense contract: scalar
+    (chunked prefill, R=1) or i32[R] (fused decode, T=1)."""
+    r, t, _ = x.shape
+    nbps = table.shape[1]
+    bsz = pool_k_l.shape[2]
+    if t > 1:
+        # A prefill chunk may extend past the logical view (its start is
+        # only block-aligned, not chunk-aligned, after a prefix hit) —
+        # pad the table with trash columns so the in-view write never
+        # clamps onto real positions.  Width is static; the extra
+        # columns are masked (k_pos > q_pos) so numerics are unchanged.
+        pad = jnp.zeros((r, t // bsz + 1), table.dtype)
+        table_read = jnp.concatenate([table, pad], axis=1)
     else:
-        normed = L.layernorm(params["ln_f"], x_last)
-        logits = (normed.astype(cfg.dtype) @ wte_head.T).astype(
-            jnp.float32
-        )[:, 0, :]
-    return logits, KVCache(k=new_k, v=new_v, length=start + t,
-                           k_scale=new_ks, v_scale=new_vs)
+        table_read = table
+    view_k = _paged_gather(pool_k_l, table_read)
+    view_v = _paged_gather(pool_v_l, table_read)
+    view_ks = (_paged_gather(pool_ks_l, table_read)
+               if pool_ks_l is not None else None)
+    view_vs = (_paged_gather(pool_vs_l, table_read)
+               if pool_vs_l is not None else None)
+    x, view_k, view_v, view_ks, view_vs = _block_with_cache(
+        block, x, view_k, view_v, start, cfg, view_ks, view_vs
+    )
+    # Positions this call wrote into the view -> (physical block, offset).
+    if jnp.ndim(start) == 0:
+        pos = jnp.broadcast_to((start + jnp.arange(t))[None, :], (r, t))
+    else:
+        pos = start[:, None] + jnp.arange(t)[None, :]      # [R, T]
+    lb = pos // bsz
+    valid = lb < nbps
+    phys = jnp.take_along_axis(table_read, jnp.minimum(lb, nbps - 1),
+                               axis=1)
+    phys = jnp.where(valid, phys, 0).reshape(-1)           # 0 = trash
+    offs = (pos % bsz).reshape(-1)
+    idx = pos[:, None, :, None]                            # [R, 1, T, 1]
+
+    def rows_of(view):                                     # [R, H, S(,Dh)]
+        if view.ndim == 4:
+            got = jnp.take_along_axis(view, idx, axis=2)   # [R, H, T, Dh]
+            return got.transpose(0, 2, 1, 3).reshape(
+                r * t, got.shape[1], got.shape[-1])
+        got = jnp.take_along_axis(view, idx[..., 0], axis=2)  # [R, H, T]
+        return got.transpose(0, 2, 1).reshape(r * t, got.shape[1])
+
+    pool_k_l = pool_k_l.at[phys, :, offs].set(rows_of(view_k))
+    pool_v_l = pool_v_l.at[phys, :, offs].set(rows_of(view_v))
+    if pool_ks_l is not None:
+        pool_ks_l = pool_ks_l.at[phys, :, offs].set(rows_of(view_ks))
+        pool_vs_l = pool_vs_l.at[phys, :, offs].set(rows_of(view_vs))
+    return x, pool_k_l, pool_v_l, pool_ks_l, pool_vs_l
+
+
+def _apply_with_cache_paged(params: Params, tokens: jax.Array,
+                            pool_k: jax.Array, pool_v: jax.Array,
+                            pool_ks: Optional[jax.Array],
+                            pool_vs: Optional[jax.Array],
+                            table: jax.Array, start: jax.Array,
+                            cfg: gpt2.GPT2Config,
+                            last_pos: Optional[jax.Array] = None,
+                            ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                       Optional[jax.Array],
+                                       Optional[jax.Array]]:
+    """Paged twin of :func:`_apply_with_cache`: run all blocks over
+    ``tokens`` [R, T] against the block pool, gathering each layer's view
+    inside the layer scan (only ONE layer's view is ever live) and
+    scattering its writes back.  Returns (logits [R, V], updated pool
+    arrays) — pool updates are functional, the scheduler threads them."""
+    t = tokens.shape[-1]
+    if jnp.ndim(start) == 0:
+        pos = start + jnp.arange(t)                        # [T]
+    else:
+        pos = start[:, None] + jnp.arange(t)[None, :]      # [R, T]
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(jnp.float32)
+
+    def scan_fn(carry, layer):
+        x = carry
+        block, pk, pv, pks, pvs = layer
+        x, pk, pv, pks, pvs = _paged_block(block, x, pk, pv, table, start,
+                                           cfg, pks, pvs)
+        return x, (pk, pv, pks, pvs)
+
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        scan_fn, x, (params["blocks"], pool_k, pool_v, pool_ks, pool_vs),
+    )
+    return _final_logits(params, x, cfg, last_pos), new_k, new_v, \
+        new_ks, new_vs
 
 
 def _exact_topk(logits: jax.Array, k: int, rows: int = 32

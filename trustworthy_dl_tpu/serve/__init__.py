@@ -6,11 +6,16 @@ concurrent requests with heterogeneous prompt/output lengths, admitted and
 retired mid-flight without recompiles.  This package is the Orca/vLLM-style
 answer, shaped for XLA's static-shape world:
 
-* ``kv_slots``  — slotted KV cache [L, MAX_SLOTS, H, S, Dh] + host-side
-  slot allocator (alloc/free/quarantine); no dynamic shapes anywhere.
-* ``scheduler`` — continuous (iteration-level) batching: bucketed prefill
-  for newly admitted slots, ONE fused decode step for all active slots,
-  mid-flight retirement and slot reuse.
+* ``kv_slots``  — KV memory pools: the PAGED block pool (default —
+  fixed-size token blocks [L, NB+1, H, BLOCK, Dh] + host-side block
+  tables/refcounts + radix prefix cache, vLLM/RadixAttention-style, so
+  occupancy is bounded by tokens in flight, not requests) and the legacy
+  slotted stripe cache [L, MAX_SLOTS, H, S, Dh]; no dynamic shapes
+  anywhere — block tables are traced gather indices.
+* ``scheduler`` — continuous (iteration-level) batching: chunked prefill
+  interleaved with ONE fused decode step for all active slots (paged),
+  or bucketed synchronous prefill (stripe), mid-flight retirement and
+  slot/block reuse.
 * ``engine``    — request lifecycle (queue → prefill → decode → stream),
   deadlines, backpressure, serving metrics (TTFT / ITL / tokens/s / slot
   occupancy), and trust-aware output monitoring: per-request logit
@@ -34,20 +39,31 @@ from trustworthy_dl_tpu.serve.engine import (
     ServingEngine,
 )
 from trustworthy_dl_tpu.serve.kv_slots import (
+    BlockAllocator,
+    PagedKV,
+    PrefixCache,
     SlotAllocator,
     SlotKV,
+    init_paged_pool,
     init_slots,
     kv_bytes_per_slot,
+    kv_bytes_per_token,
+    paged_pool_blocks,
 )
 from trustworthy_dl_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
+    PagedBatchingScheduler,
     choose_bucket,
     default_buckets,
 )
 
 __all__ = [
+    "BlockAllocator",
     "ContinuousBatchingScheduler",
     "OutputMonitor",
+    "PagedBatchingScheduler",
+    "PagedKV",
+    "PrefixCache",
     "ServeConfig",
     "ServeRequest",
     "ServeResult",
@@ -56,6 +72,9 @@ __all__ = [
     "SlotKV",
     "choose_bucket",
     "default_buckets",
+    "init_paged_pool",
     "init_slots",
     "kv_bytes_per_slot",
+    "kv_bytes_per_token",
+    "paged_pool_blocks",
 ]

@@ -44,9 +44,14 @@ from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.obs.events import EventType
 from trustworthy_dl_tpu.obs.registry import get_registry
 from trustworthy_dl_tpu.quant import int8 as q8
-from trustworthy_dl_tpu.serve.kv_slots import kv_bytes_per_slot
+from trustworthy_dl_tpu.serve.kv_slots import (
+    kv_bytes_per_token,
+    resolve_prefill_chunk,
+    validate_paged_geometry,
+)
 from trustworthy_dl_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
+    PagedBatchingScheduler,
     SlotTask,
     request_key_stream,
 )
@@ -147,13 +152,29 @@ class ServingEngine:
                  chaos: Any = None, trace: Any = None,
                  registry: Any = None,
                  kv_dtype: str = "model", weight_dtype: str = "model",
-                 kv_parity_check: bool = True):
+                 kv_parity_check: bool = True,
+                 paged: bool = True, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
         # replica must lose its slot, not keep serving).
         self.chaos = chaos
         self.cfg = cfg
+        # Paged pool geometry fails loudly HERE, before any model work
+        # (kv_slots.validate_paged_geometry — the same check ServeConfig
+        # runs, so engines built without a config stay just as safe).
+        self.paged = paged
+        if paged:
+            validate_paged_geometry(max_seq, block_size, num_blocks,
+                                    prefill_chunk)
+            if num_blocks is None:
+                # Default pool matches the stripe engine's token capacity
+                # exactly (max_slots full stripes), so paged-by-default
+                # is a strict superset before any knob is touched.
+                num_blocks = max_slots * (max_seq // block_size)
         # Quantization tier (quant/int8.py).  Unknown dtype strings fail
         # HERE; the int8 KV swap is additionally parity-gated: a short
         # eager greedy-token probe against the full-precision path, with
@@ -178,30 +199,55 @@ class ServingEngine:
                 self.kv_fallback_reason = "kv_parity_probe_failed"
                 kv_dtype = "model"
                 # Keep the HBM budget the int8 sizing planned for: an
-                # operator who filled HBM at int8 bytes/slot must not have
-                # the fallback allocate 2-4x that in the model dtype — on
-                # a budgeted deployment that is an OOM at construction,
-                # the opposite of "always safe".  Shrink the pool to the
-                # slots the int8 byte budget buys at model-dtype cost.
-                int8_bytes = kv_bytes_per_slot(cfg, max_seq, jnp.int8)
-                model_bytes = kv_bytes_per_slot(cfg, max_seq)
-                fallback_slots = max(
-                    1, (max_slots * int8_bytes) // model_bytes
-                )
-                logger.warning(
-                    "int8 KV parity probe failed: falling back to the "
-                    "model-dtype KV pool, shrinking %d -> %d slots to "
-                    "stay inside the int8 pool's HBM budget (safety "
-                    "gate; see README §Serving/Quantization)",
-                    max_slots, fallback_slots,
-                )
-                max_slots = fallback_slots
+                # operator who filled HBM at int8 bytes/token must not
+                # have the fallback allocate 2-4x that in the model dtype
+                # — on a budgeted deployment that is an OOM at
+                # construction, the opposite of "always safe".  Shrink
+                # the pool (blocks when paged, slots on the stripe path)
+                # to what the int8 byte budget buys at model-dtype cost.
+                int8_bpt = kv_bytes_per_token(cfg, jnp.int8)
+                model_bpt = kv_bytes_per_token(cfg)
+                if paged:
+                    fallback_blocks = max(
+                        max_seq // block_size,
+                        (num_blocks * int8_bpt) // model_bpt,
+                    )
+                    logger.warning(
+                        "int8 KV parity probe failed: falling back to "
+                        "the model-dtype paged pool, shrinking %d -> %d "
+                        "blocks to stay inside the int8 pool's HBM "
+                        "budget (safety gate; see README "
+                        "§Serving/Quantization)",
+                        num_blocks, fallback_blocks,
+                    )
+                    num_blocks = fallback_blocks
+                else:
+                    fallback_slots = max(
+                        1, (max_slots * int8_bpt) // model_bpt
+                    )
+                    logger.warning(
+                        "int8 KV parity probe failed: falling back to "
+                        "the model-dtype KV pool, shrinking %d -> %d "
+                        "slots to stay inside the int8 pool's HBM "
+                        "budget (safety gate; see README "
+                        "§Serving/Quantization)",
+                        max_slots, fallback_slots,
+                    )
+                    max_slots = fallback_slots
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
-        self.scheduler = ContinuousBatchingScheduler(
-            params, cfg, max_slots, max_seq, buckets,
-            kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
-        )
+        if paged:
+            self.scheduler: Any = PagedBatchingScheduler(
+                params, cfg, max_slots, max_seq, buckets,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
+                block_size=block_size, num_blocks=num_blocks,
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+            )
+        else:
+            self.scheduler = ContinuousBatchingScheduler(
+                params, cfg, max_slots, max_seq, buckets,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
+            )
         self.queue_limit = queue_limit
         self.monitor = monitor if monitor is not None else (
             OutputMonitor() if enable_monitor else None
@@ -254,6 +300,27 @@ class ServingEngine:
             for err in q8.weight_roundtrip_errors(base_view, cfg,
                                                   qview=view):
                 self._quant_err_hist.observe(err)
+        # Paged-pool occupancy surface: blocks referenced (requests +
+        # prefix cache), tokens in flight, and prefix-cache reuse.  The
+        # gauges/counter are registered on BOTH pool layouts so every
+        # serve snapshot carries them (stripe reports 0 blocks — it has
+        # no block pool to occupy).
+        self._blocks_gauge = registry.gauge(
+            "tddl_serve_blocks_in_use",
+            "Paged-KV blocks currently referenced (requests + prefix "
+            "cache); 0 on the legacy stripe pool",
+        )
+        self._tif_gauge = registry.gauge(
+            "tddl_serve_tokens_in_flight",
+            "Cached tokens currently backing live sequences",
+        )
+        self._prefix_counter = registry.counter(
+            "tddl_serve_prefix_hits_total",
+            "Admissions that reused cached prefix blocks",
+        )
+        self._prefix_hits_seen = 0
+        self.peak_tokens_in_flight = 0
+        self.peak_active = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue: Deque[tuple] = deque()   # (task, request)
         self._inflight: Dict[int, tuple] = {}  # request_id -> (task, req, t)
@@ -280,6 +347,11 @@ class ServingEngine:
             queue_limit=serve_config.queue_limit,
             kv_dtype=serve_config.kv_dtype,
             weight_dtype=serve_config.weight_dtype,
+            paged=serve_config.paged,
+            block_size=serve_config.block_size,
+            num_blocks=serve_config.num_blocks,
+            prefix_cache=serve_config.prefix_cache,
+            prefill_chunk=serve_config.prefill_chunk,
             **kwargs,
         )
 
@@ -345,9 +417,13 @@ class ServingEngine:
         self._iteration += 1
         self._expire_queued(now)
 
-        # Admit as many queued requests as there are free slots.  Each
-        # admission prefetches the first token (prefill), so TTFT is the
-        # admission latency itself.
+        # Admit as many queued requests as there are free slots.  On the
+        # stripe path each admission prefetches the first token
+        # (synchronous bucketed prefill), so TTFT is the admission
+        # latency itself; the paged path only books host-side state here
+        # (block claim + prefix-cache lookup) and the chunked prefill
+        # runs inside subsequent decode_ticks — the first token lands
+        # when the final chunk completes.
         emitted = 0
         while self._queue and self.scheduler.has_free_slot:
             task, request = self._queue.popleft()
@@ -356,21 +432,21 @@ class ServingEngine:
                 break
             rid = task.request_id
             self._inflight[rid] = (task, request)
-            t_tok = time.perf_counter()
-            self._timing[rid] = [t_tok]
             if self.trace is not None:
                 self.trace.emit(EventType.SERVE_ADMIT, request_id=rid,
                                 slot=int(task.slot))
-            self._stream(request, rid, task.emitted[-1])
-            emitted += 1
-            if task.done:
-                self._finish(task, request, "completed")
+            if task.emitted:
+                self._timing[rid] = [time.perf_counter()]
+                self._stream(request, rid, task.emitted[-1])
+                emitted += 1
+                if task.done:
+                    self._finish(task, request, "completed")
         for task in self.scheduler.decode_tick():
             rid = task.request_id
             if rid not in self._inflight:
                 continue
             _, request = self._inflight[rid]
-            self._timing[rid].append(time.perf_counter())
+            self._timing.setdefault(rid, []).append(time.perf_counter())
             self._stream(request, rid, task.emitted[-1])
             emitted += 1
             deadline = request.deadline_s
@@ -381,16 +457,41 @@ class ServingEngine:
                 self._finish(task, request, "completed")
             elif expired:
                 self._finish(task, request, "deadline_exceeded")
+        # Mid-prefill deadline check (paged chunked prefill): a slot
+        # still feeding prompt chunks emits nothing from decode_tick, so
+        # the loop above never sees it — without this an already-expired
+        # long prompt would keep burning chunk programs (and delaying
+        # every other slot's tick) until its first token.
+        for rid, (task, request) in list(self._inflight.items()):
+            if task.done or task.emitted:
+                continue
+            deadline = request.deadline_s
+            if (deadline is not None
+                    and time.perf_counter() - self._submit_t[rid]
+                    > deadline):
+                self._finish(task, request, "deadline_exceeded")
         self._tokens_emitted += emitted
         if emitted:
             self._tok_counter.inc(emitted)
 
+        tif = self.scheduler.tokens_in_flight
+        self.peak_tokens_in_flight = max(self.peak_tokens_in_flight, tif)
+        self.peak_active = max(self.peak_active,
+                               self.scheduler.active_count)
+        self._tif_gauge.set(float(tif))
+        if self.paged:
+            self._blocks_gauge.set(float(self.scheduler.blocks_in_use))
+            hits = self.scheduler.prefix_hits
+            if hits > self._prefix_hits_seen:
+                self._prefix_counter.inc(hits - self._prefix_hits_seen)
+                self._prefix_hits_seen = hits
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
             "active_slots": self.scheduler.active_count,
             "slot_occupancy": self.scheduler.occupancy,
             "queue_depth": len(self._queue),
             "tokens_emitted": emitted,
+            "tokens_in_flight": tif,
             "slots_in_service": self.scheduler.allocator.capacity,
         })
         self.metrics.tick()
@@ -402,9 +503,19 @@ class ServingEngine:
         bound trips — a liveness backstop, not a normal exit)."""
         it = 0
         while self._queue or self._inflight:
-            if (not self._inflight
-                    and self.scheduler.allocator.capacity == 0):
-                # Every slot quarantined: the queue can never drain.
+            idle_before = not self._inflight
+            qlen = len(self._queue)
+            self.step()
+            it += 1
+            # Starvation check: with nothing in flight before the step,
+            # a step that admitted nothing and shed nothing proves the
+            # queue can never drain — every row quarantined (stripe), or
+            # quarantined BLOCKS starving the paged pool even after
+            # prefix-cache eviction; no retirement can ever free more
+            # capacity.  Shed the queue instead of spinning to the
+            # iteration bound.
+            if (idle_before and not self._inflight
+                    and self._queue and len(self._queue) == qlen):
                 while self._queue:
                     task, _ = self._queue.popleft()
                     self._submit_t.pop(task.request_id, None)
@@ -419,8 +530,6 @@ class ServingEngine:
                                         status="no_capacity", tokens=0,
                                         admitted=False)
                 break
-            self.step()
-            it += 1
             if it >= max_iterations:
                 raise RuntimeError(
                     f"serving loop did not drain in {max_iterations} "
@@ -522,7 +631,9 @@ class ServingEngine:
         return self.scheduler.allocator.quarantined
 
     def release_quarantine(self, slot: int) -> None:
-        self.scheduler.allocator.release(slot)
+        # Routed through the scheduler: the paged pool returns the
+        # blocks impounded with the slot, not just the decode row.
+        self.scheduler.release_quarantine(slot)
 
     def metrics_summary(self) -> Dict[str, Any]:
         """Serving-side rollup: throughput, latency percentiles, trust."""
@@ -551,7 +662,19 @@ class ServingEngine:
             "tokens_per_s":
                 self._tokens_emitted / elapsed if elapsed > 0 else 0.0,
             "iterations": self._iteration,
+            "peak_tokens_in_flight": self.peak_tokens_in_flight,
+            "peak_active_requests": self.peak_active,
         }
+        if self.paged:
+            sched = self.scheduler
+            out["blocks_in_use"] = sched.blocks_in_use
+            out["prefix_lookups"] = sched.prefix_lookups
+            out["prefix_hits"] = sched.prefix_hits
+            out["prefix_tokens_reused"] = sched.prefix_tokens_reused
+            out["prefix_hit_rate"] = (
+                sched.prefix_hits / sched.prefix_lookups
+                if sched.prefix_lookups else 0.0
+            )
         if itls.size:
             out["itl_p50_ms"] = float(np.percentile(itls, 50) * 1e3)
             out["itl_p99_ms"] = float(np.percentile(itls, 99) * 1e3)

@@ -1,33 +1,59 @@
-"""Slotted KV cache — the serving engine's static-shape memory pool.
+"""KV cache memory pools for the serving engine — slotted (legacy) and
+paged (default).
 
-vLLM pages the KV cache at block granularity (PagedAttention, Kwon et al.,
-SOSP '23) because CUDA kernels can chase block tables.  Under XLA the
-equivalent that keeps the decode step a single never-recompiled program is
-coarser: one cache SLOT per in-flight sequence,
+The original layout (PR 1) is the slotted stripe pool: one cache SLOT per
+in-flight sequence,
 
     k, v: [L, MAX_SLOTS, H, MAX_SEQ, Dh]
 
-with per-slot valid lengths.  The decode step is then exactly the batch
-generate decode (models/generate._block_with_cache) with a *vector* of
-per-row write offsets — same numerics source, same static shapes, so it
-jits once for the engine's lifetime.
+with per-slot valid lengths.  A short request strands almost its whole
+MAX_SEQ stripe, so concurrency is capped by *request count* rather than
+by tokens in flight.
+
+The paged pool (this PR) is the vLLM answer (PagedAttention, Kwon et al.,
+SOSP '23) shaped for XLA's static-shape world: fixed-size token BLOCKS in
+a global pool,
+
+    k, v: [L, NUM_BLOCKS + 1, H, BLOCK, Dh]      (physical block 0 = trash)
+
+plus per-slot block tables (host-side lists of physical block ids).  The
+decode step gathers each slot's logical view through its block table —
+the tables are plain i32 *values*, structurally stable, so block churn
+never recompiles the fused decode program — and occupancy is bounded by
+tokens (rounded up to blocks), not by requests.  Physical block 0 is a
+reserved trash row: inactive decode rows and padded prefill tails scatter
+their garbage writes there, so a freed-and-reused block can never be
+corrupted by a stale slot's static-shape write.
+
+On top of the pool, the radix ``PrefixCache`` keeps *full* prompt blocks
+resident after retirement with reference-counted sharing (RadixAttention,
+Zheng et al. 2024): requests whose prompt shares a cached full-block
+prefix reuse those blocks and prefill only the unshared suffix.  Writes
+only ever target exclusively-owned blocks (a request's suffix and
+generated tokens land in privately allocated blocks by construction), so
+the copy-on-write discipline never actually needs a copy.
 
 THE STATIC-SHAPE INVARIANT: nothing in the device programs depends on how
 many requests are live.  Admission/retirement only change the host-side
-``lengths``/active arrays fed in as (traced) *values*; slot allocation and
-free-list bookkeeping are pure host work (SlotAllocator below).
+``lengths``/table arrays fed in as (traced) *values*; slot, block and
+refcount bookkeeping are pure host work (SlotAllocator / BlockAllocator
+below).
 
-Slot hygiene: a freed slot's cache rows are NOT scrubbed — the decode step
-keeps writing garbage K/V at the freed slot's stale position (static shapes
-mean inactive rows still compute).  That is safe by construction: a slot is
-only re-used after prefill overwrites positions [0, prompt_len), the decode
-mask admits k_pos <= current position only, and every position a new
-request ever attends to is (re)written before it first becomes visible.
+Slot hygiene (stripe pool): a freed slot's cache rows are NOT scrubbed —
+the decode step keeps writing garbage K/V at the freed slot's stale
+position (static shapes mean inactive rows still compute).  That is safe
+by construction: a slot is only re-used after prefill overwrites
+positions [0, prompt_len), the decode mask admits k_pos <= current
+position only, and every position a new request ever attends to is
+(re)written before it first becomes visible.  The paged pool gets the
+same property from the trash block instead (stale tables are never handed
+to the device; inactive rows are pointed at block 0).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Optional, Set
+import heapq
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,18 +100,79 @@ class SlotKV(NamedTuple):
         return self.pool_bytes // self.max_slots
 
 
-def kv_bytes_per_slot(cfg: gpt2.GPT2Config, max_seq: int,
-                      kv_dtype: Optional[Any] = None) -> int:
-    """Bytes one slot costs under ``kv_dtype`` WITHOUT allocating — the
-    bench A/B sizes its equal-HBM-budget arms with this.  int8 counts
-    1 byte/element plus the 4-byte per-(head, position) scales."""
+def kv_bytes_per_token(cfg: gpt2.GPT2Config,
+                       kv_dtype: Optional[Any] = None) -> int:
+    """Bytes ONE cached token position costs under ``kv_dtype`` WITHOUT
+    allocating — the HBM-budget primitive both pool layouts share (a
+    stripe slot costs ``max_seq`` of these, a paged block ``block_size``).
+    int8 counts 1 byte/element plus the 4-byte per-(head, position)
+    scale, K and V each."""
     kv_dtype = cfg.dtype if kv_dtype is None else kv_dtype
-    positions = cfg.n_layer * cfg.n_head * max_seq
+    heads = cfg.n_layer * cfg.n_head
     dh = cfg.n_embd // cfg.n_head
     if kv_dtype == jnp.int8:
-        return 2 * positions * (dh + 4)
+        return 2 * heads * (dh + 4)
     itemsize = jnp.zeros((), kv_dtype).dtype.itemsize
-    return 2 * positions * dh * itemsize
+    return 2 * heads * dh * itemsize
+
+
+def kv_bytes_per_slot(cfg: gpt2.GPT2Config, max_seq: int,
+                      kv_dtype: Optional[Any] = None) -> int:
+    """Deprecated thin wrapper: ``max_seq * kv_bytes_per_token(...)``.
+
+    Kept for the stripe pool's callers; new HBM budgeting should compute
+    from :func:`kv_bytes_per_token` (and :func:`paged_pool_blocks` for
+    block-count sizing) so the math works for both layouts."""
+    return max_seq * kv_bytes_per_token(cfg, kv_dtype)
+
+
+def paged_pool_blocks(cfg: gpt2.GPT2Config, hbm_bytes: int, block_size: int,
+                      kv_dtype: Optional[Any] = None) -> int:
+    """Largest USABLE block count whose paged pool (including the +1
+    trash block the layout always carries) fits in ``hbm_bytes`` — the
+    pool-sizing helper the bench's equal-HBM paged arm uses."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    per_block = block_size * kv_bytes_per_token(cfg, kv_dtype)
+    return max(int(hbm_bytes // per_block) - 1, 0)
+
+
+def validate_paged_geometry(max_seq: int, block_size: int,
+                            num_blocks: Optional[int],
+                            prefill_chunk: Optional[int]) -> None:
+    """Loud construction-time validation of the paged-pool knobs —
+    shared by ``core.config.ServeConfig`` and the paged scheduler so a
+    bad geometry fails where the operator typed it."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if max_seq % block_size != 0:
+        raise ValueError(
+            f"max_seq={max_seq} must be a multiple of block_size="
+            f"{block_size} (the paged pool addresses whole blocks)"
+        )
+    if num_blocks is not None and num_blocks < max_seq // block_size:
+        raise ValueError(
+            f"num_blocks={num_blocks} cannot hold even one full "
+            f"sequence (max_seq={max_seq} needs "
+            f"{max_seq // block_size} blocks of {block_size})"
+        )
+    if prefill_chunk is not None:
+        if (prefill_chunk % block_size != 0
+                or not block_size <= prefill_chunk <= max_seq):
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be a multiple of "
+                f"block_size={block_size} in [{block_size}, {max_seq}]"
+            )
+
+
+def resolve_prefill_chunk(max_seq: int, block_size: int,
+                          prefill_chunk: Optional[int]) -> int:
+    """``None`` -> the auto chunk: 64 positions (rounded down to a block
+    multiple), clamped to ``max_seq``.  Explicit values were already
+    validated by :func:`validate_paged_geometry`."""
+    if prefill_chunk is not None:
+        return prefill_chunk
+    return max(block_size, (min(64, max_seq) // block_size) * block_size)
 
 
 def init_slots(cfg: gpt2.GPT2Config, max_slots: int, max_seq: int,
@@ -163,3 +250,305 @@ class SlotAllocator:
     def capacity(self) -> int:
         """Slots currently in service (total minus quarantined)."""
         return self.max_slots - len(self._quarantined)
+
+
+# ---------------------------------------------------------------------------
+# Paged pool (the default serve data path since the paged-KV PR)
+# ---------------------------------------------------------------------------
+
+#: Physical block index reserved as the write sink for garbage: inactive
+#: decode rows and padded prefill tails scatter here, never into a block
+#: another request could own.  The allocator never hands it out.
+TRASH_BLOCK = 0
+
+
+class PagedKV(NamedTuple):
+    """Block-pooled KV arrays; block tables and refcounts live host-side.
+
+    Layout ``[L, NUM_BLOCKS + 1, H, BLOCK, Dh]`` — the +1 is the reserved
+    trash block (index 0).  int8 tier: ``k``/``v`` store int8 and the
+    per-(head, position) f32 scales ride in ``k_scale``/``v_scale``
+    ``[L, NUM_BLOCKS + 1, H, BLOCK]`` — the pool pages values and scales
+    identically, so the equal-HBM ~1.9x capacity win of the int8 tier
+    compounds with paging."""
+
+    k: jax.Array  # [L, NUM_BLOCKS + 1, H, BLOCK, Dh]
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None  # [L, NUM_BLOCKS + 1, H, BLOCK]
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def num_blocks(self) -> int:
+        """USABLE blocks (the trash block is excluded)."""
+        return self.k.shape[1] - 1
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total HBM the pool holds (values + scales, INCLUDING the trash
+        block) — the honest number ``tddl_serve_kv_bytes`` reports."""
+        total = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return total
+
+    @property
+    def bytes_per_block(self) -> int:
+        return self.pool_bytes // (self.num_blocks + 1)
+
+
+def init_paged_pool(cfg: gpt2.GPT2Config, num_blocks: int, block_size: int,
+                    kv_dtype: Optional[Any] = None) -> PagedKV:
+    """Allocate ``num_blocks`` usable blocks (+1 trash).  ``kv_dtype``
+    semantics match :func:`init_slots`: None follows the model compute
+    dtype, ``jnp.int8`` allocates the quantized pool (int8 values + f32
+    per-(head, position) scales, zeros — an untouched block dequantises
+    to exact zeros)."""
+    if num_blocks < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    if block_size > cfg.n_positions:
+        raise ValueError(
+            f"block_size={block_size} exceeds the model's position table "
+            f"(n_positions={cfg.n_positions})"
+        )
+    kv_dtype = cfg.dtype if kv_dtype is None else kv_dtype
+    shape = (cfg.n_layer, num_blocks + 1, cfg.n_head, block_size,
+             cfg.n_embd // cfg.n_head)
+    if kv_dtype == jnp.int8:
+        scales = jnp.zeros(shape[:-1], jnp.float32)
+        return PagedKV(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=scales, v_scale=scales)
+    return PagedKV(k=jnp.zeros(shape, kv_dtype),
+                   v=jnp.zeros(shape, kv_dtype))
+
+
+class BlockAllocator:
+    """Host-side block lifecycle: free list + reference counts +
+    quarantine set.
+
+    Refcounts carry the prefix-sharing discipline: a block referenced by
+    N requests (and/or the prefix cache) frees only when the LAST holder
+    releases it.  ``release(quarantine=True)`` is the trust hook — a
+    block whose last holder was a flagged request leaves the pool
+    instead of returning to the free list, while blocks still shared
+    with clean holders merely decref (quarantining a slot releases only
+    its unshared blocks)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        # LIFO free list over physical ids [1, num_blocks]; id 0 is the
+        # reserved trash block and is never handed out.
+        self._free: List[int] = list(range(num_blocks, 0, -1))
+        self._ref: Dict[int, int] = {}
+        self._quarantined: Set[int] = set()
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` blocks at refcount 1, or None when the pool cannot
+        satisfy the request (backpressure, not an error)."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} blocks")
+        if len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, block: int) -> None:
+        if block not in self._ref:
+            raise ValueError(f"incref of unallocated block {block}")
+        self._ref[block] += 1
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def release(self, block: int, quarantine: bool = False) -> str:
+        """Drop one reference.  Returns what happened: ``"shared"``
+        (other holders remain), ``"freed"``, or ``"quarantined"`` (hit
+        refcount 0 under a trust flag — the block leaves the pool until
+        :meth:`unquarantine`)."""
+        if self._ref.get(block, 0) <= 0:
+            raise ValueError(f"double free / bad block {block}")
+        self._ref[block] -= 1
+        if self._ref[block] > 0:
+            return "shared"
+        del self._ref[block]
+        if quarantine:
+            self._quarantined.add(block)
+            return "quarantined"
+        self._free.append(block)
+        return "freed"
+
+    def unquarantine(self, block: int) -> None:
+        """Operator action: return a quarantined block to the free pool."""
+        if block in self._quarantined:
+            self._quarantined.discard(block)
+            self._free.append(block)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        """Blocks currently referenced (requests and/or prefix cache)."""
+        return len(self._ref)
+
+    @property
+    def quarantined(self) -> Set[int]:
+        return set(self._quarantined)
+
+
+class PrefixCache:
+    """Host-side radix cache over FULL prompt blocks (RadixAttention-lite).
+
+    Nodes form a block-granular radix tree — each keyed by (parent, its
+    one-block token segment) and holding one physical block id on which
+    the cache itself keeps a reference — so a retired request's prompt
+    blocks stay resident and a later request with the same prefix reuses
+    them without prefill.
+    Lookups incref every matched block on behalf of the caller (atomic
+    with the match, so a concurrent eviction can never free a block the
+    caller is about to table).  Eviction is LRU over LEAF nodes whose
+    block has no other holder — an interior node is pinned by its cached
+    extensions, a shared block by its live requests."""
+
+    def __init__(self, block_size: int, blocks: BlockAllocator):
+        self.block_size = block_size
+        self._blocks = blocks
+        # True radix layout: a node is keyed by (parent node id, the ONE
+        # block_size-token segment extending it), so memory and hashing
+        # stay LINEAR in cached tokens — keying by cumulative prefix
+        # tuples would make a p-token prompt cost O(p^2/block) ints.
+        # Record: [physical block id, last-used tick, node id,
+        # cached-extension count].  Node id 0 is the implicit root.
+        self._nodes: Dict[Tuple[int, Tuple[int, ...]], List[Any]] = {}
+        self._by_id: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        self._next_id = 1
+        self._clock = 0
+
+    def _bump(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _segment(self, tokens: Sequence[int], i: int) -> Tuple[int, ...]:
+        return tuple(tokens[i * self.block_size:(i + 1) * self.block_size])
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def lookup(self, tokens: Sequence[int], max_blocks: int) -> List[int]:
+        """Longest cached full-block prefix of ``tokens`` (at most
+        ``max_blocks`` blocks), each matched block increffed for the
+        caller.  Callers cap ``max_blocks`` at ``(len(prompt)-1) //
+        block_size`` so at least one prompt token always prefills (the
+        first sampled token needs fresh logits)."""
+        out: List[int] = []
+        parent = 0
+        for i in range(max_blocks):
+            node = self._nodes.get((parent, self._segment(tokens, i)))
+            if node is None:
+                break
+            node[1] = self._bump()
+            out.append(node[0])
+            parent = node[2]
+        for b in out:
+            self._blocks.incref(b)
+        return out
+
+    def insert(self, tokens: Sequence[int],
+               block_ids: Sequence[int]) -> List[int]:
+        """Register ``tokens``' full blocks (backed by ``block_ids``, the
+        owning request's table) — the cache increfs each newly cached
+        block.  A prefix already cached (possibly under a different
+        physical block holding identical content) is refreshed, not
+        duplicated.  Returns the NEWLY cached block ids (the caller's
+        publication record — what a later quarantine must purge)."""
+        n = min(len(tokens) // self.block_size, len(block_ids))
+        added: List[int] = []
+        parent = 0
+        for i in range(n):
+            key = (parent, self._segment(tokens, i))
+            node = self._nodes.get(key)
+            if node is not None:
+                node[1] = self._bump()
+                parent = node[2]
+                continue
+            nid = self._next_id
+            self._next_id += 1
+            self._nodes[key] = [block_ids[i], self._bump(), nid, 0]
+            self._by_id[nid] = key
+            self._blocks.incref(block_ids[i])
+            if parent:
+                self._nodes[self._by_id[parent]][3] += 1
+            added.append(block_ids[i])
+            parent = nid
+        return added
+
+    def _remove(self, key: Tuple[int, Tuple[int, ...]]) -> List[int]:
+        """Drop one node; returns [block id, node id]."""
+        block, _, nid, _ = self._nodes.pop(key)
+        del self._by_id[nid]
+        if key[0] and key[0] in self._by_id:
+            self._nodes[self._by_id[key[0]]][3] -= 1
+        return [block, nid]
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` cached blocks, LRU leaves first,
+        skipping any block a live request still references.  Returns how
+        many were actually freed.  One heap pass — parents exposed by a
+        child's eviction are pushed as they become leaves, so evicting k
+        blocks from n nodes is O(n + k log n), not O(n*k) (this runs on
+        the admission path whenever the pool is tight)."""
+        heap = [(node[1], key) for key, node in self._nodes.items()
+                if node[3] == 0]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_blocks:
+            _, key = heapq.heappop(heap)
+            node = self._nodes.get(key)
+            if node is None or node[3] != 0:
+                continue                    # removed or re-grew a child
+            if self._blocks.refcount(node[0]) != 1:
+                continue                    # a live request pins it
+            block, _ = self._remove(key)
+            if key[0] and key[0] in self._by_id:
+                parent_key = self._by_id[key[0]]
+                parent = self._nodes[parent_key]
+                if parent[3] == 0:
+                    heapq.heappush(heap, (parent[1], parent_key))
+            self._blocks.release(block)
+            freed += 1
+        return freed
+
+    def purge(self, block_ids: Set[int]) -> int:
+        """Drop every node backed by one of ``block_ids`` AND the
+        subtrees hanging off them (unreachable once their parent is
+        gone), releasing the cache's reference on each removed node's
+        block.  The quarantine hook: a flagged request's PUBLISHED
+        prompt blocks must leave the cache — without this their cache
+        ref keeps them 'shared' at quarantine-retire and a later
+        same-prefix request would decode straight off suspect KV.
+        Returns the number of nodes removed."""
+        doomed = [key for key, node in self._nodes.items()
+                  if node[0] in block_ids]
+        removed = 0
+        while doomed:
+            key = doomed.pop()
+            if key not in self._nodes:
+                continue
+            block, nid = self._remove(key)
+            doomed.extend(k for k in self._nodes if k[0] == nid)
+            self._blocks.release(block)
+            removed += 1
+        return removed

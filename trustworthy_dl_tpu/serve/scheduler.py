@@ -41,7 +41,18 @@ import numpy as np
 from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.quant import int8 as q8
-from trustworthy_dl_tpu.serve.kv_slots import SlotAllocator, SlotKV, init_slots
+from trustworthy_dl_tpu.serve.kv_slots import (
+    BlockAllocator,
+    PagedKV,
+    PrefixCache,
+    SlotAllocator,
+    SlotKV,
+    TRASH_BLOCK,
+    init_paged_pool,
+    init_slots,
+    resolve_prefill_chunk,
+    validate_paged_geometry,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -109,38 +120,56 @@ def _pack_step_outputs(next_tok: jax.Array, ent: jax.Array,
     return jnp.stack([next_tok.astype(jnp.float32), ent, margin])
 
 
+def _local_prefill(cfg: gpt2.GPT2Config, view: Any, tokens: jax.Array,
+                   real_len: jax.Array, quantized: bool):
+    """The parity-critical prologue BOTH pool layouts' prefill programs
+    share (one spelling, so a numerics fix cannot diverge them): run the
+    stacked blocks over the padded prompt through a FULL-PRECISION local
+    cache — prompt self-attention sees exact K/V, so the first sampled
+    token is bit-identical to the dense engine's — and sample logits at
+    ``real_len - 1`` (the prompt's last REAL position; padding beyond it
+    is causally invisible and overwritten before any decode step can
+    attend to it).  ``quantized``: quantize once HERE, at the pool
+    write — every scale in the written span is fresh, so a reused
+    slot/block cannot leak a stale scale (pinned by tests/test_quant.py).
+    Returns (logits, k_rows, v_rows, k_scales, v_scales) with scales None
+    on the full-precision path."""
+    local = gen.init_cache(cfg, 1, tokens.shape[0])
+    logits, local = gen._apply_with_cache(
+        view, tokens[None, :], local, cfg, last_pos=real_len - 1
+    )
+    if quantized:
+        k_rows, k_s = q8.quantize_kv(local.k)   # int8, f32 [L,1,H,width]
+        v_rows, v_s = q8.quantize_kv(local.v)
+        return logits, k_rows, v_rows, k_s, v_s
+    return logits, local.k, local.v, None, None
+
+
+def _sample_pack(logits: jax.Array, key: jax.Array, temp: jax.Array,
+                 greedy: jax.Array) -> jax.Array:
+    """Single-slot sampling tail: first token + trust signals as one
+    packed f32[3, 1] — a single host sync per prefill, not three."""
+    token = _sample_tokens(logits, key[None], temp[None], greedy[None])
+    ent, margin = _logit_signals(logits)
+    return _pack_step_outputs(token, ent, margin)
+
+
 def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
                   slot_k_scale: Any, slot_v_scale: Any,
                   view: Any, tokens: jax.Array, real_len: jax.Array,
                   slot: jax.Array, key: jax.Array, temp: jax.Array,
                   greedy: jax.Array):
-    """Prefill one slot: run the stacked blocks over the bucketed prompt
-    [P] (local cache, width P), write the K/V into the slot row, and sample
-    the first token from the logits at ``real_len - 1`` (the prompt's last
-    REAL position — the bucket padding beyond it is causally invisible to
-    it and is overwritten before any decode step can attend to it).
-    Host-facing scalars (token, entropy, margin) come back as one packed
-    f32[3, 1] — a single sync per admission, not three.
-
-    int8 KV (``slot_*_scale`` not None): the prompt prefills through a
-    FULL-PRECISION local cache (prompt self-attention sees exact K/V, so
-    the first sampled token is bit-identical to the dense engine's), and
-    quantization happens once at the slot write — every scale in
-    [0, bucket) is overwritten, so a reused slot cannot leak a stale
-    scale (pinned by tests/test_quant.py)."""
-    bucket = tokens.shape[0]
-    local = gen.init_cache(cfg, 1, bucket)
-    logits, local = gen._apply_with_cache(
-        view, tokens[None, :], local, cfg, last_pos=real_len - 1
+    """Prefill one STRIPE slot: the shared ``_local_prefill`` prologue
+    over the bucketed prompt [P], then write the K/V into the slot row."""
+    logits, k_rows, v_rows, k_s, v_s = _local_prefill(
+        cfg, view, tokens, real_len, slot_k_scale is not None
     )
-    if slot_k_scale is not None:
-        k_q, k_s = q8.quantize_kv(local.k)      # int8, f32 [L,1,H,bucket]
-        v_q, v_s = q8.quantize_kv(local.v)
+    if k_s is not None:
         new_k = jax.lax.dynamic_update_slice(
-            slot_k, k_q, (0, slot, 0, 0, 0)
+            slot_k, k_rows, (0, slot, 0, 0, 0)
         )
         new_v = jax.lax.dynamic_update_slice(
-            slot_v, v_q, (0, slot, 0, 0, 0)
+            slot_v, v_rows, (0, slot, 0, 0, 0)
         )
         new_ks = jax.lax.dynamic_update_slice(
             slot_k_scale, k_s, (0, slot, 0, 0)
@@ -150,16 +179,14 @@ def _prefill_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
         )
     else:
         new_k = jax.lax.dynamic_update_slice(
-            slot_k, local.k.astype(slot_k.dtype), (0, slot, 0, 0, 0)
+            slot_k, k_rows.astype(slot_k.dtype), (0, slot, 0, 0, 0)
         )
         new_v = jax.lax.dynamic_update_slice(
-            slot_v, local.v.astype(slot_v.dtype), (0, slot, 0, 0, 0)
+            slot_v, v_rows.astype(slot_v.dtype), (0, slot, 0, 0, 0)
         )
         new_ks, new_vs = slot_k_scale, slot_v_scale
-    token = _sample_tokens(logits, key[None], temp[None], greedy[None])
-    ent, margin = _logit_signals(logits)
-    return new_k, new_v, new_ks, new_vs, _pack_step_outputs(token, ent,
-                                                            margin)
+    return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
+                                                      greedy)
 
 
 def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
@@ -183,6 +210,95 @@ def _decode_impl(cfg: gpt2.GPT2Config, slot_k: jax.Array, slot_v: jax.Array,
             cache.k_scale, cache.v_scale)
 
 
+def _paged_prefill_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
+                        pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
+                        view: Any, tokens: jax.Array, real_len: jax.Array,
+                        block_ids: jax.Array, key: jax.Array,
+                        temp: jax.Array, greedy: jax.Array):
+    """Fresh whole-prompt prefill into PAGED blocks: the SAME
+    ``_local_prefill`` prologue as the stripe path — so prompt
+    self-attention and the first sampled token match the stripe engine
+    bit-for-bit, int8 tier included (quantization happens once at the
+    block write) — then the local cache is re-laid-out block-wise and
+    scattered into the pool at ``block_ids`` (i32[C/BLOCK]; entries past
+    the slot's allocation point at the trash block).  Dispatched when
+    the whole prompt fits one chunk and no prefix blocks were reused;
+    longer or prefix-sharing prompts go through ``_paged_chunk_impl``."""
+    c = tokens.shape[0]
+    bsz = pool_k.shape[3]
+    logits, k_rows, v_rows, k_s, v_s = _local_prefill(
+        cfg, view, tokens, real_len, pool_ks is not None
+    )
+    if pool_ks is None:
+        k_rows = k_rows.astype(pool_k.dtype)
+        v_rows = v_rows.astype(pool_v.dtype)
+
+    def to_blocks(a):                       # [L, 1, H, C, Dh] -> pool rows
+        l, _, h, _, dh = a.shape
+        a = a[:, 0].transpose(0, 2, 1, 3)                # [L, C, H, Dh]
+        a = a.reshape(l, c // bsz, bsz, h, dh)
+        return a.transpose(0, 1, 3, 2, 4)                # [L, nCB, H, B, Dh]
+
+    def to_blocks_s(s):                     # [L, 1, H, C] -> scale rows
+        l, _, h, _ = s.shape
+        s = s[:, 0].transpose(0, 2, 1).reshape(l, c // bsz, bsz, h)
+        return s.transpose(0, 1, 3, 2)                   # [L, nCB, H, B]
+
+    new_k = pool_k.at[:, block_ids].set(to_blocks(k_rows))
+    new_v = pool_v.at[:, block_ids].set(to_blocks(v_rows))
+    if pool_ks is not None:
+        new_ks = pool_ks.at[:, block_ids].set(to_blocks_s(k_s))
+        new_vs = pool_vs.at[:, block_ids].set(to_blocks_s(v_s))
+    else:
+        new_ks, new_vs = pool_ks, pool_vs
+    return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
+                                                      greedy)
+
+
+def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
+                      pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
+                      view: Any, tokens: jax.Array, table: jax.Array,
+                      start: jax.Array, last_idx: jax.Array,
+                      key: jax.Array, temp: jax.Array, greedy: jax.Array):
+    """One CHUNK of a paged prefill: C prompt positions starting at
+    ``start`` (block-aligned — a prefix-cache hit starts the suffix at a
+    block boundary), attending to everything already in the slot's
+    blocks (shared prefix included) through the gathered view and
+    scattering its own K/V into the pool.  ``last_idx`` locates the
+    prompt's last real position within this chunk; the sampled token is
+    meaningful only on the final chunk (the host ignores it otherwise).
+    One compiled program serves every chunk of every prompt."""
+    logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+        view, tokens[None, :], pool_k, pool_v, pool_ks, pool_vs,
+        table, start, cfg, last_pos=last_idx,
+    )
+    return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
+                                                      greedy)
+
+
+def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
+                       pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
+                       view: Any, tokens: jax.Array, tables: jax.Array,
+                       lengths: jax.Array, keys: jax.Array,
+                       temps: jax.Array, greedy: jax.Array):
+    """THE fused paged decode step: one token for every slot, live or
+    not.  ``tables`` i32[MAX_SLOTS, NBPS] are the per-slot block maps
+    (inactive rows all-trash — their garbage writes land in block 0) and
+    ``lengths`` the per-slot write offsets; both are traced VALUES, so
+    admission, retirement, block churn and prefix sharing never change
+    the program.  The attention core is the same
+    ``models/generate._block_with_cache`` the stripe engine and batch
+    generate run, over the gathered view — bit-identical streams."""
+    logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+        view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
+        tables, lengths, cfg,
+    )
+    next_tok = _sample_tokens(logits, keys, temps, greedy)
+    ent, margin = _logit_signals(logits)
+    return (_pack_step_outputs(next_tok, ent, margin), new_k, new_v,
+            new_ks, new_vs)
+
+
 _PROGRAMS: Dict[str, Any] = {}
 
 
@@ -197,6 +313,15 @@ def _programs() -> Dict[str, Any]:
         )
         _PROGRAMS["decode"] = jax.jit(
             _decode_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        _PROGRAMS["paged_prefill"] = jax.jit(
+            _paged_prefill_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        _PROGRAMS["paged_chunk"] = jax.jit(
+            _paged_chunk_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        _PROGRAMS["paged_decode"] = jax.jit(
+            _paged_decode_impl, static_argnums=(0,), donate_argnums=donate
         )
     return _PROGRAMS
 
@@ -395,8 +520,370 @@ class ContinuousBatchingScheduler:
         else:
             self.allocator.free(slot)
 
+    def release_quarantine(self, slot: int) -> None:
+        """Operator action: return a quarantined slot to service."""
+        self.allocator.release(slot)
+
+    @property
+    def tokens_in_flight(self) -> int:
+        """Cached tokens currently backing live sequences."""
+        return int(sum(int(self.lengths[s]) for s in self.tasks))
+
     def decode_cache_size(self) -> int:
         """Number of compiled decode programs (the static-shape invariant
         says this is 1 for the scheduler's lifetime)."""
         prog = _PROGRAMS.get("decode")
+        return prog._cache_size() if prog is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# Paged scheduler (the default data path since the paged-KV PR)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _PrefillProgress:
+    """Host record of a slot mid-prefill (chunked): ``pos`` is the next
+    prompt position to feed (block-aligned; starts past the shared
+    prefix), advanced one chunk per engine tick so long prompts never
+    head-of-line-block the fused decode step."""
+
+    task: SlotTask
+    pos: int
+    plen: int
+    shared_len: int
+
+
+class PagedBatchingScheduler:
+    """Continuous batching over the paged block pool (kv_slots.PagedKV).
+
+    Same engine-facing surface as ``ContinuousBatchingScheduler`` (admit
+    / decode_tick / retire / allocator / lengths / kv), different memory
+    discipline: a request claims ``ceil((prompt + max_new) / BLOCK)``
+    blocks at admission — occupancy is bounded by tokens in flight, not
+    by request count — reusing cached prefix blocks where its prompt
+    matches the radix cache (refcounted; prefill then covers only the
+    unshared suffix, fed in bounded chunks interleaved with decode
+    ticks).  Decode stays ONE compiled program for the scheduler's
+    lifetime: block tables are traced gather indices.
+    """
+
+    def __init__(self, params: Any, cfg: gpt2.GPT2Config, max_slots: int,
+                 max_seq: int,
+                 buckets: Optional[Sequence[int]] = None,
+                 kv_dtype: str = "model", weight_dtype: str = "model",
+                 view: Any = None,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
+        q8.validate_dtypes(kv_dtype, weight_dtype)
+        validate_paged_geometry(max_seq, block_size, num_blocks,
+                                prefill_chunk)
+        if max_seq > cfg.n_positions:
+            # The stripe pool gets this from init_slots; the paged pool
+            # allocates per-block, so check the LOGICAL depth here — a
+            # sequence past the position table would silently gather
+            # clamped position embeddings, not raise.
+            raise ValueError(
+                f"max_seq={max_seq} exceeds the model's position table "
+                f"(n_positions={cfg.n_positions})"
+            )
+        self.cfg = cfg
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
+        if view is not None:
+            self.view = view
+        elif weight_dtype == "int8":
+            self.view = q8.quantize_decode_view(params, cfg)
+        else:
+            self.view = gen._decode_view(params, cfg)
+        self.block_size = block_size
+        self.nbps = max_seq // block_size          # blocks per slot table
+        self.num_blocks = (num_blocks if num_blocks is not None
+                           else max_slots * self.nbps)
+        if prefill_chunk is None and kv_dtype == "int8":
+            # Full-prompt prefill by default under int8 KV: a chunked
+            # continuation attends to the previous chunk's
+            # already-QUANTIZED blocks, while the stripe int8 engine
+            # runs the whole prompt through a full-precision local
+            # cache — bit-parity with it holds only on the one-chunk
+            # path.  An explicit prefill_chunk opts back into chunking
+            # (near-tie caveat in README §Serving; prefix-cache hits
+            # read quantized prefix blocks the same way).
+            self.chunk = max_seq
+        else:
+            self.chunk = resolve_prefill_chunk(max_seq, block_size,
+                                               prefill_chunk)
+        self.kv = init_paged_pool(cfg, self.num_blocks, block_size,
+                                  kv_dtype=q8.resolve_kv_dtype(kv_dtype,
+                                                               cfg))
+        self.allocator = SlotAllocator(max_slots)  # decode rows
+        self.blocks = BlockAllocator(self.num_blocks)
+        self.prefix = (PrefixCache(block_size, self.blocks)
+                       if prefix_cache else None)
+        # ``buckets`` is the stripe engine's prefill-program bound; the
+        # paged engine has ONE chunk program, but the engine's submit
+        # contract (reject unprefillable prompts up front) reads
+        # max(buckets) — honour a caller-provided cap, default max_seq.
+        self.buckets = tuple(sorted(buckets or (max_seq,)))
+        if max(self.buckets) > max_seq:
+            raise ValueError("prefill bucket exceeds max_seq")
+        self.lengths = np.zeros(max_slots, np.int32)
+        self.tables: List[List[int]] = [[] for _ in range(max_slots)]
+        self.tasks: Dict[int, SlotTask] = {}       # slot -> task
+        self._prefill: Dict[int, _PrefillProgress] = {}
+        self._q_blocks_by_slot: Dict[int, List[int]] = {}
+        # slot -> block ids the slot's request PUBLISHED to the prefix
+        # cache (newly cached at its prefill completion) — what a
+        # quarantine-retire must purge from the cache.
+        self._published: Dict[int, List[int]] = {}
+        self.max_seq = max_seq
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+
+    # -- admission ---------------------------------------------------------
+
+    @property
+    def has_free_slot(self) -> bool:
+        return self.allocator.free_count > 0
+
+    @property
+    def active_count(self) -> int:
+        return len(self.tasks)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.tasks) / max(self.allocator.max_slots, 1)
+
+    @property
+    def tokens_in_flight(self) -> int:
+        """Cached tokens currently backing live sequences (decode-phase
+        lengths plus prefill progress, shared prefix included)."""
+        total = sum(int(self.lengths[s]) for s in self.tasks
+                    if s not in self._prefill)
+        total += sum(min(st.pos, st.plen) for st in self._prefill.values())
+        return int(total)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.blocks.in_use
+
+    def admit(self, task: SlotTask) -> bool:
+        """Claim a decode row and the request's blocks (reusing cached
+        prefix blocks), enqueue its chunked prefill.  Pure host work — no
+        device program runs until the next ``decode_tick``.  Returns
+        False (task untouched) when no row is free or the block pool
+        cannot cover the request even after prefix-cache eviction
+        (out-of-blocks backpressure)."""
+        p = len(task.prompt)
+        total = p + task.max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"request {task.request_id}: prompt+new = {total} exceeds "
+                f"max_seq={self.max_seq}"
+            )
+        slot = self.allocator.alloc()
+        if slot is None:
+            return False
+        shared: List[int] = []
+        if self.prefix is not None:
+            self.prefix_lookups += 1
+            # Cap at (p-1)//block: at least one prompt token always
+            # prefills, so the first sampled token has fresh logits.
+            shared = self.prefix.lookup(task.prompt.tolist(),
+                                        (p - 1) // self.block_size)
+        n_total = -(-total // self.block_size)             # ceil
+        n_new = n_total - len(shared)
+        fresh = self.blocks.alloc(n_new)
+        if fresh is None and self.prefix is not None:
+            self.prefix.evict(n_new - self.blocks.free_count)
+            fresh = self.blocks.alloc(n_new)
+        if fresh is None:
+            for b in shared:
+                self.blocks.release(b)
+            self.allocator.free(slot)
+            return False
+        if shared:
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += len(shared) * self.block_size
+        self.tables[slot] = shared + fresh
+        self.lengths[slot] = 0
+        task.slot = slot
+        self.tasks[slot] = task
+        self._prefill[slot] = _PrefillProgress(
+            task=task, pos=len(shared) * self.block_size, plen=p,
+            shared_len=len(shared) * self.block_size,
+        )
+        return True
+
+    # -- decode ------------------------------------------------------------
+
+    def _table_row(self, slot: int) -> np.ndarray:
+        row = np.full(self.nbps, TRASH_BLOCK, np.int32)
+        t = self.tables[slot]
+        row[:len(t)] = t
+        return row
+
+    def _advance_prefill(self, slot: int) -> Optional[SlotTask]:
+        """Run ONE chunk for a prefilling slot; returns the task when the
+        chunk completed its prompt (first token recorded)."""
+        st = self._prefill[slot]
+        task = st.task
+        c = self.chunk
+        n_real = min(st.plen - st.pos, c)
+        chunk = np.zeros(c, np.int32)
+        chunk[:n_real] = task.prompt[st.pos:st.pos + n_real]
+        final = st.pos + n_real >= st.plen
+        kv = self.kv
+        if st.pos == 0 and st.plen <= c:
+            # Whole prompt in one chunk, nothing shared: full-precision
+            # local prefill (stripe-engine numerics, bit-for-bit — the
+            # int8 tier quantizes once at the block write).
+            ids = np.full(c // self.block_size, TRASH_BLOCK, np.int32)
+            n_ids = min(len(self.tables[slot]), len(ids))
+            ids[:n_ids] = self.tables[slot][:n_ids]
+            new_k, new_v, new_ks, new_vs, packed = _programs()[
+                "paged_prefill"](
+                self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale, self.view,
+                jnp.asarray(chunk), jnp.asarray(st.plen, jnp.int32),
+                jnp.asarray(ids),
+                jnp.asarray(task.keys[0], jnp.uint32),
+                jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
+                jnp.asarray(task.greedy),
+            )
+        else:
+            last_idx = int(np.clip(st.plen - 1 - st.pos, 0, c - 1))
+            new_k, new_v, new_ks, new_vs, packed = _programs()[
+                "paged_chunk"](
+                self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale, self.view,
+                jnp.asarray(chunk), jnp.asarray(self._table_row(slot)[None]),
+                jnp.asarray(st.pos, jnp.int32),
+                jnp.asarray(last_idx, jnp.int32),
+                jnp.asarray(task.keys[0], jnp.uint32),
+                jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
+                jnp.asarray(task.greedy),
+            )
+        self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+        if not final:
+            st.pos += c
+            return None
+        token, ent, margin = np.asarray(packed)[:, 0]
+        task._record(int(token), float(ent), float(margin))
+        self.lengths[slot] = st.plen
+        del self._prefill[slot]
+        if self.prefix is not None:
+            # The prompt's FULL blocks are now authoritative in the pool
+            # — publish them so later same-prefix requests skip their
+            # prefill.  (Generated tokens are never cached.)  The newly
+            # cached ids are remembered: if THIS request is later
+            # flagged, its publications must leave the cache with it.
+            self._published[slot] = self.prefix.insert(
+                task.prompt.tolist(),
+                self.tables[slot][:st.plen // self.block_size],
+            )
+        return task
+
+    def decode_tick(self) -> List[SlotTask]:
+        """One engine tick: advance every mid-prefill slot by ONE chunk
+        (prompts finishing their last chunk emit their first token), then
+        run the fused decode step for every decode-phase slot.  Returns
+        the tasks that received a token this tick."""
+        ticked: List[SlotTask] = []
+        finished_prefill = set()
+        for slot in sorted(self._prefill):
+            done = self._advance_prefill(slot)
+            if done is not None:
+                finished_prefill.add(slot)
+                ticked.append(done)
+        active = {s: t for s, t in self.tasks.items()
+                  if s not in self._prefill and not t.done
+                  and s not in finished_prefill}
+        if not active:
+            return ticked
+        ms = self.allocator.max_slots
+        tokens = np.zeros(ms, np.int32)
+        keys = np.zeros((ms, 2), np.uint32)
+        temps = np.ones(ms, np.float32)
+        greedy = np.ones(ms, bool)
+        tables = np.full((ms, self.nbps), TRASH_BLOCK, np.int32)
+        for slot, task in active.items():
+            tokens[slot] = task.next_token
+            keys[slot] = task.keys[len(task.emitted)]
+            temps[slot] = max(task.temperature, 1e-6)
+            greedy[slot] = task.greedy
+            tables[slot] = self._table_row(slot)
+        kv = self.kv
+        packed, new_k, new_v, new_ks, new_vs = _programs()["paged_decode"](
+            self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale, self.view,
+            jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(self.lengths),
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
+        )
+        self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+        host = np.asarray(packed)
+        next_tok, ent, margin = host[0], host[1], host[2]
+        for slot in active:
+            self.lengths[slot] += 1
+        for slot, task in active.items():
+            task._record(int(next_tok[slot]), float(ent[slot]),
+                         float(margin[slot]))
+            ticked.append(task)
+        return ticked
+
+    # -- retirement --------------------------------------------------------
+
+    def retire(self, task: SlotTask, quarantine: bool = False) -> None:
+        """Release the task's decode row and drop its block references.
+        Blocks still shared (prefix cache, other requests) stay resident;
+        under ``quarantine`` the task's UNSHARED blocks leave the pool
+        with the row, and any blocks the task itself PUBLISHED to the
+        prefix cache are purged from it first (the trust mirror: a
+        flagged request's private KV — generated tail AND the prompt
+        blocks it prefilled — is suspect; a prefix a different clean
+        request published and others share is not)."""
+        slot = task.slot
+        if slot < 0 or self.tasks.get(slot) is not task:
+            return
+        del self.tasks[slot]
+        self._prefill.pop(slot, None)
+        published = self._published.pop(slot, [])
+        if quarantine and self.prefix is not None and published:
+            # The flagged request's own PUBLISHED prompt blocks leave
+            # the cache FIRST — otherwise the cache's reference keeps
+            # them "shared" in the release loop below and a later
+            # same-prefix request would decode straight off suspect KV
+            # without any prefill.  (A prefix published by a DIFFERENT,
+            # clean request stays cached: this request merely read it.)
+            self.prefix.purge(set(published))
+        q_blocks: List[int] = []
+        for b in self.tables[slot]:
+            if self.blocks.release(b, quarantine=quarantine) \
+                    == "quarantined":
+                q_blocks.append(b)
+        self.tables[slot] = []
+        if quarantine:
+            self._q_blocks_by_slot[slot] = q_blocks
+            self.allocator.quarantine(slot)
+            logger.warning(
+                "slot %d quarantined after request %d was flagged "
+                "anomalous (%d private block(s) impounded, %d slots "
+                "remain in service)",
+                slot, task.request_id, len(q_blocks),
+                self.allocator.capacity,
+            )
+        else:
+            self.allocator.free(slot)
+
+    def release_quarantine(self, slot: int) -> None:
+        """Operator action: return a quarantined slot AND the blocks
+        impounded with it to service."""
+        self.allocator.release(slot)
+        for b in self._q_blocks_by_slot.pop(slot, []):
+            self.blocks.unquarantine(b)
+
+    def decode_cache_size(self) -> int:
+        """Number of compiled paged-decode programs (the compile-once
+        pin: block-table churn must keep this at 1)."""
+        prog = _PROGRAMS.get("paged_decode")
         return prog._cache_size() if prog is not None else 0
