@@ -404,9 +404,16 @@ def bench_serve() -> "list[dict]":
 
     records = []
     for rate in rates:
+        # SLO evidence rides every sweep arm: streaming P2 TTFT/ITL
+        # estimates + breach counts land in the record's "slo" section
+        # (stamped run_metadata at the bench-JSON top level as always).
+        from trustworthy_dl_tpu.obs.slo import SLOWatcher, \
+            default_serve_rules
+
+        watcher = SLOWatcher(default_serve_rules())
         engine = ServingEngine(params, cfg, max_slots=max_slots,
                                max_seq=max_seq, queue_limit=n_requests,
-                               rng=jax.random.PRNGKey(1))
+                               rng=jax.random.PRNGKey(1), slo=watcher)
         workload = []
         t_arrive = 0.0
         # Exclusive draw bound: plen <= max_seq - max_new, so prompt+new
@@ -454,6 +461,7 @@ def bench_serve() -> "list[dict]":
                 continue
             engine.step()
         summary = engine.metrics_summary()
+        status = watcher.status()
         row = {
             "offered_rps": rate,
             "tokens_per_s": round(summary["tokens_per_s"], 1),
@@ -462,6 +470,20 @@ def bench_serve() -> "list[dict]":
             "ttft_p50_ms": round(summary.get("ttft_p50_ms", 0.0), 3),
             "completed": summary["requests_completed"],
             "shed": shed,
+            "slo": {
+                "rules": [{"name": r["name"], "target": r["target"],
+                           "burn_rate": round(r["burn_rate"], 4),
+                           "active": r["active"]}
+                          for r in status["rules"]],
+                "breach_total": status["breach_total"],
+                "shed_slo": summary.get("requests_shed_slo", 0),
+                "ttft_s": {k: round(v, 6) if isinstance(v, float) else v
+                           for k, v in watcher.percentiles(
+                               "ttft_s").items()},
+                "itl_s": {k: round(v, 6) if isinstance(v, float) else v
+                          for k, v in watcher.percentiles(
+                              "itl_s").items()},
+            },
         }
         log(f"serve offered={rate:6.1f} req/s: "
             f"{row['tokens_per_s']:8.1f} tok/s, ITL p50 "

@@ -171,11 +171,20 @@ def _run_training(trainer, depth, ckpt_dir, epochs=2):
     attacker.activate_attacks()
     trainer.set_attack_plan(attacker.plan(NODES))
     session = ObsSession(None, registry=MetricsRegistry())
+    # Acceptance pin for the active obs plane: equivalence must hold
+    # with span tracking attached (train.step spans ride the trace).
+    session.enable_spans()
     trainer.attach_obs(session)
     dl = _loader()
     for epoch in range(epochs):
         trainer.train_epoch(dl, epoch)
-    events = _normalized_events(session)
+    all_events = _normalized_events(session)
+    # Span rows carry wall-clock durations (inherently run-dependent)
+    # and the async arm legitimately laps a "host" phase sync folds into
+    # compute — equivalence compares everything EXCEPT spans, then span
+    # COVERAGE is asserted per arm.
+    events = [e for e in all_events if e["type"] != "span"]
+    spans = [e for e in all_events if e["type"] == "span"]
     history = [{k: v for k, v in rec.items() if k != "timestamp"}
                for rec in trainer.attack_history]
     stats = trainer.get_training_stats()
@@ -184,7 +193,7 @@ def _run_training(trainer, depth, ckpt_dir, epochs=2):
         "attack_count": stats["attack_count"],
         "global_step": stats["global_step"],
         "training_state": stats["training_state"],
-    }
+    }, spans
 
 
 def test_sync_async_equivalence(shared_trainer, tmp_path):
@@ -213,6 +222,12 @@ def test_sync_async_equivalence(shared_trainer, tmp_path):
     steps = [e["step"] for e in sync[0] if e["type"] == "train_step"]
     assert len(steps) == 2 * STEPS_PER_EPOCH
     assert steps == sorted(steps)
+    # Span tracking was live in BOTH arms: every accounted step got a
+    # train.step root span (children per lap ride the same trace).
+    for name, spans in (("sync", sync[3]), ("async", async_[3])):
+        roots = sorted(e["step"] for e in spans
+                       if e["name"] == "train.step")
+        assert roots == steps, f"{name} arm span coverage"
 
 
 # ---------------------------------------------------------------------------

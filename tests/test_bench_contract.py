@@ -142,6 +142,15 @@ def test_bench_serve_sweep_records(monkeypatch):
         assert key in row, row
     assert row["completed"] + row["shed"] == 5
     assert row["tokens_per_s"] > 0
+    # SLO evidence rides every sweep arm: streaming percentile sketches
+    # + per-rule burn rates + breach counts.
+    slo = row["slo"]
+    assert {r["name"] for r in slo["rules"]} == {"ttft", "itl"}
+    for rule in slo["rules"]:
+        assert rule["burn_rate"] >= 0.0
+    assert slo["breach_total"] >= 0 and "shed_slo" in slo
+    assert slo["itl_s"]["count"] > 0 and slo["itl_s"]["p50"] > 0.0
+    assert slo["ttft_s"]["count"] == row["completed"]
 
 
 def test_bench_paged_ab_records(monkeypatch):

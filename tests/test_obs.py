@@ -423,3 +423,468 @@ def test_obs_session_artifacts_and_snapshot_cadence(tmp_path):
     # One cadence snapshot + one final.
     assert types.count("metrics_snapshot") == 2
     assert "tddl_s_total 1.0" in (tmp_path / "metrics.prom").read_text()
+
+
+# ---------------------------------------------------------------------------
+# Active plane: spans
+# ---------------------------------------------------------------------------
+
+
+obswatch = pytest.mark.obswatch
+
+
+@obswatch
+def test_span_tracker_lifecycle_and_trace_emission(tmp_path):
+    from trustworthy_dl_tpu.obs.spans import SpanTracker
+
+    path = tmp_path / "trace.jsonl"
+    bus = TraceBus(str(path))
+    spans = SpanTracker(trace=bus)
+    root = spans.start("serve.request", kind="serve", request_id=7,
+                       prompt_len=4)
+    child = spans.start("serve.prefill", kind="serve", parent_id=root,
+                        request_id=7)
+    assert spans.open_count == 2
+    ended = spans.end(child, slot=2)
+    assert ended.duration_s >= 0.0 and ended.attrs["slot"] == 2
+    assert spans.end(child) is None          # double close is a no-op
+    spans.end(root, status="completed")
+    with spans.span("engine.tick", kind="serve"):
+        pass
+    spans.add("synth", 1.0, 1.5, kind="train", step=3)
+    bus.close()
+
+    events = read_jsonl(str(path))
+    assert all(e["type"] == "span" for e in events)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["serve.prefill"]["parent_id"] == root
+    assert by_name["serve.prefill"]["request_id"] == 7
+    assert by_name["serve.request"]["status"] == "completed"
+    assert by_name["synth"]["duration_s"] == pytest.approx(0.5)
+    assert by_name["synth"]["step"] == 3
+
+    chrome = spans.export_chrome(str(tmp_path / "chrome.json"))
+    assert len(chrome["traceEvents"]) == 4
+    synth = next(e for e in chrome["traceEvents"] if e["name"] == "synth")
+    assert synth["ph"] == "X" and synth["dur"] == pytest.approx(0.5e6)
+    # Offline conversion from the JSONL agrees on the event count.
+    from trustworthy_dl_tpu.obs.spans import chrome_trace_from_events
+
+    offline = chrome_trace_from_events(events)
+    assert len(offline["traceEvents"]) == 4
+    # Serving spans land on the request's lane.
+    req = next(e for e in offline["traceEvents"]
+               if e["name"] == "serve.request")
+    assert req["tid"] == 7
+
+
+@obswatch
+def test_step_timer_synthesizes_train_spans():
+    """The trainer's per-phase laps become a train.step span with one
+    child per lap — no extra instrumentation in the loop itself."""
+    from trustworthy_dl_tpu.obs.spans import SpanTracker
+
+    rec = FlightRecorder(64)
+    bus = TraceBus(None, recorder=rec)
+    reporter = StepTimeReporter()
+    reporter.spans = SpanTracker(trace=bus)
+    reporter.discard_step()
+    time.sleep(0.001)
+    reporter.lap("data")
+    time.sleep(0.001)
+    reporter.lap("compute")
+    reporter.finish_step(step=12)
+    names = [(e["name"], e.get("step")) for e in rec.events()]
+    assert ("train.step", 12) in names
+    assert ("train.data", 12) in names and ("train.compute", 12) in names
+    root = next(e for e in rec.events() if e["name"] == "train.step")
+    child = next(e for e in rec.events() if e["name"] == "train.data")
+    assert child["parent_id"] == root["span_id"]
+    # Discarded steps synthesize nothing.
+    before = len(rec.events())
+    reporter.lap("data")
+    reporter.discard_step()
+    reporter.finish_step(step=13)
+    assert len(rec.events()) == before
+
+
+# ---------------------------------------------------------------------------
+# Active plane: streaming percentiles + SLO rules
+# ---------------------------------------------------------------------------
+
+
+@obswatch
+def test_p2_quantile_tracks_numpy_percentiles():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    xs = rng.exponential(1.0, 20000)
+    for q in (0.5, 0.9, 0.99):
+        from trustworthy_dl_tpu.obs.slo import P2Quantile
+
+        est = P2Quantile(q)
+        for x in xs:
+            est.observe(x)
+        exact = float(np.percentile(xs, q * 100))
+        assert est.value == pytest.approx(exact, rel=0.05), q
+    # Exact below five samples; NaNs are ignored, not absorbed.
+    from trustworthy_dl_tpu.obs.slo import P2Quantile
+
+    small = P2Quantile(0.5)
+    for x in (3.0, 1.0, float("nan"), 2.0):
+        small.observe(x)
+    assert small.value == 2.0
+    with pytest.raises(ValueError):
+        P2Quantile(1.5)
+
+
+@obswatch
+def test_slo_watcher_burn_rate_breach_and_clear(tmp_path):
+    from trustworthy_dl_tpu.obs.slo import SLORule, SLOWatcher
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(256)
+    bus = TraceBus(None, recorder=rec)
+    dumps = []
+
+    def dump(reason, step=None, extra=None):
+        dumps.append((reason, step, extra))
+
+    fired = []
+    watcher = SLOWatcher(
+        [SLORule("itl", signal="itl_s", target=0.1, budget=0.1,
+                 window=20, min_count=10, burn_threshold=1.0)],
+        registry=reg, trace=bus, dump=dump,
+    )
+    watcher.on_breach(lambda name, info: fired.append((name, info)))
+    for _ in range(20):
+        watcher.observe("itl_s", 0.01)
+    assert not watcher.breached
+    assert watcher.burn_rate("itl") == 0.0
+    # 5/20 violating = 25% against a 10% budget -> burn 2.5 -> breach.
+    for _ in range(5):
+        watcher.observe("itl_s", 0.5)
+    assert watcher.breached and watcher.active == ["itl"]
+    assert watcher.burn_rate("itl") == pytest.approx(2.5)
+    assert reg.get("tddl_slo_burn_rate").value(slo="itl") \
+        == pytest.approx(2.5)
+    assert reg.get("tddl_slo_breaches_total").value(slo="itl") == 1.0
+    assert len(fired) == 1 and fired[0][0] == "itl"
+    assert [(r, e["slo_rules"]) for r, _, e in dumps] \
+        == [("slo_breach", ["itl"])]
+    breaches = [e for e in rec.events() if e["type"] == "slo_breach"]
+    assert len(breaches) == 1 and breaches[0]["slo"] == "itl"
+    # Still breached = no re-fire; recovery clears the flag.
+    watcher.observe("itl_s", 0.5)
+    assert len(fired) == 1 and len(dumps) == 1
+    for _ in range(25):
+        watcher.observe("itl_s", 0.01)
+    assert not watcher.breached
+    # The estimator sketch rode along.
+    pcts = watcher.percentiles("itl_s")
+    assert pcts["count"] == 51 and pcts["p50"] < 0.1
+    status = watcher.status()
+    assert status["breach_total"] == 1 and status["active"] == []
+
+
+@obswatch
+def test_slo_rule_validation():
+    from trustworthy_dl_tpu.obs.slo import SLORule, SLOWatcher
+
+    with pytest.raises(ValueError):
+        SLORule("x", signal="s", target=1.0, budget=0.0)
+    with pytest.raises(ValueError):
+        SLORule("x", signal="s", target=1.0, window=4, min_count=5)
+    w = SLOWatcher([SLORule("a", signal="s", target=1.0)])
+    with pytest.raises(ValueError, match="duplicate"):
+        w.add_rule(SLORule("a", signal="s", target=2.0))
+
+
+# ---------------------------------------------------------------------------
+# Active plane: anomaly watcher
+# ---------------------------------------------------------------------------
+
+
+@obswatch
+def test_ewma_detector_score_then_absorb_only_clean():
+    from trustworthy_dl_tpu.obs.anomaly import EwmaDetector
+
+    det = EwmaDetector(alpha=0.1, warmup=8, z_threshold=6.0)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        anomalous, _ = det.observe(1.0 + rng.normal(0, 0.01))
+        assert not anomalous
+    before = det.count
+    anomalous, z = det.observe(100.0)
+    assert anomalous and z > 6.0
+    assert det.count == before            # outlier NOT absorbed
+    anomalous, z = det.observe(float("nan"))
+    assert anomalous and np.isinf(z)
+    anomalous, _ = det.observe(1.0)
+    assert not anomalous and det.count == before + 1
+
+
+@obswatch
+def test_anomaly_watcher_gauges_events_and_episode_dump():
+    from trustworthy_dl_tpu.obs.anomaly import AnomalyWatcher
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(256)
+    bus = TraceBus(None, recorder=rec)
+    dumps = []
+    watcher = AnomalyWatcher(
+        {"loss": (0.1, 4, 6.0), "step_time": (0.1, 4, 6.0)},
+        registry=reg, trace=bus,
+        dump=lambda reason, step=None, extra=None:
+            dumps.append((reason, step)),
+    )
+    with pytest.raises(ValueError, match="already watched"):
+        watcher.watch("loss")
+    for i in range(10):
+        watcher.observe("loss", 2.0 + 0.001 * (i % 3), step=i)
+        watcher.observe("step_time", 0.1, step=i)
+    assert watcher.active == []
+    # Two signals break on the SAME step: two anomaly events, two gauge
+    # flips, ONE episode dump.
+    onset = watcher.observe("loss", float("nan"), step=10)
+    assert onset is not None and onset["signal"] == "loss"
+    watcher.observe("step_time", 5.0, step=10)
+    assert watcher.active == ["loss", "step_time"]
+    assert reg.get("tddl_anomaly_active").value(signal="loss") == 1.0
+    assert reg.get("tddl_anomaly_active").value(signal="step_time") == 1.0
+    assert dumps == [("anomaly", 10)]
+    anomalies = [e for e in rec.events() if e["type"] == "anomaly"]
+    assert {e["signal"] for e in anomalies} == {"loss", "step_time"}
+    nan_event = next(e for e in anomalies if e["signal"] == "loss")
+    assert nan_event["zscore"] is None    # NaN has no finite z — and the
+    assert nan_event["step"] == 10        # event must still be valid JSON
+    # Clean observations clear the gauges and end the episode; the NEXT
+    # incident dumps again.
+    watcher.observe("loss", 2.0, step=11)
+    watcher.observe("step_time", 0.1, step=11)
+    assert watcher.active == []
+    assert reg.get("tddl_anomaly_active").value(signal="loss") == 0.0
+    watcher.observe("step_time", 9.0, step=12)
+    assert len(dumps) == 2
+
+
+@obswatch
+def test_seeded_chaos_drill_produces_predicted_anomalies(tmp_path):
+    """The obs→trust loop drill: a SEEDED FaultPlan schedules a stall and
+    a NaN on the same step; driving the watcher with the plan's faults
+    must produce exactly the plan-predicted anomaly events (both signals,
+    at the fault step) and exactly ONE anomaly-reason flight dump."""
+    from trustworthy_dl_tpu.chaos.plan import FaultEvent, FaultKind, \
+        FaultPlan
+
+    plan = FaultPlan.scripted([
+        FaultEvent(step=30, kind=FaultKind.STALL, severity=1.0),
+        FaultEvent(step=30, kind=FaultKind.GRAD_NAN),
+    ], seed=7)
+    session = ObsSession(str(tmp_path), registry=MetricsRegistry())
+    _, anomaly = session.install_watchers(slo_rules=())
+    rng = np.random.default_rng(plan.seed)
+    for step in range(1, 60):
+        stall = plan.at(step, FaultKind.STALL)
+        step_time = 0.1 + float(rng.normal(0, 0.002)) \
+            + (stall[0].severity if stall else 0.0)
+        loss = 2.0 + float(rng.normal(0, 0.01))
+        if plan.at(step, FaultKind.GRAD_NAN):
+            loss = float("nan")
+        anomaly.observe("step_time", step_time, step=step)
+        anomaly.observe("loss", loss, step=step)
+    session.finalize()
+
+    events = read_jsonl(str(tmp_path / "trace.jsonl"))
+    anomalies = [e for e in events if e["type"] == "anomaly"]
+    assert {(e["signal"], e["step"]) for e in anomalies} \
+        == {("step_time", 30), ("loss", 30)}
+    dumps = sorted(tmp_path.glob("flight_*anomaly*.json"))
+    assert len(dumps) == 1, [p.name for p in dumps]
+    payload = json.loads(dumps[0].read_text())
+    assert payload["reason"] == "anomaly" and payload["step"] == 30
+    # The registry carries the gauge/counter surface the SLO-aware fleet
+    # (ROADMAP item 4) will consume.
+    reg = session.registry
+    assert reg.get("tddl_anomaly_events_total").value(signal="loss") == 1.0
+    assert reg.get("tddl_anomaly_active").value(signal="loss") == 0.0
+    # slo_status.json reflects the watchers at finalize.
+    status = json.loads((tmp_path / "slo_status.json").read_text())
+    assert status["anomaly"]["event_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Active plane: attribution ledger
+# ---------------------------------------------------------------------------
+
+
+@obswatch
+def test_attribution_ledger_jsonl_roundtrip(tmp_path):
+    from trustworthy_dl_tpu.obs.attribution import AttributionLedger, \
+        read_ledger, token_hash
+
+    path = tmp_path / "attribution.jsonl"
+    ledger = AttributionLedger(str(path), keep=2)
+    for rid in range(3):
+        ledger.append({"request_id": rid, "status": "completed",
+                       "admitted": True, "layout": "paged", "slot": 0,
+                       "block_ids": [1], "tokens": 1,
+                       "token_hash": token_hash([rid])})
+    ledger.close()
+    assert ledger.total == 3
+    assert [r["request_id"] for r in ledger.records()] == [1, 2]  # ring
+    header, records = read_ledger(str(path))           # file keeps all
+    assert set(RUN_METADATA_KEYS) <= set(header["run_metadata"])
+    assert [r["request_id"] for r in records] == [0, 1, 2]
+    assert all("t" in r for r in records)
+    assert token_hash([1, 2, 3]) != token_hash([1, 2, 4])
+    assert token_hash([]) == token_hash(())
+
+
+@obswatch
+def test_verify_attribution_against_block_allocator_journal():
+    from trustworthy_dl_tpu.obs.attribution import verify_attribution
+    from trustworthy_dl_tpu.serve.kv_slots import BlockAllocator
+
+    alloc = BlockAllocator(8)
+    blocks = alloc.alloc(3)
+    alloc.incref(blocks[0])                 # prefix-cache style share
+    for b in blocks:
+        alloc.release(b)
+    record = {"request_id": 0, "status": "completed", "admitted": True,
+              "layout": "paged", "slot": 1, "block_ids": list(blocks),
+              "prefix_block_ids": [blocks[0]]}
+    ok, problems = verify_attribution([record], alloc)
+    assert ok, problems
+
+    # Forged claims are caught: a block never allocated, the trash
+    # block, duplicates, and a prefix id outside the table.
+    forged = dict(record, block_ids=[7], prefix_block_ids=[])
+    ok, problems = verify_attribution([record, forged], alloc)
+    assert not ok and any("never allocated" in p for p in problems)
+    ok, problems = verify_attribution(
+        [dict(record, block_ids=[0], prefix_block_ids=[])], alloc)
+    assert not ok and any("trash" in p for p in problems)
+    ok, problems = verify_attribution(
+        [dict(record, block_ids=[blocks[0], blocks[0]])], alloc)
+    assert not ok and any("duplicate" in p for p in problems)
+    ok, problems = verify_attribution(
+        [dict(record, prefix_block_ids=[blocks[1] + 100])], alloc)
+    assert not ok and any("subset" in p for p in problems)
+    # Unadmitted and stripe records verify structurally.
+    ok, _ = verify_attribution(
+        [{"request_id": 1, "admitted": False},
+         {"request_id": 2, "admitted": True, "layout": "stripe",
+          "slot": 0}], alloc)
+    assert ok
+
+
+@obswatch
+def test_verify_attribution_survives_journal_ring_rotation():
+    """The cumulative ``lifetime`` counts (bounded by pool size) keep
+    reconciliation exact after the debug ring overflows — a long-pinned
+    block whose alloc entry rotated out must NOT read as forged."""
+    from trustworthy_dl_tpu.obs.attribution import verify_attribution
+    from trustworthy_dl_tpu.serve.kv_slots import BlockAllocator
+
+    alloc = BlockAllocator(4, journal_capacity=4)
+    pinned = alloc.alloc(1)
+    for _ in range(8):                     # 16 ops: ring holds only 4
+        b = alloc.alloc(1)
+        alloc.release(b[0])
+    assert not any(op == "alloc" and blk == pinned[0]
+                   for op, blk, *_ in alloc.journal)
+    record = {"request_id": 0, "status": "completed", "admitted": True,
+              "layout": "paged", "slot": 0, "block_ids": list(pinned),
+              "prefix_block_ids": []}
+    ok, problems = verify_attribution([record], alloc)
+    assert ok, problems
+    alloc.release(pinned[0])
+
+
+# ---------------------------------------------------------------------------
+# Contract lints: typed emissions + metric-name prefix
+# ---------------------------------------------------------------------------
+
+
+def _package_sources():
+    pkg = REPO / "trustworthy_dl_tpu"
+    return sorted(pkg.rglob("*.py")) + [REPO / "bench.py"]
+
+
+def test_every_emit_call_site_uses_a_schema_typed_event():
+    """CONTRACT: every ``*.emit(...)`` call site in the package passes an
+    ``EventType.<NAME>`` whose NAME exists — new instrumentation cannot
+    bypass schema validation with a raw string (or a typo'd member)."""
+    import re
+
+    pattern = re.compile(r"\.emit\(\s*([A-Za-z_][\w.]*|[\"'][^\"']*[\"'])")
+    offenders = []
+    for module in _package_sources():
+        if module.name == "events.py":
+            continue  # the bus itself (validates at runtime)
+        for m in pattern.finditer(module.read_text()):
+            arg = m.group(1)
+            if not arg.startswith("EventType."):
+                offenders.append(f"{module.name}: emit({arg}")
+            elif arg.split(".", 1)[1] not in EventType.__members__:
+                offenders.append(f"{module.name}: unknown {arg}")
+    assert not offenders, offenders
+
+
+def test_every_registered_metric_name_carries_the_tddl_prefix():
+    """CONTRACT: every literal metric name registered on a registry
+    (counter/gauge/histogram) starts with ``tddl_`` — the naming
+    convention the Prometheus surface promises."""
+    import re
+
+    pattern = re.compile(
+        r"\.(?:counter|gauge|histogram)\(\s*\n?\s*([fF]?[\"'])([^\"']+)"
+    )
+    offenders = []
+    for module in _package_sources():
+        if module.name == "registry.py":
+            continue  # defines the methods; registers nothing itself
+        for m in pattern.finditer(module.read_text()):
+            if not m.group(2).startswith("tddl_"):
+                offenders.append(f"{module.name}: {m.group(2)!r}")
+    assert not offenders, offenders
+
+
+# ---------------------------------------------------------------------------
+# ObsSession active-plane plumbing
+# ---------------------------------------------------------------------------
+
+
+@obswatch
+def test_obs_session_active_plane_artifacts(tmp_path):
+    session = ObsSession(str(tmp_path), registry=MetricsRegistry())
+    spans = session.enable_spans()
+    assert session.enable_spans() is spans          # idempotent
+    assert session.step_timer.spans is spans
+    slo, anomaly = session.install_watchers()
+    assert session.install_watchers() == (slo, anomaly)
+    ledger = session.open_ledger()
+    with spans.span("serve.request", kind="serve", request_id=1):
+        pass
+    ledger.append({"request_id": 1, "status": "completed",
+                   "admitted": True, "layout": "paged", "slot": 0,
+                   "block_ids": [], "tokens": 0, "token_hash": "00"})
+    slo.observe("ttft_s", 0.1)
+    session.finalize()
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"trace.jsonl", "slo_status.json", "trace_events.json",
+            "attribution.jsonl"} <= names
+    chrome = json.loads((tmp_path / "trace_events.json").read_text())
+    assert len(chrome["traceEvents"]) == 1
+    status = json.loads((tmp_path / "slo_status.json").read_text())
+    assert status["slo"]["signals"]["ttft_s"]["count"] == 1
+    # step_time feeds flow through on_step.
+    session2 = ObsSession(None, registry=MetricsRegistry())
+    session2.install_watchers(slo_rules=())
+    session2.step_timer.lap("data")
+    time.sleep(0.001)
+    session2.step_timer.lap("compute")
+    session2.step_timer.finish_step(step=1)
+    session2.on_step(1)
+    assert session2.anomaly._dets["step_time"].count == 1

@@ -245,3 +245,136 @@ def test_flagged_request_quarantines_slot(params):
     engine.release_quarantine(0)
     rid = engine.submit(ServeRequest(prompt=[7, 8], max_new_tokens=2))
     assert engine.run_until_idle()[rid].tokens  # served
+
+
+# --------------------------------------------------------------------------
+# Active observability plane (obs/): shed hook, bounded retention,
+# full-plane bit-parity + compile-once
+# --------------------------------------------------------------------------
+
+
+def test_slo_shed_hook_drops_lowest_priority_newest_first(params):
+    """While the attached watcher is in breach, the admission path sheds
+    the LOWEST-priority queued request (ties: newest) — and only while
+    the queue exceeds free capacity, so shedding relieves pressure
+    instead of burning goodput."""
+
+    class Breached:
+        breached = True
+
+        def observe(self, *a, **k):
+            pass
+
+        def quantile(self, signal, q):
+            return None   # attached watcher owns the summary sketches
+
+    engine = ServingEngine(params, CFG, max_slots=1, max_seq=32,
+                           queue_limit=8, slo=Breached())
+    rid_hi = engine.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                        priority=5))
+    rid_a = engine.submit(ServeRequest(prompt=[3, 4], max_new_tokens=2))
+    rid_b = engine.submit(ServeRequest(prompt=[5, 6], max_new_tokens=2))
+    engine._shed_for_slo()
+    engine._shed_for_slo()
+    engine._shed_for_slo()   # queue (1) <= free (1): no further sheds
+    assert engine.results[rid_b].status == "shed_slo"   # newest tie first
+    assert engine.results[rid_a].status == "shed_slo"
+    assert rid_hi not in engine.results                 # survivor
+    assert engine.shed_slo == 2
+    assert [t.request_id for t, _ in engine._queue] == [rid_hi]
+    assert engine.metrics_summary()["requests_shed_slo"] == 2
+
+
+@pytest.mark.slow
+@pytest.mark.obswatch
+def test_full_obs_plane_keeps_streams_bit_identical(params, tmp_path):
+    """THE acceptance pin for the active plane: spans + attribution
+    ledger + SLO/anomaly watchers all attached, greedy AND sampled
+    requests — streamed tokens stay bit-identical to generate(), the
+    fused decode step still compiles exactly once, every request yields
+    a verifiable attribution record, and the request span cascade lands
+    in the trace."""
+    from trustworthy_dl_tpu.obs import MetricsRegistry, ObsSession
+    from trustworthy_dl_tpu.obs.events import read_jsonl
+    from trustworthy_dl_tpu.obs.slo import SLORule
+
+    session = ObsSession(str(tmp_path), registry=MetricsRegistry())
+    session.enable_spans()
+    # Generous targets: a healthy engine must never trip them (a breach
+    # would shed, and shedding would break the parity assertion below).
+    session.install_watchers(slo_rules=(
+        SLORule("ttft", signal="ttft_s", target=60.0),
+        SLORule("itl", signal="itl_s", target=60.0),
+    ))
+    session.open_ledger()
+    # max_seq=64 is this file's only 64-row geometry: the strict
+    # compile-once delta below must see a FRESH decode program, not a
+    # process-global jit-cache hit from an earlier engine's identical
+    # shapes (same trap test_quant's vocab split documents).
+    engine = ServingEngine(
+        params, CFG, max_slots=3, max_seq=64, queue_limit=32,
+        trace=session.trace, registry=session.registry,
+        spans=session.spans, ledger=session.ledger,
+        slo=session.slo, anomaly=session.anomaly,
+    )
+    cache_before = engine.scheduler.decode_cache_size()
+    key = jax.random.PRNGKey(3)
+    reqs = [([5, 17, 3], 6, 0.0, None),
+            ([9, 4, 33, 2], 5, 0.8, key),
+            ([5, 17, 3], 4, 0.0, None)]     # shares a prefix with req 0
+    rids = [engine.submit(ServeRequest(prompt=p, max_new_tokens=n,
+                                       temperature=t, rng=r))
+            for p, n, t, r in reqs]
+    results = engine.run_until_idle()
+    assert engine.scheduler.decode_cache_size() - cache_before == 1
+
+    for rid, (prompt, new, temp, rng) in zip(rids, reqs):
+        ref = generate(params, CFG, jnp.asarray([prompt], jnp.int32), new,
+                       temperature=temp, rng=rng)
+        assert results[rid].tokens \
+            == np.asarray(ref)[0, len(prompt):].tolist(), f"request {rid}"
+
+    # One verifiable attribution record per request.
+    records = engine.ledger.records()
+    assert sorted(r["request_id"] for r in records) == sorted(rids)
+    ok, problems = engine.verify_attribution()
+    assert ok, problems
+    for r in records:
+        assert r["admitted"] and r["layout"] == "paged"
+        assert r["block_ids"] and r["kv_dtype"] == "model"
+        assert r["token_hash"] == __import__(
+            "trustworthy_dl_tpu.obs.attribution", fromlist=["token_hash"]
+        ).token_hash(results[r["request_id"]].tokens)
+
+    session.finalize()
+    events = read_jsonl(str(tmp_path / "trace.jsonl"))
+    spans = [e for e in events if e["type"] == "span"]
+    for name in ("serve.request", "serve.queued", "serve.prefill",
+                 "serve.decode", "serve.decode_tick", "serve.monitor"):
+        assert any(e["name"] == name for e in spans), name
+    # Attribution events correlate on request id.
+    attrib = [e for e in events if e["type"] == "attribution"]
+    assert sorted(e["request_id"] for e in attrib) == sorted(rids)
+    # Streaming estimators took over the summary percentiles.
+    summary = engine.metrics_summary()
+    assert summary["itl_p50_ms"] > 0 and summary["ttft_p50_ms"] > 0
+    assert not session.slo.active
+
+
+@pytest.mark.slow
+def test_bounded_result_retention_with_exact_rollups(params):
+    """`results` retains at most retain_results finished records, while
+    metrics_summary's counters/percentiles stay exact over every request
+    ever retired (rollup + streaming estimators, not the ring)."""
+    engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           queue_limit=32, retain_results=3)
+    rids = [engine.submit(ServeRequest(prompt=[i + 1, i + 2],
+                                       max_new_tokens=2))
+            for i in range(8)]
+    results = engine.run_until_idle()
+    assert len(results) == 3                       # ring bound
+    assert set(results) == set(rids[-3:])          # oldest evicted
+    summary = engine.metrics_summary()
+    assert summary["requests_completed"] == 8      # rollup is exact
+    assert summary["tokens_emitted"] == 16
+    assert summary["itl_p50_ms"] >= 0.0

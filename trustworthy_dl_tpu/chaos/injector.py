@@ -60,7 +60,9 @@ class FaultInjector:
     def _fire(self, event: FaultEvent, at_step: int) -> FaultEvent:
         self.fired.append(event)
         if self.trace is not None:
-            self.trace.emit("chaos_fault", step=at_step,
+            from trustworthy_dl_tpu.obs.events import EventType
+
+            self.trace.emit(EventType.CHAOS_FAULT, step=at_step,
                             kind=event.kind.value,
                             scheduled_step=event.step,
                             severity=event.severity)

@@ -140,6 +140,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.obs_dir,
             metrics_snapshot_every=args.metrics_snapshot_every,
         )
+        # Active plane: per-step spans (train.step → per-phase children)
+        # and the EWMA anomaly watcher on step-time/loss/grad-norm; no
+        # serving SLO rules on a training run, but the step_time_s
+        # percentile sketch still lands in slo_status.json.
+        obs_session.enable_spans()
+        obs_session.install_watchers(slo_rules=())
         trainer.attach_obs(obs_session)
     if args.resume:
         trainer.load_checkpoint()
@@ -398,9 +404,21 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "trustworthy-dl-train)")
     parser.add_argument("--obs-dir", type=str, default=None,
                         help="write serving telemetry here: trace.jsonl "
-                             "(request lifecycle events correlated by "
-                             "request id) + metrics snapshot/Prometheus "
-                             "export")
+                             "(request lifecycle events + spans "
+                             "correlated by request id), "
+                             "attribution.jsonl (per-request ledger: "
+                             "slot/blocks/weight-tier/verdict), "
+                             "slo_status.json, trace_events.json "
+                             "(Chrome/Perfetto timeline) + metrics "
+                             "snapshot/Prometheus export")
+    parser.add_argument("--slo-ttft-ms", type=float, default=2000.0,
+                        help="TTFT SLO target per request (needs "
+                             "--obs-dir); breaches emit slo_breach "
+                             "events, burn the tddl_slo_burn_rate gauge "
+                             "and shed lowest-priority admissions")
+    parser.add_argument("--slo-itl-ms", type=float, default=250.0,
+                        help="inter-token-latency SLO target (needs "
+                             "--obs-dir)")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -479,16 +497,27 @@ def serve_main(argv: Optional[List[str]] = None,
               "serving from random init")
 
     obs_session = None
+    extra = {}
     if args.obs_dir:
         from trustworthy_dl_tpu.obs import ObsSession
+        from trustworthy_dl_tpu.obs.slo import default_serve_rules
 
         obs_session = ObsSession(args.obs_dir)
+        obs_session.enable_spans()
+        obs_session.install_watchers(slo_rules=default_serve_rules(
+            ttft_target_s=args.slo_ttft_ms / 1e3,
+            itl_target_s=args.slo_itl_ms / 1e3,
+        ))
+        obs_session.open_ledger()
+        extra = dict(spans=obs_session.spans, ledger=obs_session.ledger,
+                     slo=obs_session.slo, anomaly=obs_session.anomaly)
     engine = ServingEngine.from_config(
         trainer.state.params, cfg, serve_config,
         enable_monitor=not args.no_monitor,
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
         registry=obs_session.registry if obs_session else None,
+        **extra,
     )
     if engine.kv_fallback_reason:
         print(f"kv_dtype={args.kv_dtype} fell back to the model dtype "
@@ -529,6 +558,14 @@ def serve_main(argv: Optional[List[str]] = None,
     if summary.get("quarantined_slots"):
         print(f"  quarantined slots: {summary['quarantined_slots']}")
     if obs_session is not None:
+        ok, problems = engine.verify_attribution()
+        print(f"attribution: {engine.ledger.total} record(s), "
+              f"block-lifecycle reconciliation "
+              f"{'OK' if ok else 'FAILED'}")
+        for p in problems[:5]:
+            print(f"  !! {p}")
+        if obs_session.slo.active:
+            print(f"SLO breaches active: {obs_session.slo.active}")
         obs_session.finalize()
         print(f"obs artifacts in {args.obs_dir}")
     trainer.cleanup()
@@ -575,6 +612,164 @@ def prepare_main(argv: Optional[List[str]] = None) -> int:
           + (f" + val split {info['val_path']}" if info["val_path"] else ""))
     print(f"tokenizer files in {info['tokenizer_dir']}")
     return 0
+
+
+def build_obs_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trustworthy-dl-obs",
+        description="Render an obs directory: tail/filter trace.jsonl by "
+                    "request/step id, convert spans to a Chrome/Perfetto "
+                    "timeline, pretty-print obs_report.json and the "
+                    "SLO/anomaly status.  With no action flags, prints a "
+                    "summary of everything the directory holds.",
+    )
+    parser.add_argument("obs_dir", type=str,
+                        help="directory a run wrote with --obs-dir")
+    parser.add_argument("--tail", type=int, default=None, metavar="N",
+                        help="print the last N trace events (after any "
+                             "filters)")
+    parser.add_argument("--request-id", type=int, default=None,
+                        help="only events correlated to this request id")
+    parser.add_argument("--step", type=int, default=None,
+                        help="only events correlated to this step id")
+    parser.add_argument("--type", type=str, default=None,
+                        help="only events of this type (e.g. span, "
+                             "anomaly, serve_retire)")
+    parser.add_argument("--chrome", type=str, default=None, metavar="OUT",
+                        help="convert the trace's span events to a Chrome/"
+                             "Perfetto trace_events JSON at OUT (load in "
+                             "chrome://tracing or ui.perfetto.dev)")
+    parser.add_argument("--report", action="store_true",
+                        help="pretty-print obs_report.json (step-time "
+                             "breakdown + MFU)")
+    parser.add_argument("--slo", action="store_true",
+                        help="print SLO burn rates / anomaly status "
+                             "(slo_status.json + snapshot gauges)")
+    return parser
+
+
+def obs_main(argv: Optional[List[str]] = None) -> int:
+    """Console entry point ``trustworthy-dl-obs`` — the reader side of
+    the obs directory (host-only; imports no jax)."""
+    import json
+    import os
+
+    from trustworthy_dl_tpu.obs.events import read_jsonl
+    from trustworthy_dl_tpu.obs.spans import chrome_trace_from_events
+
+    args = build_obs_parser().parse_args(argv)
+    if not os.path.isdir(args.obs_dir):
+        print(f"no such obs directory: {args.obs_dir}")
+        return 2
+    trace_path = os.path.join(args.obs_dir, "trace.jsonl")
+    events = read_jsonl(trace_path) if os.path.exists(trace_path) else []
+
+    filtered = events
+    if args.request_id is not None:
+        filtered = [e for e in filtered
+                    if e.get("request_id") == args.request_id]
+    if args.step is not None:
+        filtered = [e for e in filtered if e.get("step") == args.step]
+    if args.type is not None:
+        filtered = [e for e in filtered if e.get("type") == args.type]
+
+    acted = False
+    if args.tail is not None or args.request_id is not None \
+            or args.step is not None or args.type is not None:
+        acted = True
+        for e in filtered[-(args.tail or 20):]:
+            print(json.dumps(e))
+    if args.chrome is not None:
+        acted = True
+        payload = chrome_trace_from_events(events, args.chrome)
+        print(f"wrote {len(payload['traceEvents'])} span event(s) to "
+              f"{args.chrome}")
+    if args.report:
+        acted = True
+        path = os.path.join(args.obs_dir, "obs_report.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                print(json.dumps(json.load(f), indent=2))
+        else:
+            print(f"no obs_report.json under {args.obs_dir}")
+    if args.slo:
+        acted = True
+        _print_slo_status(args.obs_dir)
+    if not acted:
+        _print_obs_summary(args.obs_dir, events)
+    return 0
+
+
+def _print_slo_status(obs_dir: str) -> None:
+    import json
+    import os
+
+    path = os.path.join(obs_dir, "slo_status.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            status = json.load(f)
+        for rule in status.get("slo", {}).get("rules", ()):
+            flag = " BREACHED" if rule["active"] else ""
+            print(f"  slo {rule['name']:<12} ({rule['signal']} <= "
+                  f"{rule['target']:g}): burn {rule['burn_rate']:.2f}"
+                  f"{flag}")
+        anomaly = status.get("anomaly", {})
+        if anomaly:
+            print(f"  anomaly events: {anomaly.get('event_total', 0)}, "
+                  f"active: {anomaly.get('active', [])}")
+        return
+    # Fall back to the burn-rate gauges in the metrics snapshot (a run
+    # that died before finalize still snapshotted on cadence).
+    snap_path = os.path.join(obs_dir, "metrics_snapshot.json")
+    if not os.path.exists(snap_path):
+        print(f"  no slo_status.json or metrics_snapshot.json under "
+              f"{obs_dir}")
+        return
+    with open(snap_path) as f:
+        snap = json.load(f)
+    for name in ("tddl_slo_burn_rate", "tddl_anomaly_active"):
+        metric = snap.get("metrics", {}).get(name)
+        if not metric:
+            continue
+        for row in metric.get("series", ()):
+            labels = ",".join(f"{k}={v}" for k, v in row["labels"].items())
+            print(f"  {name}{{{labels}}} = {row['value']}")
+
+
+def _print_obs_summary(obs_dir: str, events: list) -> None:
+    import json
+    import os
+
+    print(f"obs dir: {obs_dir}")
+    counts: dict = {}
+    for e in events:
+        counts[e.get("type", "?")] = counts.get(e.get("type", "?"), 0) + 1
+    if counts:
+        print(f"trace.jsonl: {len(events)} event(s)")
+        for etype, n in sorted(counts.items()):
+            print(f"  {etype}: {n}")
+    report_path = os.path.join(obs_dir, "obs_report.json")
+    if os.path.exists(report_path):
+        with open(report_path) as f:
+            report = json.load(f)
+        line = f"obs_report.json: {report.get('num_steps', 0)} step(s)"
+        mfu = report.get("mfu", {})
+        if isinstance(mfu, dict) and mfu.get("mfu") is not None:
+            line += f", MFU {mfu['mfu']:.1%} ({mfu['peak_flops_source']})"
+        print(line)
+    ledger_path = os.path.join(obs_dir, "attribution.jsonl")
+    if os.path.exists(ledger_path):
+        from trustworthy_dl_tpu.obs.attribution import read_ledger
+
+        _, records = read_ledger(ledger_path)
+        flagged = sum(1 for r in records if r.get("flagged"))
+        print(f"attribution.jsonl: {len(records)} record(s), "
+              f"{flagged} flagged")
+    _print_slo_status(obs_dir)
+    dumps = sorted(p for p in os.listdir(obs_dir)
+                   if p.startswith("flight_") and p.endswith(".json"))
+    if dumps:
+        print(f"flight dumps: {', '.join(dumps)}")
 
 
 if __name__ == "__main__":

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trustworthy_dl_tpu.obs.events import EventType
+
 logger = logging.getLogger(__name__)
 
 
@@ -360,7 +362,8 @@ class CheckpointManager:
             step
         ):
             if self.trace is not None:
-                self.trace.emit("ckpt_commit", step=step, committed=False,
+                self.trace.emit(EventType.CKPT_COMMIT, step=step,
+                                committed=False,
                                 reason="chaos_crash_before_commit")
             return  # drill: died pre-COMMIT — payload left uncommitted
         if target != final:
@@ -375,7 +378,8 @@ class CheckpointManager:
         self._write_manifest(step, final)
         _unlink(self._inflight_path(step))
         if self.trace is not None:
-            self.trace.emit("ckpt_commit", step=step, committed=True)
+            self.trace.emit(EventType.CKPT_COMMIT, step=step,
+                            committed=True)
         if self.chaos is not None:
             self.chaos.on_checkpoint_saved(step, final)
 

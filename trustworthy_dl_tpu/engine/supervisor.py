@@ -200,6 +200,19 @@ class TrainingSupervisor:
                 grad_norm=float(np.asarray(metrics.grad_norm)),
                 finite_nodes=int(np.asarray(metrics.finite).sum()),
             )
+            if getattr(self.obs, "anomaly", None) is not None:
+                # Rejected steps never reach the trainer's accepted-step
+                # feed — route the bad observations (NaN loss IS the
+                # anomaly) to the watcher here so the incident flips
+                # tddl_anomaly_active and dumps the flight recorder.
+                self.obs.anomaly.observe(
+                    "loss", float(np.asarray(metrics.loss)),
+                    step=trainer.global_step,
+                )
+                self.obs.anomaly.observe(
+                    "grad_norm", float(np.asarray(metrics.grad_norm)),
+                    step=trainer.global_step,
+                )
         self._counters.inc(action="guard_trip")
         for attempt in range(retries):
             self.retries += 1
@@ -411,6 +424,18 @@ class TrainingSupervisor:
             out["faults_fired"] = counts
             out["dropped_batches"] = counts.get("data_loss", 0)
             out["stalls"] = counts.get("stall", 0)
+        # Watcher consultation (obs/anomaly.py, obs/slo.py): the report a
+        # fleet controller reads carries what is CURRENTLY anomalous /
+        # burning budget, not just lifetime counters.
+        if self.obs is not None:
+            anomaly = getattr(self.obs, "anomaly", None)
+            if anomaly is not None:
+                out["anomalies_active"] = anomaly.active
+                out["anomaly_events"] = anomaly.event_total
+            slo = getattr(self.obs, "slo", None)
+            if slo is not None:
+                out["slo_breaches_active"] = slo.active
+                out["slo_breach_total"] = slo.breach_total
         return out
 
     # -- signals -----------------------------------------------------------

@@ -752,7 +752,7 @@ class DistributedTrainer:
                             # would poison the phase distribution.
                             timer.discard_step()
                         else:
-                            timer.finish_step()
+                            timer.finish_step(step=self.global_step)
                         self.obs.on_step(self.global_step)
                     continue
 
@@ -786,7 +786,7 @@ class DistributedTrainer:
                     self.save_checkpoint()
                 if timer is not None:
                     timer.lap("checkpoint")
-                    timer.finish_step()
+                    timer.finish_step(step=self.global_step)
                     self.obs.on_step(self.global_step)
                 if batch_idx % 10 == 0:
                     logger.info("Epoch %d, Batch %d, Loss: %.4f",
@@ -848,12 +848,23 @@ class DistributedTrainer:
         trust = np.asarray(metrics.trust_scores)
         id_of = self.node_map  # coordinate -> original node id
         if self.obs is not None:
+            grad_norm = float(np.asarray(metrics.grad_norm))
             self.obs.trace.emit(
                 EventType.TRAIN_STEP, step=self.global_step, epoch=epoch,
                 loss=loss,
-                grad_norm=float(np.asarray(metrics.grad_norm)),
+                grad_norm=grad_norm,
                 system_trust=float(np.asarray(metrics.system_trust)),
             )
+            if self.obs.anomaly is not None:
+                # Anomaly watcher feed: only guard-ACCEPTED steps reach
+                # this path, so the EWMA baseline is the healthy run —
+                # drift/spikes that pass the (non-finite-only) guard
+                # still flag here; NaNs reach the watcher through the
+                # supervisor's guard-trip feed instead.
+                self.obs.anomaly.observe("loss", loss,
+                                         step=self.global_step)
+                self.obs.anomaly.observe("grad_norm", grad_norm,
+                                         step=self.global_step)
             # Trust-state transitions: emitted on CHANGE (keyed by
             # original identity), not per step — the trace stays joinable
             # on step id without carrying n gauges per row.

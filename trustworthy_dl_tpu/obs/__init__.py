@@ -24,11 +24,32 @@ Four pieces, composable separately and bundled by :class:`ObsSession`:
   utilization (MFU), written as ``obs_report.json``; also the shared
   ``run_metadata()`` stamp every experiment artifact carries.
 
+Since the active-plane PR the package also WATCHES what it records:
+
+* :mod:`obs.spans` — hierarchical request/step spans emitted through the
+  trace bus, exportable as a Chrome/Perfetto timeline;
+* :mod:`obs.attribution` — the per-request attribution ledger
+  (replica/slot/blocks/weight-tier/verdict per served stream) +
+  ``verify_attribution`` against the block allocator's journal;
+* :mod:`obs.slo` — bounded-memory P² percentile estimators and
+  declarative target/window/burn-rate SLO rules
+  (``tddl_slo_burn_rate{slo=}``);
+* :mod:`obs.anomaly` — EWMA/z-score anomaly detection on step-time /
+  loss / grad-norm / ITL (``tddl_anomaly_active{signal=}``), with
+  flight-recorder dumps on breach/anomaly episodes.
+
 Metric naming convention: ``tddl_<subsystem>_<what>[_unit]`` —
 e.g. ``tddl_train_loss``, ``tddl_serve_ttft_seconds``,
 ``tddl_supervisor_rollbacks_total``.
 """
 
+from trustworthy_dl_tpu.obs.anomaly import AnomalyWatcher, EwmaDetector
+from trustworthy_dl_tpu.obs.attribution import (
+    AttributionLedger,
+    read_ledger,
+    token_hash,
+    verify_attribution,
+)
 from trustworthy_dl_tpu.obs.events import EVENT_SCHEMAS, EventType, TraceBus
 from trustworthy_dl_tpu.obs.meta import run_metadata
 from trustworthy_dl_tpu.obs.recorder import FlightRecorder
@@ -39,18 +60,42 @@ from trustworthy_dl_tpu.obs.registry import (
 from trustworthy_dl_tpu.obs.report import PHASES, StepTimeReporter, \
     mfu_from_throughput, peak_flops_per_chip
 from trustworthy_dl_tpu.obs.session import ObsSession
+from trustworthy_dl_tpu.obs.slo import (
+    P2Quantile,
+    SLORule,
+    SLOWatcher,
+    StreamingPercentiles,
+    default_serve_rules,
+)
+from trustworthy_dl_tpu.obs.spans import (
+    SpanTracker,
+    chrome_trace_from_events,
+)
 
 __all__ = [
+    "AnomalyWatcher",
+    "AttributionLedger",
     "EVENT_SCHEMAS",
     "EventType",
+    "EwmaDetector",
     "FlightRecorder",
     "MetricsRegistry",
     "ObsSession",
+    "P2Quantile",
     "PHASES",
+    "SLORule",
+    "SLOWatcher",
+    "SpanTracker",
     "StepTimeReporter",
+    "StreamingPercentiles",
     "TraceBus",
+    "chrome_trace_from_events",
+    "default_serve_rules",
     "get_registry",
     "mfu_from_throughput",
     "peak_flops_per_chip",
+    "read_ledger",
     "run_metadata",
+    "token_hash",
+    "verify_attribution",
 ]

@@ -1,0 +1,208 @@
+"""Per-request attribution ledger: which replica, slot, physical KV
+blocks, weight tier and monitor verdict produced each served stream.
+
+The trust claim on the serving side is only auditable if a finished
+token stream can be traced back to the physical state that produced it.
+At stream completion the engine appends ONE durable record per request:
+
+.. code-block:: json
+
+    {"request_id": 7, "status": "completed", "admitted": true,
+     "slot": 2, "layout": "paged",
+     "block_ids": [3, 9, 14], "prefix_block_ids": [3],
+     "prefix_publishers": {"3": 1},
+     "kv_dtype": "int8", "weight_dtype": "model",
+     "kv_fallback_reason": null,
+     "flagged": false, "monitor_z": 0.41,
+     "tokens": 12, "token_hash": "a3f0c2...", "t": 1722700000.1}
+
+appended as JSONL beside the trace (``attribution.jsonl``; the first
+line is a header carrying the replica's ``run_metadata`` once, not per
+record), mirrored as a compact ``attribution`` trace-bus event, and
+retained in a bounded in-memory ring for :func:`verify_attribution` —
+which cross-checks the recorded block ids against the
+``BlockAllocator``'s lifecycle journal (every claimed block was really
+allocated; references never went negative; an unreferenced block is on
+the free list or quarantined, never limbo).
+
+``token_hash`` is a sha256 over the emitted int32 token ids — cheap
+evidence two replicas (or a replay) produced the same stream without
+shipping the stream itself.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def token_hash(tokens: Sequence[int]) -> str:
+    """sha256 hex digest of the token-id stream (int32 little-endian)."""
+    arr = np.asarray(list(tokens), np.int32)
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+class AttributionLedger:
+    """Durable JSONL sink + bounded in-memory ring of per-request
+    attribution records.
+
+    ``path=None`` is the in-memory mode (tests, in-process fleets); the
+    ring (``keep``) bounds host memory under the million-user framing —
+    the FILE is the durable record, the ring is the working set
+    ``verify_attribution`` and the monitor drills read."""
+
+    def __init__(self, path: Optional[str] = None, keep: int = 4096,
+                 run_meta: Optional[Dict[str, Any]] = None):
+        self.path = str(path) if path else None
+        self._ring: collections.deque = collections.deque(maxlen=keep)
+        self._lock = threading.Lock()
+        self._file: Any = None
+        self._closed = False
+        self.total = 0
+        if self.path is not None:
+            if run_meta is None:
+                from trustworthy_dl_tpu.obs.meta import run_metadata
+
+                run_meta = run_metadata()
+            self._file = open(self.path, "a", buffering=1)
+            self._file.write(json.dumps(
+                {"header": True, "run_metadata": run_meta}
+            ) + "\n")
+
+    def append(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        record = dict(record)
+        record.setdefault("t", time.time())
+        with self._lock:
+            self.total += 1
+            self._ring.append(record)
+            if self._file is not None and not self._closed:
+                self._file.write(json.dumps(record) + "\n")
+        return record
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def read_ledger(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Load a ledger file back as ``(header, records)``."""
+    header: Dict[str, Any] = {}
+    records: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("header"):
+                header = row
+            else:
+                records.append(row)
+    return header, records
+
+
+def verify_attribution(records: Iterable[Dict[str, Any]],
+                       allocator: Any) -> Tuple[bool, List[str]]:
+    """Cross-check ledger records against a ``BlockAllocator``'s
+    lifecycle journal.  Returns ``(ok, problems)``.
+
+    Checks per admitted paged record:
+
+    * block ids are unique, in ``[1, num_blocks]`` and never the trash
+      block (a record claiming block 0 would mean a request attended to
+      the garbage sink);
+    * every claimed block has at least one ``alloc`` journal entry (it
+      physically existed in the pool's handed-out set);
+    * per block, lifetime releases never exceed lifetime
+      ``alloc + incref`` references (no double free slipped through);
+    * a block no longer referenced is on the free list or quarantined —
+      never in limbo (the allocator's own invariant, asserted from the
+      outside).
+
+    Stripe-layout records only carry a slot id (no block pool); they
+    verify as ``slot >= 0``.
+    """
+    problems: List[str] = []
+    allocs: Dict[int, int] = {}
+    refs: Dict[int, int] = {}
+    releases: Dict[int, int] = {}
+    lifetime = getattr(allocator, "lifetime", None)
+    if lifetime is not None:
+        # Exact cumulative per-block counts (bounded by pool size, never
+        # by run length) — the ring journal would false-positive "never
+        # allocated" once a long-pinned block's entry rotated out.
+        for block, counts in lifetime.items():
+            allocs[block] = counts.get("alloc", 0)
+            refs[block] = counts.get("alloc", 0) + counts.get("incref", 0)
+            releases[block] = counts.get("release", 0)
+    else:
+        for entry in getattr(allocator, "journal", ()):
+            op, block = entry[0], entry[1]
+            if op == "alloc":
+                allocs[block] = allocs.get(block, 0) + 1
+                refs[block] = refs.get(block, 0) + 1
+            elif op == "incref":
+                refs[block] = refs.get(block, 0) + 1
+            elif op == "release":
+                releases[block] = releases.get(block, 0) + 1
+            # "unquarantine" re-enters the free pool without dropping a
+            # reference — it does not change the accounting.
+
+    for rec in records:
+        rid = rec.get("request_id")
+        if not rec.get("admitted", True):
+            continue  # never touched a slot or block
+        if rec.get("layout") == "stripe":
+            if rec.get("slot", -1) < 0:
+                problems.append(f"request {rid}: stripe record without a "
+                                "slot id")
+            continue
+        blocks = rec.get("block_ids") or []
+        if len(set(blocks)) != len(blocks):
+            problems.append(f"request {rid}: duplicate block ids {blocks}")
+        prefix = set(rec.get("prefix_block_ids") or [])
+        if not prefix <= set(blocks):
+            problems.append(f"request {rid}: prefix blocks {sorted(prefix)} "
+                            f"not a subset of its table {blocks}")
+        num_blocks = getattr(allocator, "num_blocks", None)
+        for b in blocks:
+            if b == 0:
+                problems.append(f"request {rid}: claims the trash block")
+                continue
+            if num_blocks is not None and not 1 <= b <= num_blocks:
+                problems.append(f"request {rid}: block {b} outside the "
+                                f"pool [1, {num_blocks}]")
+                continue
+            if allocs.get(b, 0) < 1:
+                problems.append(f"request {rid}: block {b} was never "
+                                "allocated per the journal")
+            if releases.get(b, 0) > refs.get(b, 0):
+                problems.append(f"request {rid}: block {b} released "
+                                f"{releases[b]}x with only "
+                                f"{refs.get(b, 0)} references")
+
+    # Allocator-side invariant: an unreferenced block must be free or
+    # quarantined (never limbo).  Only checkable for real allocators.
+    free = getattr(allocator, "_free", None)
+    ref_now = getattr(allocator, "_ref", None)
+    quarantined = getattr(allocator, "quarantined", set())
+    num_blocks = getattr(allocator, "num_blocks", None)
+    if free is not None and ref_now is not None and num_blocks is not None:
+        for b in range(1, num_blocks + 1):
+            if b not in ref_now and b not in free and b not in quarantined:
+                problems.append(f"block {b} is unreferenced but neither "
+                                "free nor quarantined")
+    return not problems, problems

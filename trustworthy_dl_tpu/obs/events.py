@@ -63,6 +63,12 @@ class EventType(str, enum.Enum):
     SERVE_ADMIT = "serve_admit"
     SERVE_RETIRE = "serve_retire"
     SERVE_QUARANTINE = "serve_quarantine"
+    # Active observability plane (obs/spans.py, slo.py, anomaly.py,
+    # attribution.py)
+    SPAN = "span"
+    SLO_BREACH = "slo_breach"
+    ANOMALY = "anomaly"
+    ATTRIBUTION = "attribution"
 
 
 #: type -> {"requires": base correlation keys, "fields": required extras}.
@@ -109,6 +115,15 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
                              "fields": ("status", "tokens")},
     EventType.SERVE_QUARANTINE: {"requires": ("request_id",),
                                  "fields": ("slot",)},
+    # Spans correlate on whichever key their workload carries (a train
+    # span has a step, a serve span a request id) — neither is required.
+    EventType.SPAN: {"requires": (),
+                     "fields": ("name", "kind", "span_id", "duration_s")},
+    EventType.SLO_BREACH: {"requires": (),
+                           "fields": ("slo", "signal", "burn_rate")},
+    EventType.ANOMALY: {"requires": (), "fields": ("signal", "zscore")},
+    EventType.ATTRIBUTION: {"requires": ("request_id",),
+                            "fields": ("slot", "n_blocks", "token_hash")},
 }
 
 

@@ -125,7 +125,15 @@ class StepTimeReporter:
             maxlen=max_steps
         )
         self._current: Dict[str, float] = {}
+        self._laps: list = []          # (phase, start, end) this step
         self._mark: Optional[float] = None
+        #: Optional obs.spans.SpanTracker: when attached (ObsSession
+        #: enable_spans), finish_step synthesizes a ``train.step`` span
+        #: plus one child per recorded lap from the SAME perf_counter
+        #: marks the phase accounting used — the trainer loop needs no
+        #: extra instrumentation for its timeline.
+        self.spans: Any = None
+        self.last_step_total: Optional[float] = None
         self.n_params: Optional[int] = None
         self.tokens_per_step: Optional[int] = None
         self.model_kind: str = "lm"
@@ -159,6 +167,7 @@ class StepTimeReporter:
         if self._mark is not None:
             self._current[phase] = self._current.get(phase, 0.0) \
                 + (now - self._mark)
+            self._laps.append((phase, self._mark, now))
         self._mark = now
 
     @contextmanager
@@ -170,27 +179,44 @@ class StepTimeReporter:
         try:
             yield
         finally:
-            self._current[name] = self._current.get(name, 0.0) \
-                + (time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self._current[name] = self._current.get(name, 0.0) + (t1 - t0)
+            self._laps.append((name, t0, t1))
             self._mark = time.perf_counter()
 
-    def finish_step(self) -> None:
+    def finish_step(self, step: Optional[int] = None) -> None:
         record = self._current
+        laps = self._laps
         self._current = {}
+        self._laps = []
         self._mark = time.perf_counter()
         if not record:
             return
         record["_total"] = sum(record.values())
+        self.last_step_total = record["_total"]
         self._steps.append(record)
         if self._phase_hist is not None:
             for phase, seconds in record.items():
                 if not phase.startswith("_"):
                     self._phase_hist.observe(seconds, phase=phase)
+        if self.spans is not None and laps:
+            # One root span per accounted step, one child per lap, all
+            # from the marks the phase accounting already took — the
+            # Chrome timeline and obs_report.json agree by construction.
+            root = self.spans.add(
+                "train.step", laps[0][1], laps[-1][2], kind="train",
+                step=step,
+            )
+            for phase, t0, t1 in laps:
+                self.spans.add(f"train.{phase}", t0, t1, kind="train",
+                               parent_id=root.span_id, step=step)
 
     def discard_step(self) -> None:
         """Drop the accumulating step (rejected/retried — its duration
         would poison the per-phase distribution)."""
         self._current = {}
+        self._laps = []
+        self.last_step_total = None  # nothing fresh for watcher feeds
         self._mark = time.perf_counter()
 
     @property

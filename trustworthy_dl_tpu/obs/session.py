@@ -47,6 +47,11 @@ from trustworthy_dl_tpu.obs.report import StepTimeReporter
 
 logger = logging.getLogger(__name__)
 
+#: Extra artifacts the active plane adds under ``obs_dir``:
+#: ``attribution.jsonl`` (per-request attribution ledger),
+#: ``slo_status.json`` (SLO/anomaly watcher rollup at finalize),
+#: ``trace_events.json`` (Chrome/Perfetto span timeline at finalize).
+
 
 class ObsSession:
     def __init__(self, obs_dir: Optional[str] = None, *,
@@ -69,12 +74,72 @@ class ObsSession:
         self.step_timer = StepTimeReporter(registry=self.registry)
         self.metrics_snapshot_every = int(metrics_snapshot_every)
         self._finalized = False
+        # Active-plane attachments (None until enabled — the passive
+        # recorder stays exactly as cheap as before).
+        self.spans: Any = None            # obs.spans.SpanTracker
+        self.slo: Any = None              # obs.slo.SLOWatcher
+        self.anomaly: Any = None          # obs.anomaly.AnomalyWatcher
+        self.ledger: Any = None           # obs.attribution.AttributionLedger
         self.trace.emit(EventType.RUN_START, obs_dir=self.obs_dir)
+
+    # -- active plane ------------------------------------------------------
+
+    def enable_spans(self) -> Any:
+        """Attach a SpanTracker to the trace bus AND the step timer (the
+        trainer's per-phase laps become ``train.*`` spans for free)."""
+        if self.spans is None:
+            from trustworthy_dl_tpu.obs.spans import SpanTracker
+
+            self.spans = SpanTracker(trace=self.trace)
+            self.step_timer.spans = self.spans
+        return self.spans
+
+    def install_watchers(self, slo_rules: Any = None,
+                         anomaly_signals: Any = None) -> tuple:
+        """Construct the SLO and anomaly watchers wired to this
+        session's trace/registry/flight-recorder.  ``slo_rules`` default
+        to :func:`obs.slo.default_serve_rules`; ``anomaly_signals`` to
+        :data:`obs.anomaly.DEFAULT_SIGNALS`.  Returns ``(slo, anomaly)``
+        (idempotent — repeated calls return the existing watchers)."""
+        from trustworthy_dl_tpu.obs.anomaly import AnomalyWatcher
+        from trustworthy_dl_tpu.obs.slo import SLOWatcher, \
+            default_serve_rules
+
+        if self.slo is None:
+            self.slo = SLOWatcher(
+                default_serve_rules() if slo_rules is None else slo_rules,
+                registry=self.registry, trace=self.trace,
+                dump=self.dump_flight,
+            )
+        if self.anomaly is None:
+            self.anomaly = AnomalyWatcher(
+                anomaly_signals, registry=self.registry, trace=self.trace,
+                dump=self.dump_flight,
+            )
+        return self.slo, self.anomaly
+
+    def open_ledger(self, keep: int = 4096) -> Any:
+        """Open the per-request attribution ledger (JSONL beside the
+        trace when ``obs_dir`` is set; in-memory ring otherwise)."""
+        if self.ledger is None:
+            from trustworthy_dl_tpu.obs.attribution import AttributionLedger
+
+            self.ledger = AttributionLedger(
+                os.path.join(self.obs_dir, "attribution.jsonl")
+                if self.obs_dir else None, keep=keep,
+            )
+        return self.ledger
 
     # -- cadence hooks -----------------------------------------------------
 
     def on_step(self, step: int) -> None:
         """Called by the trainer once per accounted step."""
+        total = self.step_timer.last_step_total
+        if total is not None:
+            if self.anomaly is not None:
+                self.anomaly.observe("step_time", total, step=step)
+            if self.slo is not None:
+                self.slo.observe("step_time_s", total, step=step)
         if (self.metrics_snapshot_every > 0
                 and step % self.metrics_snapshot_every == 0):
             self.snapshot_metrics(step=step)
@@ -120,12 +185,38 @@ class ObsSession:
                     report.get("num_steps", 0))
         return report
 
+    def write_slo_status(self) -> Optional[Dict[str, Any]]:
+        """Watcher rollup (SLO burn + anomaly baselines) as
+        ``slo_status.json`` — what the obs CLI pretty-prints."""
+        if self.slo is None and self.anomaly is None:
+            return None
+        status: Dict[str, Any] = {}
+        if self.slo is not None:
+            status["slo"] = self.slo.status()
+        if self.anomaly is not None:
+            status["anomaly"] = self.anomaly.status()
+        if self.obs_dir:
+            import json
+
+            with open(os.path.join(self.obs_dir, "slo_status.json"),
+                      "w") as f:
+                json.dump(status, f, indent=2)
+        return status
+
     def finalize(self) -> None:
-        """Final snapshot + report + close the trace file.  Idempotent."""
+        """Final snapshot + report + active-plane artifacts + close the
+        trace file.  Idempotent."""
         if self._finalized:
             return
         self._finalized = True
         self.snapshot_metrics()
         self.write_report()
+        self.write_slo_status()
+        if self.spans is not None and self.obs_dir:
+            self.spans.export_chrome(
+                os.path.join(self.obs_dir, "trace_events.json")
+            )
+        if self.ledger is not None:
+            self.ledger.close()
         self.trace.emit(EventType.RUN_END)  # last event in the trace
         self.trace.close()

@@ -41,6 +41,7 @@ import numpy as np
 from trustworthy_dl_tpu.detect import baseline as bl
 from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.obs import attribution
 from trustworthy_dl_tpu.obs.events import EventType
 from trustworthy_dl_tpu.obs.registry import get_registry
 from trustworthy_dl_tpu.quant import int8 as q8
@@ -65,7 +66,10 @@ class ServeRequest:
     """One generation request.  ``temperature<=0`` decodes greedily;
     ``deadline_s`` is a relative wall-clock budget from submit time (the
     request retires mid-flight with whatever it has when it expires);
-    ``on_token`` streams each token as ``on_token(request_id, token)``."""
+    ``on_token`` streams each token as ``on_token(request_id, token)``;
+    ``priority`` orders load shedding under an SLO breach — when the
+    attached watcher is burning budget, the LOWEST-priority queued
+    requests are shed first (ties: newest first)."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -74,6 +78,7 @@ class ServeRequest:
     deadline_s: Optional[float] = None
     rng: Optional[jax.Array] = None
     on_token: Optional[Callable[[int, int], None]] = None
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -156,7 +161,10 @@ class ServingEngine:
                  paged: bool = True, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 spans: Any = None, ledger: Any = None,
+                 slo: Any = None, anomaly: Any = None,
+                 retain_results: int = 1024):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -332,6 +340,44 @@ class ServingEngine:
         self._iteration = 0
         self._tokens_emitted = 0
         self._t_start: Optional[float] = None
+        # -- active observability plane (all optional, all host-only) --
+        # ``spans``: obs.spans.SpanTracker — request/phase timeline.
+        # ``ledger``: obs.attribution.AttributionLedger — one durable
+        # record per retired request.  ``slo``/``anomaly``: the
+        # streaming watchers; when the SLO watcher is burning budget
+        # (or an anomaly is active) the admission path sheds the
+        # lowest-priority queued requests.  None of these touch the
+        # device programs — streams stay bit-identical with all four
+        # attached (pinned by tests).
+        self.spans = spans
+        self.ledger = ledger
+        self.slo = slo
+        self.anomaly = anomaly
+        self.scheduler.spans = spans
+        self._req_spans: Dict[int, Dict[str, int]] = {}  # rid -> open ids
+        # Bounded completed-request retention: ``results`` keeps at most
+        # ``retain_results`` finished records (oldest evicted first);
+        # the rollup counters + streaming percentile estimators below
+        # keep ``metrics_summary`` exact over EVERY request ever
+        # retired, evicted or not.
+        if retain_results < 1:
+            raise ValueError("retain_results must be >= 1")
+        self.retain_results = retain_results
+        self._status_counts: Dict[str, int] = {}
+        self._flagged_total = 0
+        # An attached SLO watcher already keeps P² sketches of the same
+        # ttft_s/itl_s streams — own a second pair only when unwatched,
+        # and read whichever exists in metrics_summary (one marker set
+        # per signal, one p50 for both summary and slo_status.json).
+        if slo is None:
+            from trustworthy_dl_tpu.obs.slo import StreamingPercentiles
+
+            self._ttft_est = StreamingPercentiles()
+            self._itl_est = StreamingPercentiles()
+        else:
+            self._ttft_est = None
+            self._itl_est = None
+        self.shed_slo = 0
 
     @classmethod
     def from_config(cls, params: Any, cfg: gpt2.GPT2Config,
@@ -404,7 +450,102 @@ class ServingEngine:
             self.trace.emit(EventType.SERVE_SUBMIT, request_id=request_id,
                             prompt_len=int(prompt.size),
                             max_new_tokens=int(request.max_new_tokens))
+        if self.spans is not None:
+            root = self.spans.start("serve.request", kind="serve",
+                                    request_id=request_id,
+                                    prompt_len=int(prompt.size),
+                                    max_new_tokens=int(
+                                        request.max_new_tokens))
+            queued = self.spans.start("serve.queued", kind="serve",
+                                      parent_id=root,
+                                      request_id=request_id)
+            self._req_spans[request_id] = {"root": root, "queued": queued}
         return request_id
+
+    # -- terminal bookkeeping ----------------------------------------------
+
+    def _record_result(self, result: ServeResult) -> None:
+        """The ONE rollup path every terminal state goes through: status
+        counters (exact forever), bounded ``results`` retention (oldest
+        evicted first), registry counter."""
+        self._status_counts[result.status] = \
+            self._status_counts.get(result.status, 0) + 1
+        if result.flagged:
+            self._flagged_total += 1
+        self.results[result.request_id] = result
+        while len(self.results) > self.retain_results:
+            del self.results[next(iter(self.results))]
+        self._req_counter.inc(status=result.status)
+
+    def _close_request_spans(self, rid: int, status: str,
+                             **attrs: Any) -> None:
+        handles = self._req_spans.pop(rid, None)
+        if handles is None or self.spans is None:
+            return
+        for name in ("queued", "prefill", "decode", "monitor"):
+            sid = handles.get(name)
+            if sid is not None:
+                self.spans.end(sid)
+        self.spans.end(handles["root"], status=status, **attrs)
+
+    def _span_first_token(self, rid: int) -> None:
+        """prefill → decode span transition at the request's first
+        emitted token."""
+        handles = self._req_spans.get(rid)
+        if handles is None or self.spans is None:
+            return
+        sid = handles.pop("prefill", None)
+        if sid is not None:
+            self.spans.end(sid)
+        handles["decode"] = self.spans.start(
+            "serve.decode", kind="serve", parent_id=handles["root"],
+            request_id=rid,
+        )
+
+    def _ledger_unadmitted(self, rid: int, status: str) -> None:
+        if self.ledger is None:
+            return
+        self.ledger.append({
+            "request_id": rid, "status": status, "admitted": False,
+            "slot": -1, "layout": "paged" if self.paged else "stripe",
+            "block_ids": [], "prefix_block_ids": [],
+            "prefix_publishers": {},
+            "kv_dtype": self.kv_dtype, "weight_dtype": self.weight_dtype,
+            "kv_fallback_reason": self.kv_fallback_reason,
+            "flagged": False, "monitor_z": 0.0,
+            "tokens": 0, "token_hash": attribution.token_hash([]),
+        })
+
+    def _shed_for_slo(self) -> None:
+        """The watcher's host-side shed hook: while an SLO rule is
+        burning budget (or an anomaly is active), drop the
+        LOWEST-priority queued request (ties: newest first) — but only
+        when the queue exceeds the currently free capacity, so shedding
+        relieves real pressure instead of burning goodput.  At most one
+        shed per iteration: pressure is re-evaluated every step."""
+        breached = ((self.slo is not None and self.slo.breached)
+                    or (self.anomaly is not None
+                        and self.anomaly.any_active))
+        if not breached or not self._queue:
+            return
+        if len(self._queue) <= self.scheduler.allocator.free_count:
+            return
+        idx = min(range(len(self._queue)),
+                  key=lambda i: (self._queue[i][1].priority, -i))
+        task, _request = self._queue[idx]
+        del self._queue[idx]
+        rid = task.request_id
+        self._submit_t.pop(rid, None)
+        self.shed_slo += 1
+        self._record_result(ServeResult(
+            request_id=rid, tokens=[], status="shed_slo", ttft_s=None,
+            itl_s=[],
+        ))
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
+                            status="shed_slo", tokens=0, admitted=False)
+        self._close_request_spans(rid, "shed_slo")
+        self._ledger_unadmitted(rid, "shed_slo")
 
     # -- iteration loop ----------------------------------------------------
 
@@ -416,6 +557,7 @@ class ServingEngine:
             self._t_start = now
         self._iteration += 1
         self._expire_queued(now)
+        self._shed_for_slo()
 
         # Admit as many queued requests as there are free slots.  On the
         # stripe path each admission prefetches the first token
@@ -435,18 +577,39 @@ class ServingEngine:
             if self.trace is not None:
                 self.trace.emit(EventType.SERVE_ADMIT, request_id=rid,
                                 slot=int(task.slot))
+            handles = self._req_spans.get(rid)
+            if handles is not None:
+                sid = handles.pop("queued", None)
+                if sid is not None:
+                    self.spans.end(sid, slot=int(task.slot))
+                handles["prefill"] = self.spans.start(
+                    "serve.prefill", kind="serve",
+                    parent_id=handles["root"], request_id=rid,
+                    slot=int(task.slot),
+                )
             if task.emitted:
                 self._timing[rid] = [time.perf_counter()]
+                self._span_first_token(rid)
                 self._stream(request, rid, task.emitted[-1])
                 emitted += 1
                 if task.done:
                     self._finish(task, request, "completed")
-        for task in self.scheduler.decode_tick():
+        t_tick = time.perf_counter()
+        ticked = self.scheduler.decode_tick()
+        if self.spans is not None and ticked:
+            self.spans.add("serve.decode_tick", t_tick,
+                           time.perf_counter(), kind="serve",
+                           tokens=len(ticked),
+                           active=self.scheduler.active_count)
+        for task in ticked:
             rid = task.request_id
             if rid not in self._inflight:
                 continue
             _, request = self._inflight[rid]
-            self._timing.setdefault(rid, []).append(time.perf_counter())
+            times = self._timing.setdefault(rid, [])
+            if not times:
+                self._span_first_token(rid)
+            times.append(time.perf_counter())
             self._stream(request, rid, task.emitted[-1])
             emitted += 1
             deadline = request.deadline_s
@@ -479,6 +642,8 @@ class ServingEngine:
         self.peak_active = max(self.peak_active,
                                self.scheduler.active_count)
         self._tif_gauge.set(float(tif))
+        if self.slo is not None:
+            self.slo.observe("occupancy", self.scheduler.occupancy)
         if self.paged:
             self._blocks_gauge.set(float(self.scheduler.blocks_in_use))
             hits = self.scheduler.prefix_hits
@@ -518,17 +683,19 @@ class ServingEngine:
                     and self._queue and len(self._queue) == qlen):
                 while self._queue:
                     task, _ = self._queue.popleft()
-                    self._submit_t.pop(task.request_id, None)
-                    self.results[task.request_id] = ServeResult(
-                        request_id=task.request_id, tokens=[],
+                    rid = task.request_id
+                    self._submit_t.pop(rid, None)
+                    self._record_result(ServeResult(
+                        request_id=rid, tokens=[],
                         status="no_capacity", ttft_s=None, itl_s=[],
-                    )
-                    self._req_counter.inc(status="no_capacity")
+                    ))
                     if self.trace is not None:
                         self.trace.emit(EventType.SERVE_RETIRE,
-                                        request_id=task.request_id,
+                                        request_id=rid,
                                         status="no_capacity", tokens=0,
                                         admitted=False)
+                    self._close_request_spans(rid, "no_capacity")
+                    self._ledger_unadmitted(rid, "no_capacity")
                 break
             if it >= max_iterations:
                 raise RuntimeError(
@@ -553,15 +720,16 @@ class ServingEngine:
             if (request.deadline_s is not None
                     and now - self._submit_t[rid] > request.deadline_s):
                 self._submit_t.pop(rid, None)
-                self.results[rid] = ServeResult(
+                self._record_result(ServeResult(
                     request_id=rid, tokens=[],
                     status="deadline_exceeded", ttft_s=None, itl_s=[],
-                )
-                self._req_counter.inc(status="deadline_exceeded")
+                ))
                 if self.trace is not None:
                     self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
                                     status="deadline_exceeded", tokens=0,
                                     admitted=False)
+                self._close_request_spans(rid, "deadline_exceeded")
+                self._ledger_unadmitted(rid, "deadline_exceeded")
             else:
                 keep.append((task, request))
         self._queue = keep
@@ -574,23 +742,44 @@ class ServingEngine:
             # rewrites the recorded entropy/margin signals before the
             # monitor scores them (simulating a compromised replica).
             self.chaos.on_serve_retire(task)
+        # Placement snapshot BEFORE retire() clears the slot's table —
+        # the attribution record must name the physical blocks the
+        # stream actually decoded from.
+        placement = (self.scheduler.attribution_info(task)
+                     if self.ledger is not None else None)
         flagged, z = False, 0.0
+        t_mon = time.perf_counter()
         if self.monitor is not None and task.entropies:
             flagged, z = self.monitor.observe(task.entropies, task.margins)
+            if self.spans is not None and rid in self._req_spans:
+                self.spans.add("serve.monitor", t_mon, time.perf_counter(),
+                               kind="serve",
+                               parent_id=self._req_spans[rid]["root"],
+                               request_id=rid, flagged=flagged,
+                               monitor_z=float(z))
         self.scheduler.retire(task, quarantine=flagged)
         times = self._timing.pop(rid, [])
         t0 = self._submit_t.pop(rid, None)
         ttft = (times[0] - t0) if times and t0 is not None else None
         itl = [b - a for a, b in zip(times, times[1:])]
-        self.results[rid] = ServeResult(
+        self._record_result(ServeResult(
             request_id=rid, tokens=list(task.emitted), status=status,
             ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
-        )
-        self._req_counter.inc(status=status)
+        ))
         if ttft is not None:
             self._ttft_hist.observe(ttft)
+            if self.slo is not None:
+                self.slo.observe("ttft_s", ttft)
+            else:
+                self._ttft_est.observe(ttft)
         for dt in itl:
             self._itl_hist.observe(dt)
+            if self.slo is not None:
+                self.slo.observe("itl_s", dt)
+            else:
+                self._itl_est.observe(dt)
+            if self.anomaly is not None:
+                self.anomaly.observe("itl", dt)
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
                             status=status, tokens=len(task.emitted),
@@ -598,6 +787,26 @@ class ServingEngine:
             if flagged:
                 self.trace.emit(EventType.SERVE_QUARANTINE, request_id=rid,
                                 slot=int(task.slot))
+        if self.ledger is not None:
+            thash = attribution.token_hash(task.emitted)
+            record = {
+                "request_id": rid, "status": status, "admitted": True,
+                **placement,
+                "kv_dtype": self.kv_dtype,
+                "weight_dtype": self.weight_dtype,
+                "kv_fallback_reason": self.kv_fallback_reason,
+                "flagged": bool(flagged), "monitor_z": float(z),
+                "tokens": len(task.emitted), "token_hash": thash,
+                "ttft_s": ttft,
+            }
+            self.ledger.append(record)
+            if self.trace is not None:
+                self.trace.emit(EventType.ATTRIBUTION, request_id=rid,
+                                slot=int(task.slot),
+                                n_blocks=len(placement["block_ids"]),
+                                token_hash=thash, flagged=bool(flagged))
+        self._close_request_spans(rid, status, tokens=len(task.emitted),
+                                  flagged=bool(flagged))
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
             "request_id": rid,
@@ -636,27 +845,23 @@ class ServingEngine:
         self.scheduler.release_quarantine(slot)
 
     def metrics_summary(self) -> Dict[str, Any]:
-        """Serving-side rollup: throughput, latency percentiles, trust."""
-        done = [r for r in self.results.values() if r.tokens]
-        itls = np.asarray(
-            [d for r in done for d in r.itl_s], np.float64
-        )
-        ttfts = np.asarray(
-            [r.ttft_s for r in done if r.ttft_s is not None], np.float64
-        )
+        """Serving-side rollup: throughput, latency percentiles, trust.
+
+        Counters come from the terminal-status rollup and the latency
+        percentiles from the streaming P² estimators — both exact/stable
+        over EVERY request ever retired, regardless of how many finished
+        records the bounded ``results`` ring still retains."""
         elapsed = (
             (time.perf_counter() - self._t_start)
             if self._t_start is not None else 0.0
         )
         out: Dict[str, Any] = {
-            "requests_completed":
-                sum(r.status == "completed" for r in self.results.values()),
+            "requests_completed": self._status_counts.get("completed", 0),
             "requests_deadline_exceeded":
-                sum(r.status == "deadline_exceeded"
-                    for r in self.results.values()),
+                self._status_counts.get("deadline_exceeded", 0),
             "requests_rejected": self.rejected,
-            "requests_flagged":
-                sum(r.flagged for r in self.results.values()),
+            "requests_shed_slo": self.shed_slo,
+            "requests_flagged": self._flagged_total,
             "quarantined_slots": sorted(self.quarantined_slots),
             "tokens_emitted": self._tokens_emitted,
             "tokens_per_s":
@@ -675,10 +880,27 @@ class ServingEngine:
                 sched.prefix_hits / sched.prefix_lookups
                 if sched.prefix_lookups else 0.0
             )
-        if itls.size:
-            out["itl_p50_ms"] = float(np.percentile(itls, 50) * 1e3)
-            out["itl_p99_ms"] = float(np.percentile(itls, 99) * 1e3)
-        if ttfts.size:
-            out["ttft_p50_ms"] = float(np.percentile(ttfts, 50) * 1e3)
-            out["ttft_p99_ms"] = float(np.percentile(ttfts, 99) * 1e3)
+        for name, signal, est in (("itl", "itl_s", self._itl_est),
+                                  ("ttft", "ttft_s", self._ttft_est)):
+            if self.slo is not None:
+                p50 = self.slo.quantile(signal, 0.5)
+                p99 = self.slo.quantile(signal, 0.99)
+            else:
+                p50 = est.quantile(0.5) if est.count else None
+                p99 = est.quantile(0.99) if est.count else None
+            if p50 is not None:
+                out[f"{name}_p50_ms"] = float(p50 * 1e3)
+                out[f"{name}_p99_ms"] = float(p99 * 1e3)
         return out
+
+    def verify_attribution(self) -> "tuple[bool, list]":
+        """Reconcile the attached ledger's records against the paged
+        pool's block-lifecycle journal (obs.attribution) — the audit the
+        serve-trust acceptance runs.  Stripe engines verify trivially
+        (records carry no block ids)."""
+        if self.ledger is None:
+            raise ValueError("engine has no attribution ledger attached")
+        allocator = getattr(self.scheduler, "blocks", None) \
+            if self.paged else self.scheduler.allocator
+        return attribution.verify_attribution(self.ledger.records(),
+                                              allocator)

@@ -342,7 +342,7 @@ class BlockAllocator:
     with clean holders merely decref (quarantining a slot releases only
     its unshared blocks)."""
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, journal_capacity: int = 65536):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         self.num_blocks = num_blocks
@@ -351,6 +351,26 @@ class BlockAllocator:
         self._free: List[int] = list(range(num_blocks, 0, -1))
         self._ref: Dict[int, int] = {}
         self._quarantined: Set[int] = set()
+        # Lifecycle evidence for obs.attribution.verify_attribution, two
+        # granularities: ``journal`` is a bounded ring of (op, block,
+        # seq[, outcome]) tuples for event-level debugging; ``lifetime``
+        # is EXACT cumulative per-block op counts — keyed by block id so
+        # it is bounded by the pool size, never by run length (the ring
+        # alone would false-positive "never allocated" once a pinned
+        # block's alloc entry rotated out).
+        import collections as _collections
+
+        self.journal: Any = _collections.deque(maxlen=journal_capacity)
+        self._journal_seq = 0
+        self.lifetime: Dict[int, Dict[str, int]] = {}
+
+    def _journal_add(self, op: str, block: int, *extra: Any) -> None:
+        self._journal_seq += 1
+        self.journal.append((op, block, self._journal_seq, *extra))
+        counts = self.lifetime.setdefault(
+            block, {"alloc": 0, "incref": 0, "release": 0,
+                    "unquarantine": 0})
+        counts[op] += 1
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """Claim ``n`` blocks at refcount 1, or None when the pool cannot
@@ -362,12 +382,14 @@ class BlockAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._ref[b] = 1
+            self._journal_add("alloc", b)
         return out
 
     def incref(self, block: int) -> None:
         if block not in self._ref:
             raise ValueError(f"incref of unallocated block {block}")
         self._ref[block] += 1
+        self._journal_add("incref", block)
 
     def refcount(self, block: int) -> int:
         return self._ref.get(block, 0)
@@ -381,12 +403,15 @@ class BlockAllocator:
             raise ValueError(f"double free / bad block {block}")
         self._ref[block] -= 1
         if self._ref[block] > 0:
+            self._journal_add("release", block, "shared")
             return "shared"
         del self._ref[block]
         if quarantine:
             self._quarantined.add(block)
+            self._journal_add("release", block, "quarantined")
             return "quarantined"
         self._free.append(block)
+        self._journal_add("release", block, "freed")
         return "freed"
 
     def unquarantine(self, block: int) -> None:
@@ -394,6 +419,7 @@ class BlockAllocator:
         if block in self._quarantined:
             self._quarantined.discard(block)
             self._free.append(block)
+            self._journal_add("unquarantine", block)
 
     @property
     def free_count(self) -> int:
@@ -434,6 +460,9 @@ class PrefixCache:
         # cached-extension count].  Node id 0 is the implicit root.
         self._nodes: Dict[Tuple[int, Tuple[int, ...]], List[Any]] = {}
         self._by_id: Dict[int, Tuple[int, Tuple[int, ...]]] = {}
+        # block id -> request id that PUBLISHED it (attribution: a
+        # prefix-cache hit records whose prefill it is trusting).
+        self._publisher: Dict[int, int] = {}
         self._next_id = 1
         self._clock = 0
 
@@ -466,14 +495,16 @@ class PrefixCache:
             self._blocks.incref(b)
         return out
 
-    def insert(self, tokens: Sequence[int],
-               block_ids: Sequence[int]) -> List[int]:
+    def insert(self, tokens: Sequence[int], block_ids: Sequence[int],
+               publisher: Optional[int] = None) -> List[int]:
         """Register ``tokens``' full blocks (backed by ``block_ids``, the
         owning request's table) — the cache increfs each newly cached
         block.  A prefix already cached (possibly under a different
         physical block holding identical content) is refreshed, not
-        duplicated.  Returns the NEWLY cached block ids (the caller's
-        publication record — what a later quarantine must purge)."""
+        duplicated.  ``publisher`` (the owning request id) is remembered
+        per newly cached block for attribution.  Returns the NEWLY
+        cached block ids (the caller's publication record — what a later
+        quarantine must purge)."""
         n = min(len(tokens) // self.block_size, len(block_ids))
         added: List[int] = []
         parent = 0
@@ -489,15 +520,24 @@ class PrefixCache:
             self._nodes[key] = [block_ids[i], self._bump(), nid, 0]
             self._by_id[nid] = key
             self._blocks.incref(block_ids[i])
+            if publisher is not None:
+                self._publisher[block_ids[i]] = publisher
             if parent:
                 self._nodes[self._by_id[parent]][3] += 1
             added.append(block_ids[i])
             parent = nid
         return added
 
+    def publishers(self, block_ids: Sequence[int]) -> Dict[int, int]:
+        """Publisher request id per cached block (blocks with no
+        recorded publisher are omitted)."""
+        return {b: self._publisher[b] for b in block_ids
+                if b in self._publisher}
+
     def _remove(self, key: Tuple[int, Tuple[int, ...]]) -> List[int]:
         """Drop one node; returns [block id, node id]."""
         block, _, nid, _ = self._nodes.pop(key)
+        self._publisher.pop(block, None)
         del self._by_id[nid]
         if key[0] and key[0] in self._by_id:
             self._nodes[self._by_id[key[0]]][3] -= 1

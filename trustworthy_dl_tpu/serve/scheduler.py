@@ -408,6 +408,15 @@ class ContinuousBatchingScheduler:
         self.lengths = np.zeros(max_slots, np.int32)
         self.tasks: Dict[int, SlotTask] = {}   # slot -> task
         self.max_seq = max_seq
+        self.spans: Any = None  # optional obs.spans.SpanTracker (engine)
+
+    def attribution_info(self, task: SlotTask) -> Dict[str, Any]:
+        """What the attribution ledger records about THIS task's
+        physical placement.  The stripe pool has no block table — the
+        slot id is the whole story."""
+        return {"layout": "stripe", "slot": int(task.slot),
+                "block_ids": [], "prefix_block_ids": [],
+                "prefix_publishers": {}}
 
     # -- admission ---------------------------------------------------------
 
@@ -633,6 +642,11 @@ class PagedBatchingScheduler:
         self.tasks: Dict[int, SlotTask] = {}       # slot -> task
         self._prefill: Dict[int, _PrefillProgress] = {}
         self._q_blocks_by_slot: Dict[int, List[int]] = {}
+        # slot -> attribution snapshot taken at admission (block table,
+        # prefix reuse, publishers) — the ledger reads it at retirement,
+        # AFTER retire() has already cleared the live table.
+        self._attrib: Dict[int, Dict[str, Any]] = {}
+        self.spans: Any = None  # optional obs.spans.SpanTracker (engine)
         # slot -> block ids the slot's request PUBLISHED to the prefix
         # cache (newly cached at its prefill completion) — what a
         # quarantine-retire must purge from the cache.
@@ -669,6 +683,20 @@ class PagedBatchingScheduler:
     def blocks_in_use(self) -> int:
         return self.blocks.in_use
 
+    def attribution_info(self, task: SlotTask) -> Dict[str, Any]:
+        """The admission-time placement snapshot for the attribution
+        ledger: physical block table, which blocks came from the prefix
+        cache, and their publisher request ids.  Read it BEFORE
+        ``retire`` (which drops the snapshot with the row)."""
+        info = self._attrib.get(task.slot)
+        if info is None or self.tasks.get(task.slot) is not task:
+            return {"layout": "paged", "slot": int(task.slot),
+                    "block_ids": [], "prefix_block_ids": [],
+                    "prefix_publishers": {}}
+        return {**info, "prefix_publishers": dict(info["prefix_publishers"]),
+                "block_ids": list(info["block_ids"]),
+                "prefix_block_ids": list(info["prefix_block_ids"])}
+
     def admit(self, task: SlotTask) -> bool:
         """Claim a decode row and the request's blocks (reusing cached
         prefix blocks), enqueue its chunked prefill.  Pure host work — no
@@ -689,10 +717,18 @@ class PagedBatchingScheduler:
         shared: List[int] = []
         if self.prefix is not None:
             self.prefix_lookups += 1
+            import time as _time
+
+            t0 = _time.perf_counter()
             # Cap at (p-1)//block: at least one prompt token always
             # prefills, so the first sampled token has fresh logits.
             shared = self.prefix.lookup(task.prompt.tolist(),
                                         (p - 1) // self.block_size)
+            if self.spans is not None:
+                self.spans.add("serve.prefix_lookup", t0,
+                               _time.perf_counter(), kind="serve",
+                               request_id=task.request_id,
+                               hit=bool(shared), shared_blocks=len(shared))
         n_total = -(-total // self.block_size)             # ceil
         n_new = n_total - len(shared)
         fresh = self.blocks.alloc(n_new)
@@ -711,6 +747,13 @@ class PagedBatchingScheduler:
         self.lengths[slot] = 0
         task.slot = slot
         self.tasks[slot] = task
+        self._attrib[slot] = {
+            "layout": "paged", "slot": slot,
+            "block_ids": list(shared + fresh),
+            "prefix_block_ids": list(shared),
+            "prefix_publishers": (self.prefix.publishers(shared)
+                                  if self.prefix is not None else {}),
+        }
         self._prefill[slot] = _PrefillProgress(
             task=task, pos=len(shared) * self.block_size, plen=p,
             shared_len=len(shared) * self.block_size,
@@ -731,6 +774,9 @@ class PagedBatchingScheduler:
         st = self._prefill[slot]
         task = st.task
         c = self.chunk
+        import time as _time
+
+        t_chunk = _time.perf_counter()
         n_real = min(st.plen - st.pos, c)
         chunk = np.zeros(c, np.int32)
         chunk[:n_real] = task.prompt[st.pos:st.pos + n_real]
@@ -765,6 +811,11 @@ class PagedBatchingScheduler:
                 jnp.asarray(task.greedy),
             )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+        if self.spans is not None:
+            self.spans.add("serve.prefill_chunk", t_chunk,
+                           _time.perf_counter(), kind="serve",
+                           request_id=task.request_id, pos=int(st.pos),
+                           tokens=int(n_real), final=bool(final))
         if not final:
             st.pos += c
             return None
@@ -781,6 +832,7 @@ class PagedBatchingScheduler:
             self._published[slot] = self.prefix.insert(
                 task.prompt.tolist(),
                 self.tables[slot][:st.plen // self.block_size],
+                publisher=task.request_id,
             )
         return task
 
@@ -847,6 +899,7 @@ class PagedBatchingScheduler:
             return
         del self.tasks[slot]
         self._prefill.pop(slot, None)
+        self._attrib.pop(slot, None)
         published = self._published.pop(slot, [])
         if quarantine and self.prefix is not None and published:
             # The flagged request's own PUBLISHED prompt blocks leave
