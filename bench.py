@@ -32,7 +32,10 @@ TDDL_BENCH_ASYNC=1 (async host-pipeline A/B: trainer loop at
 async_host_depth 0 vs default, tokens/sec + obs phase shares),
 TDDL_BENCH_QUANT=1 (int8 KV quantization A/B: model-dtype vs int8 KV
 pool at EQUAL HBM budget — slots, KV bytes and tokens/s per arm;
-TDDL_BENCH_QUANT_W8=1 adds weight-only int8 to the quantized arm).
+TDDL_BENCH_QUANT_W8=1 adds weight-only int8 to the quantized arm),
+TDDL_BENCH_FLEET=1 (serving-fleet goodput-under-SLO vs offered load,
+chaos OFF vs ON over identical seeded workloads — "fleet" record key,
+TDDL_BENCH_FLEET_* knobs).
 Infra knobs: TDDL_BENCH_PROBE_TIMEOUT (backend liveness probe seconds,
 default 180; a successful probe is cached for the process AND persisted
 to disk — TDDL_BENCH_PROBE_CACHE sets the file, default
@@ -635,6 +638,127 @@ def bench_paged() -> "dict":
         f"{record['prefix']['hit_rate']} "
         f"({record['prefix']['tokens_reused']} tokens reused)")
     return record
+
+
+def bench_fleet() -> "dict":
+    """Serving-fleet leg (TDDL_BENCH_FLEET=1): goodput-under-SLO vs
+    offered load, chaos OFF vs ON, over a replica fleet driven by the
+    seeded workload generator (bursty arrivals, heavy-tailed lengths,
+    tenant priority skew — serve/workload.py).
+
+    Per offered rate, two arms on IDENTICAL traffic (same workload
+    seed): *baseline* (no faults) and *chaos* (a seeded REPLICA_* fault
+    plan: crash + stall + poison).  Goodput counts only tokens from
+    requests that COMPLETED inside their deadline — the number the
+    robustness layer is supposed to defend; the gap between the arms at
+    each rate is the price of the injected failures after fail-over,
+    drain and quarantine have done their work.
+
+    Env: TDDL_BENCH_FLEET_MODEL (gpt2), TDDL_BENCH_FLEET_REPLICAS (3),
+    TDDL_BENCH_FLEET_SLOTS (4, per replica), TDDL_BENCH_FLEET_SEQ (256),
+    TDDL_BENCH_FLEET_REQUESTS (32), TDDL_BENCH_FLEET_RATES ("4,16"),
+    TDDL_BENCH_FLEET_SEED (0)."""
+    import jax
+
+    from trustworthy_dl_tpu.chaos import FaultEvent, FaultInjector, \
+        FaultKind, FaultPlan
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import (
+        FleetConfig,
+        ServeRequest,
+        ServingFleet,
+        WorkloadConfig,
+        generate_workload,
+    )
+    from trustworthy_dl_tpu.serve.workload import replay_workload
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_FLEET_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    replicas = int(os.environ.get("TDDL_BENCH_FLEET_REPLICAS", "3"))
+    max_slots = int(os.environ.get("TDDL_BENCH_FLEET_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_FLEET_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_FLEET_REQUESTS", "32"))
+    rates = [float(r) for r in os.environ.get(
+        "TDDL_BENCH_FLEET_RATES", "4,16").split(",")]
+    seed = int(os.environ.get("TDDL_BENCH_FLEET_SEED", "0"))
+
+    def fault_plan() -> FaultPlan:
+        # One scripted arc per chaos arm: an early poison (flag-rate →
+        # drain → quarantine), a mid-run crash (fail-over + restart) and
+        # a stall (heartbeat drain) — replica indices spread so the
+        # fleet is never down to zero.
+        return FaultPlan.scripted([
+            FaultEvent(step=4, kind=FaultKind.REPLICA_POISON,
+                       target=replicas - 1),
+            FaultEvent(step=8, kind=FaultKind.REPLICA_CRASH, target=0),
+            FaultEvent(step=14, kind=FaultKind.REPLICA_STALL,
+                       target=min(1, replicas - 1), severity=8),
+        ], seed=seed)
+
+    arms: "dict[str, list]" = {"baseline": [], "chaos": []}
+    for rate in rates:
+        workload = generate_workload(
+            WorkloadConfig(seed=seed, num_requests=n_requests,
+                           mean_rps=rate),
+            cfg.vocab_size, max_seq,
+        )
+        for arm in ("baseline", "chaos"):
+            chaos = (FaultInjector(fault_plan()) if arm == "chaos"
+                     else None)
+            fleet = ServingFleet(
+                params, cfg,
+                # Cool-off pinned past the run: an unhealed poisoned
+                # replica re-trips on every readmission probe, and this
+                # sweep wants the injected faults' cost, not a
+                # quarantine-probe-quarantine churn tail.
+                fleet_config=FleetConfig(num_replicas=replicas,
+                                         max_retries=6,
+                                         quarantine_cooloff_ticks=10 ** 6),
+                chaos=chaos, rng=jax.random.PRNGKey(1),
+                max_slots=max_slots, max_seq=max_seq,
+                queue_limit=n_requests,
+            )
+            t0 = time.perf_counter()
+            replay_workload(fleet, workload, lambda item: ServeRequest(
+                prompt=list(item.prompt),
+                max_new_tokens=item.max_new_tokens,
+                temperature=0.8, priority=item.priority,
+                deadline_s=item.deadline_s,
+            ))
+            wall = time.perf_counter() - t0
+            summary = fleet.metrics_summary()
+            statuses = summary["statuses"]
+            good_tokens = summary["completed_tokens"]
+            row = {
+                "offered_rps": rate,
+                "goodput_tokens_per_s": round(good_tokens / wall, 1)
+                if wall > 0 else 0.0,
+                "completed": statuses.get("completed", 0),
+                "deadline_exceeded": statuses.get("deadline_exceeded", 0),
+                "shed": (statuses.get("shed_slo", 0)
+                         + statuses.get("no_capacity", 0)
+                         + statuses.get("failover_exhausted", 0)
+                         + fleet.rejected),
+                "failovers": summary["fleet_failovers"],
+                "drains": summary["fleet_drains"],
+                "quarantines": summary["fleet_quarantines"],
+                "restarts": summary["fleet_restarts"],
+                "wall_s": round(wall, 2),
+            }
+            arms[arm].append(row)
+            log(f"fleet {arm:8s} offered={rate:6.1f} req/s: "
+                f"goodput {row['goodput_tokens_per_s']:8.1f} tok/s, "
+                f"completed {row['completed']}/{n_requests}, "
+                f"failovers {row['failovers']}, drains {row['drains']}, "
+                f"quarantines {row['quarantines']}")
+    return {
+        "replicas": replicas,
+        "max_slots_per_replica": max_slots,
+        "requests_per_arm": n_requests,
+        "arms": arms,
+    }
 
 
 def bench_chaos() -> "list[dict]":
@@ -1241,6 +1365,9 @@ def _inner_main() -> None:
     if os.environ.get("TDDL_BENCH_SERVE") == "1":
         serve_records = bench_serve()
         paged_record = bench_paged()
+    fleet_record = None
+    if os.environ.get("TDDL_BENCH_FLEET") == "1":
+        fleet_record = bench_fleet()
     chaos_records = None
     if os.environ.get("TDDL_BENCH_CHAOS") == "1":
         chaos_records = bench_chaos()
@@ -1270,6 +1397,8 @@ def _inner_main() -> None:
         record["serve"] = serve_records
     if paged_record is not None:
         record["serve_paged"] = paged_record
+    if fleet_record is not None:
+        record["fleet"] = fleet_record
     if chaos_records is not None:
         record["chaos"] = chaos_records
     if async_records is not None:

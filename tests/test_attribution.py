@@ -39,7 +39,10 @@ from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.data import get_dataloader
 from trustworthy_dl_tpu.engine import DistributedTrainer
 
-pytestmark = pytest.mark.slow  # heavy jitted-training integration tier
+# The training-side attribution cells are the heavy jitted integration
+# tier (marked @slow individually); the serving-fleet ledger
+# reconciliation tests at the bottom are host-only fast-tier.
+slow = pytest.mark.slow
 
 TINY = dict(n_layer=2, n_embd=32, n_head=4, vocab_size=128, n_positions=32,
             seq_len=16)
@@ -68,6 +71,7 @@ def shared_trainer(tmp_path_factory):
     return DistributedTrainer(config, model_overrides=dict(TINY))
 
 
+@slow
 @pytest.mark.parametrize("kind", sorted(EXPECTED_FIRST))
 def test_first_incident_attribution(shared_trainer, kind):
     trainer = shared_trainer
@@ -114,6 +118,7 @@ def test_first_incident_attribution(shared_trainer, kind):
     )), (dist, trainer.attack_history)
 
 
+@slow
 def test_gradient_poisoning_never_first_labelled_byzantine(tmp_path):
     """The specific r3 regression (MULTICHIP_r03 DP leg): a
     gradient_poisoning injection must NOT be first-reported as the
@@ -141,6 +146,7 @@ def test_gradient_poisoning_never_first_labelled_byzantine(tmp_path):
     assert trainer.attack_history[0]["attack_type"] == "gradient_poisoning"
 
 
+@slow
 @pytest.mark.xfail(
     condition=jax.default_backend() == "cpu",
     reason="container-specific (triaged PR 5, fails identically at seed): "
@@ -191,3 +197,94 @@ def test_vision_data_poisoning_detected(tmp_path):
     assert first["node_id"] == 3
     assert first["attack_type"] in EXPECTED_FIRST["data_poisoning"], first
     assert {r["node_id"] for r in trainer.attack_history} == {3}
+
+
+# ---------------------------------------------------------------------------
+# Serving-fleet attribution reconciliation (host-only fast tier):
+# verify_attribution over records whose blocks span TWO replicas'
+# allocators — one record, two lifecycle journals — plus the
+# double-retire detector the hedge dedup-at-retire invariant needs.
+# ---------------------------------------------------------------------------
+
+import pytest as _pytest  # noqa: E402  (fast-tier section below)
+
+from trustworthy_dl_tpu.serve.kv_slots import BlockAllocator  # noqa: E402
+from trustworthy_dl_tpu.obs.attribution import (  # noqa: E402
+    token_hash,
+    verify_attribution,
+)
+
+
+def _fleet_record(rid, attempts, **extra):
+    return {"request_id": rid, "status": "completed", "admitted": True,
+            "attempts": attempts, "tokens": 2,
+            "token_hash": token_hash([1, 2]), **extra}
+
+
+@_pytest.mark.fleet
+def test_verify_attribution_record_spanning_two_replica_journals():
+    """A failed-over request's canonical record carries one attempt per
+    replica; each attempt's blocks must reconcile against ITS replica's
+    journal (block ids collide across pools — 'block 3' exists on
+    both).  The same record must fail loudly when an attempt claims a
+    block its journal never allocated."""
+    alloc0, alloc1 = BlockAllocator(8), BlockAllocator(8)
+    blocks0 = alloc0.alloc(2)       # replica 0: blocks [8, 7]
+    blocks1 = alloc1.alloc(3)       # replica 1: blocks [8, 7, 6]
+    for b in blocks0:               # attempt 0 was cancelled: released
+        alloc0.release(b)
+    rec = _fleet_record(0, [
+        {"replica": 0, "journal": "0:0", "layout": "paged", "slot": 0,
+         "block_ids": list(blocks0), "prefix_block_ids": []},
+        {"replica": 1, "journal": "1:0", "layout": "paged", "slot": 1,
+         "block_ids": list(blocks1), "prefix_block_ids": []},
+    ])
+    ok, problems = verify_attribution(
+        [rec], {"0:0": alloc0, "1:0": alloc1})
+    assert ok, problems
+
+    # An attempt claiming a block its own journal never handed out is
+    # caught even though the OTHER replica did allocate that id.
+    bogus = _fleet_record(1, [
+        {"replica": 0, "journal": "0:0", "layout": "paged", "slot": 0,
+         "block_ids": [6], "prefix_block_ids": []},   # only alloc1 has 6
+    ])
+    ok, problems = verify_attribution(
+        [bogus], {"0:0": alloc0, "1:0": alloc1})
+    assert not ok
+    assert any("never allocated" in p for p in problems)
+
+    # An attempt naming an unknown journal is loud, not skipped.
+    lost = _fleet_record(2, [
+        {"replica": 4, "journal": "4:0", "layout": "paged", "slot": 0,
+         "block_ids": [1], "prefix_block_ids": []},
+    ])
+    ok, problems = verify_attribution(
+        [lost], {"0:0": alloc0, "1:0": alloc1})
+    assert not ok
+    assert any("no lifecycle journal" in p for p in problems)
+
+
+@_pytest.mark.fleet
+def test_verify_attribution_flags_double_retire():
+    """Dedup-at-retire invariant, asserted from the ledger side: TWO
+    admitted records claiming the same fleet request id is a double
+    retire (both replicas claimed the canonical stream) and must fail
+    reconciliation.  A hedge loser's ``admitted: false`` record does
+    NOT count."""
+    alloc = BlockAllocator(4)
+    blocks = alloc.alloc(1)
+    attempts = [{"replica": 0, "journal": "0:0", "layout": "paged",
+                 "slot": 0, "block_ids": list(blocks),
+                 "prefix_block_ids": []}]
+    canonical = _fleet_record(7, attempts)
+    loser = {"request_id": 7, "status": "hedge_lost", "admitted": False,
+             "replica": 1, "tokens": 0, "token_hash": token_hash([])}
+    ok, problems = verify_attribution([canonical, loser],
+                                      {"0:0": alloc})
+    assert ok, problems             # one canonical + one loser is legal
+    dup = _fleet_record(7, attempts)
+    ok, problems = verify_attribution([canonical, loser, dup],
+                                      {"0:0": alloc})
+    assert not ok
+    assert any("double retire" in p for p in problems)

@@ -224,3 +224,46 @@ def test_bench_quant_ab_records(monkeypatch):
         for key in ("slots", "kv_bytes", "kv_dtype", "weight_dtype",
                     "tokens_per_s", "wall_s"):
             assert key in row, row
+
+
+def test_bench_fleet_records(monkeypatch, tmp_path):
+    """bench_fleet's goodput-under-SLO sweep on a tiny model: chaos-off
+    and chaos-on arms over IDENTICAL seeded workloads, each row carrying
+    the goodput/offered-load/recovery keys the JSON contract publishes.
+    The probe disk cache is isolated per test (a healthy probe here must
+    never leak into the dead-backend tests' runs)."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_PROBE_CACHE", str(tmp_path / "probe.json"))
+    monkeypatch.setenv("TDDL_BENCH_FLEET_REPLICAS", "2")
+    monkeypatch.setenv("TDDL_BENCH_FLEET_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_FLEET_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_FLEET_REQUESTS", "6")
+    monkeypatch.setenv("TDDL_BENCH_FLEET_RATES", "100")
+    record = bench.bench_fleet()
+    assert record["replicas"] == 2
+    assert set(record["arms"]) == {"baseline", "chaos"}
+    for arm in ("baseline", "chaos"):
+        rows = record["arms"][arm]
+        assert len(rows) == 1
+        row = rows[0]
+        for key in ("offered_rps", "goodput_tokens_per_s", "completed",
+                    "deadline_exceeded", "shed", "failovers", "drains",
+                    "quarantines", "restarts", "wall_s"):
+            assert key in row, (arm, row)
+        # Zero lost accepted requests in EITHER arm: every request is
+        # accounted as completed, deadline-shed or explicitly shed.
+        assert row["completed"] + row["deadline_exceeded"] \
+            + row["shed"] == 6, (arm, row)
+    chaos_row = record["arms"]["chaos"][0]
+    # The chaos arm really injected: recovery machinery engaged.
+    assert chaos_row["restarts"] >= 1
+    assert chaos_row["failovers"] + chaos_row["drains"] >= 1

@@ -1,0 +1,708 @@
+"""Trust-aware serving fleet (serve/fleet.py + serve/workload.py).
+
+Fast tier: host contracts through a FakeEngine seam (state machine
+transitions, backoff schedule, hedge dedup-at-retire, drain blocks
+admission, replica-addressed chaos, workload generator determinism) —
+nothing jits a model.  Slow tier: THE seeded drill — REPLICA_CRASH +
+REPLICA_POISON + REPLICA_STALL in one plan over real engines, asserting
+the ``FaultPlan.predict_fleet()``-pinned failover/drain/quarantine
+counts, zero lost accepted requests, and every surviving stream
+bit-identical to a single-engine ``generate()`` reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.chaos import (
+    FaultEvent,
+    FaultInjector,
+    FaultKind,
+    FaultPlan,
+)
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models.generate import generate
+from trustworthy_dl_tpu.obs.attribution import AttributionLedger
+from trustworthy_dl_tpu.serve import (
+    FleetConfig,
+    ReplicaState,
+    ServeRequest,
+    ServeResult,
+    ServingFleet,
+    Tenant,
+    WorkloadConfig,
+    backoff_ticks,
+    generate_workload,
+)
+
+pytestmark = pytest.mark.fleet
+
+# Unique decode geometry for this file (vocab 107): the process-global
+# jit cache must never hand another serve-test file's compiled program
+# to this one's compile-sensitive assertions (test_quant/test_paged_kv
+# document the same split: 97/101/103).
+CFG = gpt2.GPT2Config(vocab_size=107, n_positions=64, n_layer=2, n_embd=32,
+                      n_head=4, dtype=jnp.float32)
+
+
+class FakeEngine:
+    """Minimal host-only stand-in honouring the fleet's engine surface:
+    submit/step/cancel, queued/inflight ids, retire_hook.  ``step()``
+    admits the queue; tests finish requests explicitly via
+    ``complete()``."""
+
+    def __init__(self, index, **kwargs):
+        self.index = index
+        self.replica_id = kwargs.get("replica_id")
+        self.retire_hook = kwargs.get("retire_hook")
+        self.slo = kwargs.get("slo")
+        self.anomaly = kwargs.get("anomaly")
+        self.chaos = kwargs.get("chaos")
+        self.queue_limit = kwargs.get("queue_limit", 64)
+        self.kv_dtype = "model"
+        self.weight_dtype = "model"
+        self.kv_fallback_reason = None
+        self._next = 0
+        self.queue = {}
+        self.inflight = {}
+        self.steps = 0
+
+    def submit(self, request):
+        if len(self.queue) >= self.queue_limit:
+            return None
+        rid = self._next
+        self._next += 1
+        self.queue[rid] = request
+        return rid
+
+    def step(self):
+        self.inflight.update(self.queue)
+        self.queue.clear()
+        self.steps += 1
+        return 0
+
+    def cancel(self, rid, status="cancelled"):
+        req = self.queue.pop(rid, None) or self.inflight.pop(rid, None)
+        if req is None:
+            return False
+        self.retire_hook(ServeResult(request_id=rid, tokens=[],
+                                     status=status, ttft_s=None, itl_s=[]),
+                         None)
+        return True
+
+    def complete(self, rid, tokens=(1, 2), status="completed",
+                 flagged=False):
+        if self.inflight.pop(rid, None) is None:
+            del self.queue[rid]
+        self.retire_hook(
+            ServeResult(request_id=rid, tokens=list(tokens), status=status,
+                        ttft_s=0.01, itl_s=[], flagged=flagged),
+            {"layout": "stripe", "slot": 0, "block_ids": [],
+             "prefix_block_ids": [], "prefix_publishers": {}},
+        )
+
+    @property
+    def queued_ids(self):
+        return list(self.queue)
+
+    @property
+    def inflight_ids(self):
+        return list(self.inflight)
+
+    @property
+    def load(self):
+        return len(self.queue) + len(self.inflight)
+
+
+def fake_fleet(num_replicas=2, chaos=None, ledger=None, **cfg_kwargs):
+    fakes = {}
+
+    def factory(index, **kwargs):
+        fakes[index] = FakeEngine(index, **kwargs)
+        return fakes[index]
+
+    fleet = ServingFleet(
+        fleet_config=FleetConfig(num_replicas=num_replicas, **cfg_kwargs),
+        chaos=chaos, ledger=ledger, engine_factory=factory,
+    )
+    return fleet, fakes
+
+
+# --------------------------------------------------------------------------
+# Fast tier: host contracts
+# --------------------------------------------------------------------------
+
+
+def test_fleet_config_validation_and_backoff_schedule():
+    with pytest.raises(ValueError):
+        FleetConfig(num_replicas=0)
+    with pytest.raises(ValueError):
+        FleetConfig(flag_rate_quarantine=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(flag_min_count=8, flag_window=4)
+    with pytest.raises(ValueError):
+        FleetConfig(backoff_mult=0.5)
+    cfg = FleetConfig(backoff_base_ticks=2, backoff_mult=2.0)
+    assert [backoff_ticks(cfg, a) for a in (1, 2, 3, 4)] == [2, 4, 8, 16]
+    with pytest.raises(ValueError):
+        backoff_ticks(cfg, 0)
+
+
+def test_stall_heartbeat_drives_degrade_drain_failover_readmit():
+    """A wedged replica walks the ladder off missed-tick heartbeats
+    alone: healthy -> degraded -> draining (in-flight failed over) ->
+    restarting -> healthy; its request completes on the other replica
+    and the drill counters record exactly one drain + one episode."""
+    class RecordingTrace:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, type, **data):
+            self.events.append({"type": getattr(type, "value", type),
+                                **data})
+
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=2, kind=FaultKind.REPLICA_STALL, target=0,
+                   severity=12),
+    ]))
+    trace = RecordingTrace()
+    fleet, fakes = fake_fleet(chaos=inj, heartbeat_miss_degraded=2,
+                              heartbeat_miss_limit=4, restart_ticks=1,
+                              backoff_base_ticks=0)
+    fleet.trace = trace
+    fid = fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2))
+    assert fleet.requests[fid].live.keys() == {0}   # least-index wins
+    for _ in range(8):
+        fleet.step()
+    # The full ladder, in order, as typed replica_transition events
+    # (one engine tick can walk several rungs — the trace is the record).
+    ladder = [(e["from_state"], e["to_state"]) for e in trace.events
+              if e["type"] == "replica_transition" and e["replica"] == 0]
+    assert ladder[:3] == [("healthy", "degraded"),
+                          ("degraded", "draining"),
+                          ("draining", "restarting")]
+    assert fleet.counters["drains"] == 1
+    assert fleet.counters["failover_episodes"] == 1
+    assert fleet.counters["failovers"] == 1
+    # The request moved to replica 1 and completes there.
+    attempt = fleet.requests[fid].live
+    assert attempt.keys() == {1}
+    fakes[1].complete(attempt[1].local_id, tokens=(7, 8))
+    fleet.step()
+    assert fleet.results[fid].status == "completed"
+    assert fleet.results[fid].replica == 1
+    assert fleet.results[fid].tokens == [7, 8]
+    # Stall over + warmup -> the replica re-enters service.
+    for _ in range(12):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.HEALTHY
+
+
+def test_hedge_dedup_exactly_one_canonical_stream():
+    """Near-deadline hedging: the duplicate launches on a second
+    replica, the FIRST completed attempt wins, the loser is cancelled
+    and ledgered ``admitted: false, status: hedge_lost`` — exactly one
+    admitted record per fleet request id."""
+    ledger = AttributionLedger(None)
+    fleet, fakes = fake_fleet(ledger=ledger, hedge_deadline_s=60.0)
+    fid = fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                    deadline_s=30.0))
+    fleet.step()    # remaining 30 < 60: hedge fires
+    rec = fleet.requests[fid]
+    assert set(rec.live) == {0, 1}
+    assert fleet.counters["hedges"] == 1
+    # The HEDGE (replica 1) completes first -> canonical; primary loses.
+    fakes[1].complete(rec.live[1].local_id, tokens=(5, 6))
+    fleet.step()
+    assert fleet.results[fid].status == "completed"
+    assert fleet.results[fid].replica == 1
+    assert fleet.results[fid].tokens == [5, 6]
+    assert fleet.counters["hedge_lost"] == 1
+    records = ledger.records()
+    admitted = [r for r in records if r.get("admitted")]
+    losers = [r for r in records if not r.get("admitted")]
+    assert len(admitted) == 1 and admitted[0]["request_id"] == fid
+    assert len(losers) == 1 and losers[0]["status"] == "hedge_lost"
+    assert losers[0]["replica"] == 0
+    assert not fleet.busy
+
+
+def test_draining_replica_blocks_admission_until_capacity_returns():
+    fleet, fakes = fake_fleet(num_replicas=2)
+    fleet.replicas[0].state = ReplicaState.DRAINING
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    assert fleet.requests[fid].live.keys() == {1}   # routed around drain
+    fleet.replicas[1].state = ReplicaState.DRAINING
+    parked = fleet.submit(ServeRequest(prompt=[2], max_new_tokens=1))
+    rec = fleet.requests[parked]
+    assert not rec.live and rec.retry_due is not None   # accepted, parked
+    fleet.replicas[0].state = ReplicaState.HEALTHY
+    fleet.step()
+    assert rec.live.keys() == {0}                  # resubmitted on revival
+
+
+def test_fleet_backpressure_when_every_admitting_queue_is_full():
+    fleet, fakes = fake_fleet(num_replicas=2, )
+    for f in fakes.values():
+        f.queue_limit = 1
+    a = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    b = fleet.submit(ServeRequest(prompt=[2], max_new_tokens=1))
+    assert a is not None and b is not None
+    shed = fleet.submit(ServeRequest(prompt=[3], max_new_tokens=1))
+    assert shed is None                             # true backpressure
+    assert fleet.rejected == 1
+
+
+def test_crash_fails_over_and_restarts_with_retained_journal():
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=2, kind=FaultKind.REPLICA_CRASH, target=0),
+    ]))
+    fleet, fakes = fake_fleet(chaos=inj, restart_ticks=2,
+                              backoff_base_ticks=0)
+    fid = fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2))
+    assert fleet.requests[fid].live.keys() == {0}
+    fleet.step()            # tick 1
+    fleet.step()            # tick 2: crash fires
+    assert fleet.replicas[0].engine is None
+    assert fleet.replicas[0].state is ReplicaState.RESTARTING
+    assert fleet.counters["crashes"] == 1
+    assert fleet.counters["failover_episodes"] == 1
+    rec = fleet.requests[fid]
+    assert rec.closed and rec.closed[0]["outcome"] == "crashed"
+    fleet.step()
+    assert rec.live.keys() == {1}                  # failed over
+    for _ in range(3):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.HEALTHY
+    assert fleet.replicas[0].engine is not None
+    assert fleet.replicas[0].gen == 1              # new generation
+    assert fleet.counters["restarts"] == 1
+    assert "0:0" in fleet.journals and "0:1" in fleet.journals
+    fakes[1].complete(rec.live[1].local_id)
+    fleet.step()
+    assert fleet.results[fid].status == "completed"
+
+
+def test_retry_exhaustion_is_an_explicit_terminal_never_silent():
+    """A request whose every attempt is shed finalizes
+    ``failover_exhausted`` after max_retries resubmissions — an
+    accepted request always retires with an explicit status."""
+
+    fleet, fakes = fake_fleet(num_replicas=2, max_retries=2,
+                              backoff_base_ticks=0)
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    for _ in range(10):
+        if fleet.requests.get(fid) is None:
+            break
+        rec = fleet.requests[fid]
+        for rep_idx, att in list(rec.live.items()):
+            fakes[rep_idx].queue.pop(att.local_id, None)
+            fakes[rep_idx].inflight.pop(att.local_id, None)
+            fakes[rep_idx].retire_hook(
+                ServeResult(request_id=att.local_id, tokens=[],
+                            status="no_capacity", ttft_s=None, itl_s=[]),
+                None)
+        fleet.step()
+    res = fleet.results[fid]
+    assert res.status == "failover_exhausted"
+    assert res.attempts == 3                        # 1 + max_retries
+    assert fleet.counters["failovers"] == 2
+
+
+def test_replica_addressed_serve_poison_never_crosses_replicas():
+    """Satellite regression: request ids are replica-LOCAL in a fleet —
+    a SERVE_POISON aimed at replica 1's request 3 must never fire on
+    replica 0's request 3 (same id, different namespace)."""
+
+    class Task:
+        def __init__(self):
+            self.request_id = 3
+            self.entropies = [3.0, 3.1]
+            self.margins = [0.5, 0.4]
+
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=3, kind=FaultKind.SERVE_POISON, target=1),
+    ]))
+    on_zero = Task()
+    inj.on_serve_retire(on_zero, replica=0)        # wrong replica
+    assert on_zero.margins == [0.5, 0.4]           # untouched
+    assert not inj.fired
+    standalone = Task()
+    inj.on_serve_retire(standalone)                # no replica at all
+    assert standalone.margins == [0.5, 0.4]
+    on_one = Task()
+    inj.on_serve_retire(on_one, replica=1)         # the addressed target
+    assert on_one.margins[0] > 100.0               # poisoned
+    assert len(inj.fired) == 1
+    # Fire-once: a second retire with the same local id stays clean.
+    again = Task()
+    inj.on_serve_retire(again, replica=1)
+    assert again.margins == [0.5, 0.4]
+
+
+def test_replica_poison_persists_until_healed():
+    class Task:
+        def __init__(self, rid):
+            self.request_id = rid
+            self.entropies = [3.0]
+            self.margins = [0.5]
+
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_POISON, target=2),
+    ]))
+    assert [e.kind for e in inj.on_fleet_tick(1)] \
+        == [FaultKind.REPLICA_POISON]
+    assert inj.on_fleet_tick(2) == []              # fire-once event
+    for rid in (0, 1):                             # ...persistent effect
+        t = Task(rid)
+        inj.on_serve_retire(t, replica=2)
+        assert t.margins[0] > 100.0
+    clean = Task(2)
+    inj.on_serve_retire(clean, replica=1)          # other replicas clean
+    assert clean.margins == [0.5]
+    inj.heal_replica(2)
+    healed = Task(3)
+    inj.on_serve_retire(healed, replica=2)
+    assert healed.margins == [0.5]
+
+
+def test_predict_fleet_counts_and_generate_targets():
+    plan = FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_POISON, target=2),
+        FaultEvent(step=3, kind=FaultKind.REPLICA_CRASH, target=0),
+        FaultEvent(step=5, kind=FaultKind.REPLICA_STALL, target=1),
+        FaultEvent(step=7, kind=FaultKind.REPLICA_SLOWSTART, target=1),
+    ])
+    assert plan.predict_fleet() == {
+        "crashes": 1, "restarts": 1, "stalls": 1, "poisons": 1,
+        "slowstarts": 1, "failover_episodes": 2, "drains": 2,
+        "quarantines": 1,
+    }
+    # Seeded generation draws replica targets for fleet kinds...
+    gen_plan = FaultPlan.generate(7, 50, {FaultKind.REPLICA_CRASH: 0.1},
+                                  num_replicas=3)
+    assert gen_plan.events, "expected some crashes at rate 0.1 over 50"
+    assert all(0 <= e.target < 3 for e in gen_plan.events)
+    assert FaultPlan.generate(
+        7, 50, {FaultKind.REPLICA_CRASH: 0.1}, num_replicas=3,
+    ).events == gen_plan.events                    # reproducible
+    # ...and refuses fleet rates without a replica count.
+    with pytest.raises(ValueError, match="num_replicas"):
+        FaultPlan.generate(0, 10, {FaultKind.REPLICA_STALL: 0.5})
+
+
+def test_workload_generator_is_seeded_bursty_and_skewed():
+    cfg = WorkloadConfig(seed=3, num_requests=256, mean_rps=32.0,
+                         burstiness=0.8)
+    a = generate_workload(cfg, vocab_size=97, max_seq=64)
+    b = generate_workload(cfg, vocab_size=97, max_seq=64)
+    assert a == b                                  # reproducible
+    assert len(a) == 256
+    for item in a:
+        assert len(item.prompt) + item.max_new_tokens <= 64
+        assert all(0 <= t < 97 for t in item.prompt)
+        assert item.max_new_tokens >= 1
+    # Tenant skew: the heavy tenant dominates, every class shows up.
+    names = [i.tenant for i in a]
+    assert names.count("bulk") > names.count("interactive") \
+        > names.count("premium") > 0
+    prios = {i.tenant: i.priority for i in a}
+    assert prios["premium"] > prios["bulk"]
+    # Heavy tail: max prompt length far above the median.
+    plens = sorted(len(i.prompt) for i in a)
+    assert plens[-1] >= 3 * plens[len(plens) // 2]
+    # Burstiness: inter-arrival gaps swing well beyond Poisson jitter —
+    # the shortest-gap decile packs much tighter than the longest.
+    gaps = np.diff([i.t_arrive for i in a])
+    assert np.quantile(gaps, 0.9) > 4 * max(np.quantile(gaps, 0.1), 1e-9)
+    with pytest.raises(ValueError):
+        WorkloadConfig(burstiness=1.0)
+    with pytest.raises(ValueError):
+        WorkloadConfig(tenants=())
+
+
+def test_slowstart_pauses_admissions_without_failover():
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_SLOWSTART, target=0,
+                   severity=5),
+    ]))
+    fleet, fakes = fake_fleet(chaos=inj)
+    fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.RESTARTING
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    assert fleet.requests[fid].live.keys() == {1}  # warmup excluded
+    assert fleet.counters["slowstarts"] == 1
+    assert fleet.counters["failover_episodes"] == 0
+    for _ in range(7):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.HEALTHY
+
+
+def test_invalid_submit_raises_without_orphaning_a_record():
+    """Review regression: an impossible request must fail AT submit with
+    the engine's own semantics and leave NO registered record behind —
+    an orphan (no live attempt, no retry, done=False) would keep
+    ``busy`` True forever and spin run_until_idle to its tick bound."""
+    params = gpt2.init_params(jax.random.PRNGKey(0), CFG)
+    fleet = ServingFleet(params, CFG, num_replicas=2, max_slots=2,
+                         max_seq=32, queue_limit=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=0))
+    with pytest.raises(ValueError, match="empty prompt"):
+        fleet.submit(ServeRequest(prompt=[], max_new_tokens=1))
+    with pytest.raises(ValueError, match="max_seq"):
+        fleet.submit(ServeRequest(prompt=[1] * 30, max_new_tokens=10))
+    assert not fleet.requests and not fleet.busy   # nothing orphaned
+    assert fleet.run_until_idle(max_ticks=2) == {}
+
+
+def test_queue_expiry_does_not_dilute_the_flag_rate_window():
+    """Review regression: a queue-side deadline expiry (placement None —
+    it never held a slot, the monitor never ran) must NOT feed the
+    replica's flag-rate window; otherwise tight-deadline sheds dilute
+    the rate and a poisoned replica hides below the quarantine
+    threshold."""
+    fleet, fakes = fake_fleet(num_replicas=2)
+    fleet._on_terminal(0, ServeResult(request_id=99, tokens=[],
+                                      status="deadline_exceeded",
+                                      ttft_s=None, itl_s=[]), None)
+    assert len(fleet.replicas[0].flags) == 0       # unknown id: no-op
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    rec = fleet.requests[fid]
+    local = rec.live[0].local_id
+    # Queue-side expiry: placement None -> window untouched.
+    fakes[0].queue.pop(local, None)
+    fakes[0].inflight.pop(local, None)
+    fakes[0].retire_hook(ServeResult(request_id=local, tokens=[],
+                                     status="deadline_exceeded",
+                                     ttft_s=None, itl_s=[]), None)
+    fleet.step()
+    assert len(fleet.replicas[0].flags) == 0
+    # Slot-side retirement: placement present -> window fed.
+    fid2 = fleet.submit(ServeRequest(prompt=[2], max_new_tokens=1))
+    fakes[0].complete(fleet.requests[fid2].live[0].local_id,
+                      flagged=True)
+    fleet.step()
+    assert list(fleet.replicas[0].flags) == [1]
+
+
+def test_chaos_on_quarantined_replica_never_launders_trust_state():
+    """Review regression: a CRASH or SLOWSTART landing on a QUARANTINED
+    replica must not cancel its cool-off or readmit it without a probe
+    — dying is not an exit from the trust ladder."""
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=3, kind=FaultKind.REPLICA_CRASH, target=0),
+        FaultEvent(step=4, kind=FaultKind.REPLICA_SLOWSTART, target=0,
+                   severity=1),
+    ]))
+    fleet, fakes = fake_fleet(chaos=inj, quarantine_cooloff_ticks=1000)
+    rep = fleet.replicas[0]
+    rep.state = ReplicaState.QUARANTINED
+    rep.cooloff_until = 1000
+    for _ in range(6):
+        fleet.step()
+    assert rep.state is ReplicaState.QUARANTINED   # ladder intact
+    assert rep.cooloff_until == 1000               # cool-off untouched
+    assert rep.engine is None                      # crash still landed
+    assert fleet.counters["crashes"] == 1
+    assert fleet.counters["failover_episodes"] == 0  # held no work
+
+
+def test_failover_emits_one_event_with_the_replica_it_left():
+    """Review regression: exactly ONE fleet_failover trace event per
+    failover, naming the replica the request actually left — so
+    event-count-vs-counter reconciliation holds and forensics don't
+    misattribute the failing replica."""
+
+    class RecordingTrace:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, type, **data):
+            self.events.append({"type": getattr(type, "value", type),
+                                **data})
+
+    trace = RecordingTrace()
+    fleet, fakes = fake_fleet(num_replicas=3, backoff_base_ticks=0,
+                              max_retries=4)
+    fleet.trace = trace
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    first = fleet.requests[fid].live
+    assert set(first) == {0}
+
+    def shed_current():
+        rec = fleet.requests[fid]
+        for rep_idx, att in list(rec.live.items()):
+            fakes[rep_idx].queue.pop(att.local_id, None)
+            fakes[rep_idx].inflight.pop(att.local_id, None)
+            fakes[rep_idx].retire_hook(
+                ServeResult(request_id=att.local_id, tokens=[],
+                            status="no_capacity", ttft_s=None, itl_s=[]),
+                None)
+            return rep_idx
+
+    left_a = shed_current()
+    fleet.step()
+    left_b = shed_current()
+    fleet.step()
+    failovers = [e for e in trace.events if e["type"] == "fleet_failover"]
+    assert len(failovers) == fleet.counters["failovers"] == 2
+    assert [e["from_replica"] for e in failovers] == [left_a, left_b]
+
+
+def test_engine_trace_events_carry_replica_in_fleet_mode():
+    """Review regression: replica-local request ids are ambiguous on a
+    shared TraceBus — every engine lifecycle event must carry the
+    replica index when the engine runs inside a fleet (standalone
+    engines stay untagged)."""
+
+    class RecordingTrace:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, type, **data):
+            self.events.append({"type": getattr(type, "value", type),
+                                **data})
+
+    params = gpt2.init_params(jax.random.PRNGKey(0), CFG)
+    trace = RecordingTrace()
+    from trustworthy_dl_tpu.serve import ServingEngine
+
+    tagged = ServingEngine(params, CFG, max_slots=1, max_seq=32,
+                           trace=trace, replica_id=1)
+    tagged.submit(ServeRequest(prompt=[1, 2], max_new_tokens=1))
+    assert trace.events and all(e.get("replica") == 1
+                                for e in trace.events)
+    plain = ServingEngine(params, CFG, max_slots=1, max_seq=32,
+                          trace=trace)
+    plain.submit(ServeRequest(prompt=[1, 2], max_new_tokens=1))
+    assert "replica" not in trace.events[-1]
+
+
+def test_replay_workload_drives_any_serving_surface():
+    """The shared open-loop driver (bench + CLI use this one spelling):
+    submits each arrival on time, steps while busy, returns accepted."""
+    fleet, fakes = fake_fleet(num_replicas=2)
+    items = generate_workload(WorkloadConfig(seed=1, num_requests=4,
+                                             mean_rps=10_000.0), 97, 48)
+
+    class AutoComplete:
+        """Wrap the fleet so every admitted attempt finishes next tick
+        (FakeEngines never finish on their own)."""
+
+        busy = property(lambda self: fleet.busy)
+
+        def submit(self, request):
+            return fleet.submit(request)
+
+        def step(self):
+            for fake in fakes.values():
+                for rid in list(fake.inflight):
+                    fake.complete(rid)
+            return fleet.step()
+
+    from trustworthy_dl_tpu.serve import replay_workload
+
+    accepted = replay_workload(AutoComplete(), items, lambda item:
+                               ServeRequest(prompt=list(item.prompt),
+                                            max_new_tokens=1))
+    assert accepted == 4
+    assert sorted(fleet.results) == list(range(4))
+    assert all(r.status == "completed" for r in fleet.results.values())
+
+
+# --------------------------------------------------------------------------
+# Slow tier: THE seeded drill over real engines
+# --------------------------------------------------------------------------
+
+
+class PoisonSignatureMonitor:
+    """Deterministic stand-in for the drill: flags exactly the chaos
+    poison signature (margin >> any real logit margin).  The z-score
+    monitor's statistics are covered by test_serve/test_chaos; the
+    drill pins the FLEET's response to flags, which must not depend on
+    how many requests the rolling baseline has absorbed."""
+
+    def observe(self, entropies, margins):
+        poisoned = float(np.mean(margins)) > 100.0
+        return poisoned, (99.0 if poisoned else 0.0)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_drill_matches_predict_and_reference_streams():
+    """THE acceptance drill: REPLICA_POISON + REPLICA_CRASH +
+    REPLICA_STALL in one seeded plan over 3 real engines.  Recovery
+    counts match ``predict_fleet()`` exactly, every accepted request
+    retires with an explicit status (zero silently dropped), all
+    surviving streams are bit-identical to single-engine generate(),
+    and the fleet attribution ledger reconciles against every replica
+    generation's block journal — including records whose attempts span
+    two replicas' allocators."""
+    params = gpt2.init_params(jax.random.PRNGKey(0), CFG)
+    plan = FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_POISON, target=2),
+        FaultEvent(step=3, kind=FaultKind.REPLICA_CRASH, target=0),
+        FaultEvent(step=6, kind=FaultKind.REPLICA_STALL, target=1,
+                   severity=10),
+    ])
+    inj = FaultInjector(plan)
+    ledger = AttributionLedger(None)
+    fleet = ServingFleet(
+        params, CFG,
+        fleet_config=FleetConfig(
+            num_replicas=3, max_retries=6, heartbeat_miss_limit=3,
+            restart_ticks=2, drain_grace_ticks=4,
+            quarantine_cooloff_ticks=10_000,   # stays out for the drill
+        ),
+        chaos=inj, ledger=ledger,
+        max_slots=2, max_seq=48, queue_limit=32,
+        monitor=PoisonSignatureMonitor(),
+    )
+    rng = np.random.default_rng(1)
+    reqs = []
+    for _ in range(12):
+        plen = int(rng.integers(3, 10))
+        new = int(rng.integers(4, 10))
+        prompt = rng.integers(0, CFG.vocab_size, plen).tolist()
+        reqs.append((prompt, new))
+        fleet.submit(ServeRequest(prompt=prompt, max_new_tokens=new))
+    results = fleet.run_until_idle(max_ticks=2000)
+
+    # Exactly the plan-predicted recovery counts.
+    predicted = plan.predict_fleet()
+    observed = {k: fleet.counters[k] for k in predicted}
+    assert observed == predicted, (observed, predicted)
+
+    # Zero lost accepted requests: every one retires explicitly...
+    assert sorted(results) == list(range(12))
+    assert all(r.status == "completed" for r in results.values())
+    # ...and every survivor is bit-identical to the reference.
+    for fid, (prompt, new) in enumerate(reqs):
+        ref = np.asarray(generate(
+            params, CFG, jnp.asarray([prompt], jnp.int32), new,
+            temperature=0.0,
+        ))[0, len(prompt):].tolist()
+        assert results[fid].tokens == ref, f"request {fid}"
+
+    # The poisoned replica ends quarantined; the others recovered.
+    assert fleet.states() == {0: "healthy", 1: "healthy",
+                              2: "quarantined"}
+    # Chaos fired exactly the plan.
+    assert inj.counts() == {"replica_poison": 1, "replica_crash": 1,
+                            "replica_stall": 1}
+
+    # Attribution: reconciles across ALL replica generations, with at
+    # least one record whose attempts span two different journals (a
+    # failed-over request) — the one-record/two-journals contract.
+    ok, problems = fleet.verify_attribution()
+    assert ok, problems
+    records = ledger.records()
+    admitted = [r for r in records if r.get("admitted")]
+    assert sorted(r["request_id"] for r in admitted) == list(range(12))
+    spanning = [r for r in admitted if r.get("attempts")
+                and len({a["journal"] for a in r["attempts"]}) > 1]
+    assert spanning, "no record spans two replicas' journals"
+    # The crash retained its generation's journal alongside the new one.
+    assert "0:0" in fleet.journals and "0:1" in fleet.journals

@@ -832,6 +832,26 @@ def test_every_emit_call_site_uses_a_schema_typed_event():
     assert not offenders, offenders
 
 
+def test_fleet_events_and_gauges_are_inside_the_lint_perimeter():
+    """PR 8 extension: the serving-fleet event types carry full schemas
+    (so the emit lint + validate_event cover them like every other
+    type) and the fleet metric surface keeps the ``tddl_`` naming
+    contract — ``tddl_fleet_replicas{state=}`` and the fail-over/hedge/
+    transition counters are registered via literal names the
+    metric-name lint scans."""
+    assert EVENT_SCHEMAS[EventType.REPLICA_TRANSITION]["fields"] == \
+        ("replica", "from_state", "to_state", "reason")
+    assert EVENT_SCHEMAS[EventType.FLEET_FAILOVER]["requires"] == \
+        ("request_id",)
+    assert EVENT_SCHEMAS[EventType.FLEET_FAILOVER]["fields"] == \
+        ("from_replica", "to_replica", "attempt")
+    assert EVENT_SCHEMAS[EventType.FLEET_HEDGE]["fields"] == ("replica",)
+    src = (REPO / "trustworthy_dl_tpu" / "serve" / "fleet.py").read_text()
+    for name in ("tddl_fleet_replicas", "tddl_fleet_failovers_total",
+                 "tddl_fleet_hedges_total", "tddl_fleet_transitions_total"):
+        assert f'"{name}"' in src, name
+
+
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
     (counter/gauge/histogram) starts with ``tddl_`` — the naming

@@ -285,6 +285,36 @@ def test_slo_shed_hook_drops_lowest_priority_newest_first(params):
     assert engine.metrics_summary()["requests_shed_slo"] == 2
 
 
+def test_slo_shed_tiebreak_honours_retry_age(params):
+    """Satellite regression (fleet fail-over depends on this): a shed
+    request RESUBMITTED with ``first_submit_id`` keeps its original
+    age in the shed tie-break.  Without the anchor the retry gets a
+    fresh (newest) id and is shed again first under sustained pressure
+    — a starvation loop where the same request is shed forever."""
+
+    class Breached:
+        breached = True
+
+        def observe(self, *a, **k):
+            pass
+
+        def quantile(self, signal, q):
+            return None
+
+    engine = ServingEngine(params, CFG, max_slots=1, max_seq=32,
+                           queue_limit=8, slo=Breached())
+    # rid 0 was shed earlier and is now RESUBMITTED as rid 1, carrying
+    # its original age; rid 2 arrives after it, same priority.
+    retry = engine.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                       first_submit_id=0))
+    fresh = engine.submit(ServeRequest(prompt=[3, 4], max_new_tokens=2))
+    engine._shed_for_slo()
+    # The genuinely newest request is shed — NOT the retry.
+    assert engine.results[fresh].status == "shed_slo"
+    assert retry not in engine.results
+    assert [t.request_id for t, _ in engine._queue] == [retry]
+
+
 @pytest.mark.slow
 @pytest.mark.obswatch
 def test_full_obs_plane_keeps_streams_bit_identical(params, tmp_path):

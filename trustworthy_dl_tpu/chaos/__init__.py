@@ -12,9 +12,15 @@ from trustworthy_dl_tpu.chaos.injector import (
     SimulatedPreemption,
     corrupt_file,
 )
-from trustworthy_dl_tpu.chaos.plan import FaultEvent, FaultKind, FaultPlan
+from trustworthy_dl_tpu.chaos.plan import (
+    FLEET_KINDS,
+    FaultEvent,
+    FaultKind,
+    FaultPlan,
+)
 
 __all__ = [
+    "FLEET_KINDS",
     "FaultEvent",
     "FaultInjector",
     "FaultKind",

@@ -25,7 +25,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from trustworthy_dl_tpu.chaos.plan import FaultEvent, FaultKind, FaultPlan
+from trustworthy_dl_tpu.chaos.plan import (
+    FLEET_KINDS,
+    FaultEvent,
+    FaultKind,
+    FaultPlan,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -54,6 +59,12 @@ class FaultInjector:
         # emitted as a ``chaos_fault`` event, so a flight-recorder dump
         # can be diffed against ``FaultPlan.predict`` counts.
         self.trace: Any = None
+        # Replicas with an ACTIVE REPLICA_POISON: the event fires once
+        # (at its fleet tick), but the compromise persists — every
+        # request retiring on the replica is poisoned until
+        # :meth:`heal_replica` (so a readmission probe of a
+        # still-compromised replica fails again, as it must).
+        self._poisoned_replicas: Dict[int, float] = {}
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -65,7 +76,9 @@ class FaultInjector:
             self.trace.emit(EventType.CHAOS_FAULT, step=at_step,
                             kind=event.kind.value,
                             scheduled_step=event.step,
-                            severity=event.severity)
+                            severity=event.severity,
+                            target=(event.target if event.target >= 0
+                                    else None))
         return event
 
     def _take_at(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
@@ -153,21 +166,72 @@ class FaultInjector:
                        target, step)
         corrupt_file(target)
 
-    # -- serving hook ------------------------------------------------------
+    # -- serving hooks -----------------------------------------------------
 
-    def on_serve_retire(self, task: Any) -> None:
+    def _poison_signals(self, task: Any, severity: float) -> None:
+        n = max(len(task.entropies), 1)
+        task.entropies[:] = [0.0] * n
+        task.margins[:] = [1e3 * float(severity)] * n
+
+    def on_serve_retire(self, task: Any,
+                        replica: Optional[int] = None) -> None:
         """SERVE_POISON: overwrite the retiring request's output signals
         with a collapsed-entropy / inflated-margin profile (a poisoned
         replica looping on one token) so the engine's output monitor must
-        flag it and quarantine the slot it ran on."""
-        event = self._take_at(int(task.request_id), FaultKind.SERVE_POISON)
-        if event is None:
+        flag it and quarantine the slot it ran on.
+
+        ``replica`` is the retiring engine's ``replica_id`` (None for a
+        standalone engine).  Request ids are replica-LOCAL in a fleet, so
+        a replica-addressed event (``target >= 0``) only fires when the
+        target matches — a poison aimed at replica 1's request 3 must
+        never fire on replica 0's request 3.  An active REPLICA_POISON
+        on this replica poisons EVERY retirement (the fired-once event
+        is the onset; the compromise persists until healed)."""
+        rep = self._poisoned_replicas.get(-1 if replica is None else replica)
+        if rep is not None:
+            self._poison_signals(task, rep)
             return
-        logger.warning("chaos: poisoning serve output of request %d",
-                       task.request_id)
-        n = max(len(task.entropies), 1)
-        task.entropies[:] = [0.0] * n
-        task.margins[:] = [1e3 * float(event.severity)] * n
+        for event in self.plan.at(int(task.request_id),
+                                  FaultKind.SERVE_POISON):
+            if event in self.fired:
+                continue
+            if event.target >= 0 and event.target != replica:
+                continue
+            self._fire(event, int(task.request_id))
+            logger.warning("chaos: poisoning serve output of request %d"
+                           "%s", task.request_id,
+                           "" if replica is None
+                           else f" on replica {replica}")
+            self._poison_signals(task, event.severity)
+            return
+
+    # -- fleet hooks -------------------------------------------------------
+
+    def on_fleet_tick(self, tick: int) -> List[FaultEvent]:
+        """Fire every fleet-granularity event scheduled at/before this
+        tick (fire-once each) and return them — the ``ServingFleet``
+        applies the mechanics (kill/skip/warmup); the injector only
+        keeps the persistent replica-poison state."""
+        out: List[FaultEvent] = []
+        for kind in FLEET_KINDS:
+            for event in self.plan.of_kind(kind):
+                if event.step <= tick and event not in self.fired:
+                    self._fire(event, tick)
+                    logger.warning("chaos: %s on replica %d at tick %d",
+                                   kind.value, event.target, tick)
+                    if kind is FaultKind.REPLICA_POISON:
+                        self._poisoned_replicas[event.target] = \
+                            float(event.severity)
+                    out.append(event)
+        return out
+
+    def heal_replica(self, replica: int) -> None:
+        """Operator action: clear an active REPLICA_POISON (until then a
+        readmitted replica is immediately re-flagged)."""
+        self._poisoned_replicas.pop(replica, None)
+
+    def replica_poisoned(self, replica: int) -> bool:
+        return replica in self._poisoned_replicas
 
 
 def _corrupt_largest_leaf(params: Any) -> Any:

@@ -53,19 +53,54 @@ class FaultKind(str, enum.Enum):
     DATA_LOSS = "data_loss"
     #: Poison a serving slot's output signals for request id ``step`` —
     #: the engine's output monitor must flag and quarantine the slot.
+    #: With ``target >= 0`` the poison is replica-addressed: it only
+    #: fires on the engine whose ``replica_id`` matches (fleet request
+    #: ids are namespaced replica-locally, so an unaddressed poison
+    #: would be ambiguous once N replicas share the id space).
     SERVE_POISON = "serve_poison"
+    # -- fleet-granularity kinds (serve/fleet.py).  ``step`` is the
+    # fleet TICK the event fires on; ``target`` the replica index. --
+    #: Kill replica ``target`` at tick ``step``: its engine (and KV
+    #: pool, allocator journal, in-flight work) is gone.  The fleet must
+    #: fail over every accepted request it held and restart the replica.
+    REPLICA_CRASH = "replica_crash"
+    #: Wedge replica ``target`` for ``severity`` ticks (its engine stops
+    #: making progress) — the missed-tick heartbeat must catch it, drain
+    #: it, and migrate its in-flight requests.
+    REPLICA_STALL = "replica_stall"
+    #: Compromise replica ``target`` from tick ``step`` on: every
+    #: request retiring there gets a collapsed-entropy/inflated-margin
+    #: signal profile, so its monitor flag-rate must cross the
+    #: quarantine threshold → drain → quarantine.  Persists until the
+    #: injector's :meth:`FaultInjector.heal_replica` (a readmission
+    #: probe of a still-poisoned replica must fail again).
+    REPLICA_POISON = "replica_poison"
+    #: Replica ``target`` restarts slowly: after tick ``step`` it takes
+    #: ``severity`` extra ticks of warmup during which it accepts no new
+    #: admissions (goodput dip, no failover/drain).
+    REPLICA_SLOWSTART = "replica_slowstart"
+
+
+#: The serving-fleet kinds (consumed by ``FaultInjector.on_fleet_tick``
+#: / ``on_serve_retire`` rather than the trainer hooks).
+FLEET_KINDS = (FaultKind.REPLICA_CRASH, FaultKind.REPLICA_STALL,
+               FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART)
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:
     """One scheduled fault.  ``step`` is the trainer's *global step* for
-    training-side kinds, the minimum save step for checkpoint kinds, and
-    the request id for ``SERVE_POISON``.  ``severity`` is kind-specific
-    (stall seconds, poison magnitude); unused kinds ignore it."""
+    training-side kinds, the minimum save step for checkpoint kinds, the
+    request id for ``SERVE_POISON`` and the fleet tick for the
+    ``REPLICA_*`` kinds.  ``severity`` is kind-specific (stall
+    seconds/ticks, poison magnitude, slow-start warmup ticks); unused
+    kinds ignore it.  ``target`` addresses a replica (fleet kinds and
+    replica-gated serve poison); ``-1`` = unaddressed (any replica)."""
 
     step: int
     kind: FaultKind
     severity: float = 1.0
+    target: int = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,21 +125,32 @@ class FaultPlan:
     @classmethod
     def generate(cls, seed: int, num_steps: int,
                  rates: Mapping[FaultKind, float],
-                 severity: float = 1.0) -> "FaultPlan":
+                 severity: float = 1.0,
+                 num_replicas: Optional[int] = None) -> "FaultPlan":
         """Seeded Bernoulli draw per (step, kind): the same arguments
         always produce the same plan, so a drill is reproducible from its
-        seed alone.  ``rates`` maps kind -> per-step probability."""
+        seed alone.  ``rates`` maps kind -> per-step probability.
+        ``num_replicas`` seeds a replica ``target`` for the fleet kinds
+        (drawn from the same stream — required when their rates are
+        nonzero, since an unaddressed fleet fault has no victim)."""
         rng = np.random.default_rng(seed)
         events: List[FaultEvent] = []
         # Fixed kind order (enum declaration order) keeps the draw stream
         # stable across python versions / dict orderings.
         kinds = [k for k in FaultKind if rates.get(k, 0.0) > 0.0]
+        if num_replicas is None and any(k in FLEET_KINDS for k in kinds):
+            raise ValueError(
+                "fleet fault rates need num_replicas to draw targets"
+            )
         for step in range(num_steps):
             for kind in kinds:
                 if rng.random() < rates[kind]:
+                    target = (int(rng.integers(num_replicas))
+                              if kind in FLEET_KINDS else -1)
                     events.append(FaultEvent(
                         step=step, kind=kind,
                         severity=float(severity * (0.5 + rng.random())),
+                        target=target,
                     ))
         return cls(seed=seed, events=tuple(events))
 
@@ -141,4 +187,42 @@ class FaultPlan:
             "preemptions": self.count(FaultKind.PREEMPT),
             "dropped_batches": self.count(FaultKind.DATA_LOSS),
             "stalls": self.count(FaultKind.STALL),
+        }
+
+    def predict_fleet(self) -> Dict[str, int]:
+        """Expected ``ServingFleet`` recovery counts for this plan's
+        REPLICA_* events (the serving mirror of :meth:`predict`).
+
+        Valid when events are *isolated* — at most one fleet fault per
+        replica, each given room to complete its recovery arc: a STALL's
+        severity (ticks) exceeds the fleet's heartbeat-miss limit, a
+        poisoned replica retires at least ``flag_min_count`` requests
+        while poisoned, and the drill runs long enough for every drain
+        to complete — but ENDS before any quarantined replica's
+        cool-off expires (or the poison is healed first): an unhealed
+        replica re-trips on every readmission probe by design, adding a
+        drain + quarantine per probe beyond the first.  Drills pin
+        ``quarantine_cooloff_ticks`` past their horizon.  Under those
+        conditions each event's recovery arc is exact:
+
+        * CRASH  → 1 failover episode (everything the replica held
+          migrates at once) + 1 restart;
+        * STALL  → 1 drain (heartbeat trips) + 1 failover episode;
+        * POISON → 1 drain (monitor flag-rate crosses the quarantine
+          threshold) + 1 quarantine;
+        * SLOWSTART → 1 slow-start warmup (goodput only — no failover,
+          drain or quarantine).
+        """
+        crashes = self.count(FaultKind.REPLICA_CRASH)
+        stalls = self.count(FaultKind.REPLICA_STALL)
+        poisons = self.count(FaultKind.REPLICA_POISON)
+        return {
+            "crashes": crashes,
+            "restarts": crashes,
+            "stalls": stalls,
+            "poisons": poisons,
+            "slowstarts": self.count(FaultKind.REPLICA_SLOWSTART),
+            "failover_episodes": crashes + stalls,
+            "drains": stalls + poisons,
+            "quarantines": poisons,
         }

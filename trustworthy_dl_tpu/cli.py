@@ -419,6 +419,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--slo-itl-ms", type=float, default=250.0,
                         help="inter-token-latency SLO target (needs "
                              "--obs-dir)")
+    parser.add_argument("--fleet-replicas", type=int, default=1,
+                        help="serve through a ServingFleet of N engine "
+                             "replicas (replica lifecycle supervision, "
+                             "trust-aware routing, request fail-over "
+                             "with bounded retries, drain/quarantine; "
+                             "README §Fleet).  1 = single engine "
+                             "(default)")
+    parser.add_argument("--hedge-deadline-ms", type=float, default=None,
+                        help="fleet only: launch a hedged duplicate on "
+                             "a second replica when a request's "
+                             "remaining deadline drops below this "
+                             "(first completed attempt wins; the loser "
+                             "is cancelled and recorded hedge_lost)")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -500,15 +513,22 @@ def serve_main(argv: Optional[List[str]] = None,
     extra = {}
     if args.obs_dir:
         from trustworthy_dl_tpu.obs import ObsSession
-        from trustworthy_dl_tpu.obs.slo import default_serve_rules
 
         obs_session = ObsSession(args.obs_dir)
         obs_session.enable_spans()
+        obs_session.open_ledger()
+    if args.fleet_replicas > 1:
+        # Fleet mode builds PER-REPLICA watchers from the SLO flags (a
+        # breach is a replica-local signal) — the session-level watcher
+        # pair stays uninstalled rather than sitting attached-but-unfed.
+        return _serve_fleet(args, trainer, cfg, serve_config, obs_session)
+    if obs_session is not None:
+        from trustworthy_dl_tpu.obs.slo import default_serve_rules
+
         obs_session.install_watchers(slo_rules=default_serve_rules(
             ttft_target_s=args.slo_ttft_ms / 1e3,
             itl_target_s=args.slo_itl_ms / 1e3,
         ))
-        obs_session.open_ledger()
         extra = dict(spans=obs_session.spans, ledger=obs_session.ledger,
                      slo=obs_session.slo, anomaly=obs_session.anomaly)
     engine = ServingEngine.from_config(
@@ -566,6 +586,87 @@ def serve_main(argv: Optional[List[str]] = None,
             print(f"  !! {p}")
         if obs_session.slo.active:
             print(f"SLO breaches active: {obs_session.slo.active}")
+        obs_session.finalize()
+        print(f"obs artifacts in {args.obs_dir}")
+    trainer.cleanup()
+    return 0
+
+
+def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
+    """The ``--fleet-replicas N`` serve path: a ServingFleet over the
+    seeded workload generator (bursty arrivals, heavy-tailed lengths,
+    tenant priority skew) — the smoke-deployment mirror of the
+    single-engine loop."""
+    import jax
+
+    from trustworthy_dl_tpu.serve import (
+        FleetConfig,
+        ServeRequest,
+        ServingFleet,
+        WorkloadConfig,
+        generate_workload,
+    )
+    from trustworthy_dl_tpu.serve.workload import replay_workload
+
+    slo_rules = None
+    if obs_session is not None:
+        from trustworthy_dl_tpu.obs.slo import default_serve_rules
+
+        # The SLO flags become PER-REPLICA watcher rules: each replica
+        # sheds its own breached admissions and feeds its own
+        # degraded-signal, instead of one fleet-wide watcher conflating
+        # every replica's latency stream.
+        slo_rules = default_serve_rules(
+            ttft_target_s=args.slo_ttft_ms / 1e3,
+            itl_target_s=args.slo_itl_ms / 1e3,
+        )
+    # One source of truth for the serving knobs: the SAME validated
+    # ServeConfig the single-engine path uses, via from_config.
+    fleet = ServingFleet.from_config(
+        trainer.state.params, cfg, serve_config,
+        fleet_config=FleetConfig(
+            num_replicas=args.fleet_replicas,
+            hedge_deadline_s=(args.hedge_deadline_ms / 1e3
+                              if args.hedge_deadline_ms else None),
+        ),
+        rng=jax.random.PRNGKey(args.seed),
+        trace=obs_session.trace if obs_session else None,
+        registry=obs_session.registry if obs_session else None,
+        spans=obs_session.spans if obs_session else None,
+        ledger=obs_session.ledger if obs_session else None,
+        slo_rules=slo_rules,
+        enable_monitor=not args.no_monitor,
+    )
+    workload = generate_workload(
+        WorkloadConfig(seed=args.seed, num_requests=args.num_requests,
+                       prompt_median=args.prompt_len,
+                       output_median=max(args.max_new_tokens // 2, 1),
+                       max_output=args.max_new_tokens),
+        cfg.vocab_size, args.max_seq,
+    )
+    deadline = args.deadline_ms / 1e3 if args.deadline_ms else None
+    submitted = replay_workload(fleet, workload, lambda item: ServeRequest(
+        prompt=list(item.prompt), max_new_tokens=item.max_new_tokens,
+        temperature=args.temperature, priority=item.priority,
+        deadline_s=(deadline if deadline is not None
+                    else item.deadline_s),
+    ))
+    summary = fleet.metrics_summary()
+    print(f"fleet served {submitted} request(s) on "
+          f"{args.fleet_replicas} replica(s) x {args.max_slots} slot(s)")
+    for key in ("statuses", "completed_tokens", "replica_states", "ticks",
+                "fleet_failovers", "fleet_hedges", "fleet_drains",
+                "fleet_quarantines", "fleet_restarts",
+                "replica_slo_active"):
+        if key in summary:
+            print(f"  {key}: {summary[key]}")
+    if obs_session is not None:
+        ok, problems = fleet.verify_attribution()
+        print(f"attribution: {fleet.ledger.total} record(s), "
+              f"fleet block-lifecycle reconciliation "
+              f"{'OK' if ok else 'FAILED'}")
+        for p in problems[:5]:
+            print(f"  !! {p}")
         obs_session.finalize()
         print(f"obs artifacts in {args.obs_dir}")
     trainer.cleanup()

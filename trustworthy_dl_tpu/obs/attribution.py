@@ -114,36 +114,18 @@ def read_ledger(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     return header, records
 
 
-def verify_attribution(records: Iterable[Dict[str, Any]],
-                       allocator: Any) -> Tuple[bool, List[str]]:
-    """Cross-check ledger records against a ``BlockAllocator``'s
-    lifecycle journal.  Returns ``(ok, problems)``.
-
-    Checks per admitted paged record:
-
-    * block ids are unique, in ``[1, num_blocks]`` and never the trash
-      block (a record claiming block 0 would mean a request attended to
-      the garbage sink);
-    * every claimed block has at least one ``alloc`` journal entry (it
-      physically existed in the pool's handed-out set);
-    * per block, lifetime releases never exceed lifetime
-      ``alloc + incref`` references (no double free slipped through);
-    * a block no longer referenced is on the free list or quarantined —
-      never in limbo (the allocator's own invariant, asserted from the
-      outside).
-
-    Stripe-layout records only carry a slot id (no block pool); they
-    verify as ``slot >= 0``.
-    """
-    problems: List[str] = []
+def _journal_digest(allocator: Any) -> Tuple[Dict[int, int], Dict[int, int],
+                                             Dict[int, int]]:
+    """(allocs, refs, releases) per block from the allocator's lifecycle
+    evidence — exact ``lifetime`` counters when present (bounded by pool
+    size, never run length: the ring journal alone would false-positive
+    "never allocated" once a long-pinned block's entry rotated out),
+    the ring journal replay otherwise."""
     allocs: Dict[int, int] = {}
     refs: Dict[int, int] = {}
     releases: Dict[int, int] = {}
     lifetime = getattr(allocator, "lifetime", None)
     if lifetime is not None:
-        # Exact cumulative per-block counts (bounded by pool size, never
-        # by run length) — the ring journal would false-positive "never
-        # allocated" once a long-pinned block's entry rotated out.
         for block, counts in lifetime.items():
             allocs[block] = counts.get("alloc", 0)
             refs[block] = counts.get("alloc", 0) + counts.get("incref", 0)
@@ -160,47 +142,130 @@ def verify_attribution(records: Iterable[Dict[str, Any]],
                 releases[block] = releases.get(block, 0) + 1
             # "unquarantine" re-enters the free pool without dropping a
             # reference — it does not change the accounting.
+    return allocs, refs, releases
 
+
+def _check_placement(rid: Any, placement: Dict[str, Any], allocator: Any,
+                     digest: Tuple[Dict[int, int], Dict[int, int],
+                                   Dict[int, int]],
+                     problems: List[str], where: str = "") -> None:
+    """The per-placement block checks (shared by single-engine records
+    and each fleet attempt): unique non-trash in-pool block ids, every
+    claimed block really allocated per the journal, releases never
+    exceeding references, prefix ⊆ table.  Stripe placements only carry
+    a slot id (no block pool); they verify as ``slot >= 0``."""
+    if placement.get("layout") == "stripe":
+        if placement.get("slot", -1) < 0:
+            problems.append(f"request {rid}{where}: stripe record "
+                            "without a slot id")
+        return
+    allocs, refs, releases = digest
+    blocks = placement.get("block_ids") or []
+    if len(set(blocks)) != len(blocks):
+        problems.append(f"request {rid}{where}: duplicate block ids "
+                        f"{blocks}")
+    prefix = set(placement.get("prefix_block_ids") or [])
+    if not prefix <= set(blocks):
+        problems.append(f"request {rid}{where}: prefix blocks "
+                        f"{sorted(prefix)} not a subset of its table "
+                        f"{blocks}")
+    num_blocks = getattr(allocator, "num_blocks", None)
+    for b in blocks:
+        if b == 0:
+            problems.append(f"request {rid}{where}: claims the trash "
+                            "block")
+            continue
+        if num_blocks is not None and not 1 <= b <= num_blocks:
+            problems.append(f"request {rid}{where}: block {b} outside "
+                            f"the pool [1, {num_blocks}]")
+            continue
+        if allocs.get(b, 0) < 1:
+            problems.append(f"request {rid}{where}: block {b} was never "
+                            "allocated per the journal")
+        if releases.get(b, 0) > refs.get(b, 0):
+            problems.append(f"request {rid}{where}: block {b} released "
+                            f"{releases[b]}x with only "
+                            f"{refs.get(b, 0)} references")
+
+
+def verify_attribution(records: Iterable[Dict[str, Any]],
+                       allocator: Any) -> Tuple[bool, List[str]]:
+    """Cross-check ledger records against ``BlockAllocator`` lifecycle
+    journals.  Returns ``(ok, problems)``.
+
+    ``allocator`` is either one allocator (single engine) or a mapping
+    of journal key → allocator (a fleet: one lifecycle journal per
+    replica *generation* — a restarted replica's fresh pool must not be
+    asked to vouch for blocks its predecessor handed out).  A fleet
+    record carries the canonical stream once plus an ``attempts`` list;
+    each attempt names its journal (``journal`` key, falling back to
+    ``replica``), so ONE record's blocks can span two replicas'
+    allocators and still reconcile.
+
+    Checks per admitted record (or per attempt): block ids unique, in
+    ``[1, num_blocks]`` and never the trash block; every claimed block
+    has an ``alloc`` journal entry; per block, lifetime releases never
+    exceed lifetime ``alloc + incref`` references; prefix blocks are a
+    subset of the table.  Across records: at most ONE admitted record
+    per request id (a double retire means two replicas both claimed the
+    canonical stream — the dedup-at-retire invariant failed).  Per
+    allocator: an unreferenced block is free or quarantined, never
+    limbo.
+    """
+    import collections.abc as _abc
+
+    problems: List[str] = []
+    fleet = isinstance(allocator, _abc.Mapping) and not hasattr(
+        allocator, "journal")
+    digests: Dict[int, tuple] = {}
+
+    def _resolve(key: Any, rid: Any, where: str):
+        alloc = allocator.get(key) if fleet else allocator
+        if alloc is None:
+            problems.append(f"request {rid}{where}: no lifecycle journal "
+                            f"for allocator key {key!r}")
+            return None, None
+        digest = digests.get(id(alloc))
+        if digest is None:
+            digest = _journal_digest(alloc)
+            digests[id(alloc)] = digest
+        return alloc, digest
+
+    admitted_count: Dict[Any, int] = {}
     for rec in records:
         rid = rec.get("request_id")
         if not rec.get("admitted", True):
-            continue  # never touched a slot or block
-        if rec.get("layout") == "stripe":
-            if rec.get("slot", -1) < 0:
-                problems.append(f"request {rid}: stripe record without a "
-                                "slot id")
-            continue
-        blocks = rec.get("block_ids") or []
-        if len(set(blocks)) != len(blocks):
-            problems.append(f"request {rid}: duplicate block ids {blocks}")
-        prefix = set(rec.get("prefix_block_ids") or [])
-        if not prefix <= set(blocks):
-            problems.append(f"request {rid}: prefix blocks {sorted(prefix)} "
-                            f"not a subset of its table {blocks}")
-        num_blocks = getattr(allocator, "num_blocks", None)
-        for b in blocks:
-            if b == 0:
-                problems.append(f"request {rid}: claims the trash block")
+            continue  # never touched a slot or block (or lost a hedge)
+        admitted_count[rid] = admitted_count.get(rid, 0) + 1
+        attempts = rec.get("attempts")
+        if attempts:
+            for att in attempts:
+                key = att.get("journal", att.get("replica"))
+                where = f" attempt on replica {att.get('replica')}"
+                alloc, digest = _resolve(key, rid, where)
+                if alloc is None:
+                    continue
+                _check_placement(rid, att, alloc, digest, problems, where)
+        else:
+            key = rec.get("journal", rec.get("replica"))
+            alloc, digest = _resolve(key, rid, "")
+            if alloc is None:
                 continue
-            if num_blocks is not None and not 1 <= b <= num_blocks:
-                problems.append(f"request {rid}: block {b} outside the "
-                                f"pool [1, {num_blocks}]")
-                continue
-            if allocs.get(b, 0) < 1:
-                problems.append(f"request {rid}: block {b} was never "
-                                "allocated per the journal")
-            if releases.get(b, 0) > refs.get(b, 0):
-                problems.append(f"request {rid}: block {b} released "
-                                f"{releases[b]}x with only "
-                                f"{refs.get(b, 0)} references")
+            _check_placement(rid, rec, alloc, digest, problems)
+    for rid, n in admitted_count.items():
+        if n > 1:
+            problems.append(f"request {rid}: double retire — {n} admitted "
+                            "records claim its canonical stream")
 
     # Allocator-side invariant: an unreferenced block must be free or
     # quarantined (never limbo).  Only checkable for real allocators.
-    free = getattr(allocator, "_free", None)
-    ref_now = getattr(allocator, "_ref", None)
-    quarantined = getattr(allocator, "quarantined", set())
-    num_blocks = getattr(allocator, "num_blocks", None)
-    if free is not None and ref_now is not None and num_blocks is not None:
+    for alloc in (allocator.values() if fleet else (allocator,)):
+        free = getattr(alloc, "_free", None)
+        ref_now = getattr(alloc, "_ref", None)
+        quarantined = getattr(alloc, "quarantined", set())
+        num_blocks = getattr(alloc, "num_blocks", None)
+        if free is None or ref_now is None or num_blocks is None:
+            continue
         for b in range(1, num_blocks + 1):
             if b not in ref_now and b not in free and b not in quarantined:
                 problems.append(f"block {b} is unreferenced but neither "

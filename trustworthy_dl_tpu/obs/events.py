@@ -69,6 +69,14 @@ class EventType(str, enum.Enum):
     SLO_BREACH = "slo_breach"
     ANOMALY = "anomaly"
     ATTRIBUTION = "attribution"
+    # Serving fleet (serve/fleet.py): replica lifecycle + request
+    # fail-over.  ``request_id`` on fleet events is the FLEET id; the
+    # ENGINE lifecycle events (serve_submit/admit/retire/...) keep
+    # replica-LOCAL ids but carry a ``replica`` field whenever the
+    # engine runs inside a fleet, so a shared trace stays joinable.
+    REPLICA_TRANSITION = "replica_transition"
+    FLEET_FAILOVER = "fleet_failover"
+    FLEET_HEDGE = "fleet_hedge"
 
 
 #: type -> {"requires": base correlation keys, "fields": required extras}.
@@ -124,6 +132,19 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     EventType.ANOMALY: {"requires": (), "fields": ("signal", "zscore")},
     EventType.ATTRIBUTION: {"requires": ("request_id",),
                             "fields": ("slot", "n_blocks", "token_hash")},
+    # Fleet lifecycle is replica-keyed, not request-keyed: a transition
+    # (healthy → degraded → draining → quarantined → restarting) names
+    # the replica, the states, and the signal that drove it.
+    EventType.REPLICA_TRANSITION: {
+        "requires": (),
+        "fields": ("replica", "from_state", "to_state", "reason"),
+    },
+    EventType.FLEET_FAILOVER: {
+        "requires": ("request_id",),
+        "fields": ("from_replica", "to_replica", "attempt"),
+    },
+    EventType.FLEET_HEDGE: {"requires": ("request_id",),
+                            "fields": ("replica",)},
 }
 
 

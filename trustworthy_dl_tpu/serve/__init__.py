@@ -23,6 +23,17 @@ answer, shaped for XLA's static-shape world:
   anomalous generations quarantining the issuing slot — the inference
   mirror of the training-side trust state machine.
 
+* ``fleet``     — the robustness layer (README §Fleet): N engine
+  replicas behind one ``submit()`` with a per-replica lifecycle state
+  machine (healthy → degraded → draining → quarantined → restarting)
+  driven by the obs signals, request fail-over with bounded retries +
+  hedged duplicates (dedup-at-retire), trust-aware routing/drain, and
+  seeded REPLICA_* chaos drills with ``predict_fleet()``-pinned
+  outcomes.
+* ``workload``  — seeded traffic generator (bursty arrivals,
+  heavy-tailed prompt/output lengths, tenant priority skew) for the
+  scenario battery and the ``TDDL_BENCH_FLEET`` sweep.
+
 The int8 quantization tier (``quant/``, ``ServeConfig.kv_dtype`` /
 ``weight_dtype``) roughly halves KV bytes per slot (per-(head, position)
 scaled int8 K/V — ~2x the slot pool at fixed HBM) and the decode weight
@@ -37,6 +48,20 @@ from trustworthy_dl_tpu.serve.engine import (
     ServeRequest,
     ServeResult,
     ServingEngine,
+)
+from trustworthy_dl_tpu.serve.fleet import (
+    FleetConfig,
+    FleetResult,
+    ReplicaState,
+    ServingFleet,
+    backoff_ticks,
+)
+from trustworthy_dl_tpu.serve.workload import (
+    Tenant,
+    WorkloadConfig,
+    WorkloadItem,
+    generate_workload,
+    replay_workload,
 )
 from trustworthy_dl_tpu.serve.kv_slots import (
     BlockAllocator,
@@ -60,21 +85,31 @@ from trustworthy_dl_tpu.serve.scheduler import (
 __all__ = [
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "FleetConfig",
+    "FleetResult",
     "OutputMonitor",
     "PagedBatchingScheduler",
     "PagedKV",
     "PrefixCache",
+    "ReplicaState",
     "ServeConfig",
     "ServeRequest",
     "ServeResult",
     "ServingEngine",
+    "ServingFleet",
     "SlotAllocator",
     "SlotKV",
+    "Tenant",
+    "WorkloadConfig",
+    "WorkloadItem",
+    "backoff_ticks",
     "choose_bucket",
     "default_buckets",
+    "generate_workload",
     "init_paged_pool",
     "init_slots",
     "kv_bytes_per_slot",
     "kv_bytes_per_token",
     "paged_pool_blocks",
+    "replay_workload",
 ]

@@ -69,7 +69,14 @@ class ServeRequest:
     ``on_token`` streams each token as ``on_token(request_id, token)``;
     ``priority`` orders load shedding under an SLO breach — when the
     attached watcher is burning budget, the LOWEST-priority queued
-    requests are shed first (ties: newest first)."""
+    requests are shed first (ties: newest first).  ``first_submit_id``
+    is the retry-age anchor: a resubmission of a previously shed/failed
+    request carries its ORIGINAL submission's id so the shed tie-break
+    treats it as old as it really is (without it a retry gets a fresh —
+    newest — id and is shed again first under sustained pressure;
+    fleet fail-over depends on this).  ``span_parent`` re-parents the
+    request's ``serve.request`` span under an outer span (the fleet's
+    per-attempt span, so one request's timeline survives fail-over)."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -79,14 +86,18 @@ class ServeRequest:
     rng: Optional[jax.Array] = None
     on_token: Optional[Callable[[int, int], None]] = None
     priority: int = 0
+    first_submit_id: Optional[int] = None
+    span_parent: Optional[int] = None
 
 
 @dataclasses.dataclass
 class ServeResult:
     request_id: int
     tokens: List[int]
-    # completed | deadline_exceeded | no_capacity (shed because every
-    # slot was quarantined — see run_until_idle)
+    # completed | deadline_exceeded | shed_slo | no_capacity (shed
+    # because every slot was quarantined — see run_until_idle) | any
+    # caller-chosen status passed to cancel() (the fleet uses
+    # "migrated" / "hedge_lost" / "failover")
     status: str
     ttft_s: Optional[float]        # submit -> first token
     itl_s: List[float]             # inter-token latencies
@@ -164,7 +175,9 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  spans: Any = None, ledger: Any = None,
                  slo: Any = None, anomaly: Any = None,
-                 retain_results: int = 1024):
+                 retain_results: int = 1024,
+                 replica_id: Optional[int] = None,
+                 retire_hook: Optional[Callable[..., None]] = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -353,6 +366,24 @@ class ServingEngine:
         self.ledger = ledger
         self.slo = slo
         self.anomaly = anomaly
+        # Fleet integration (serve/fleet.py): ``replica_id`` names this
+        # engine in a ServingFleet — it gates replica-addressed chaos
+        # (request ids are replica-local, so an unaddressed poison would
+        # be ambiguous across N replicas) and rides trace/ledger rows.
+        # ``retire_hook(result, placement)`` fires synchronously at
+        # every terminal state — placement is the scheduler's
+        # attribution snapshot for admitted requests (None otherwise) —
+        # so the fleet sees failures the instant they happen instead of
+        # polling ``results``.
+        self.replica_id = replica_id
+        # Every engine trace event carries the replica index in fleet
+        # mode: request ids are replica-LOCAL, so without the tag a
+        # shared TraceBus cannot tell replica 0's request 3 from
+        # replica 1's (the same ambiguity the replica-gated chaos hook
+        # closes for SERVE_POISON).
+        self._trace_tags = ({"replica": replica_id}
+                            if replica_id is not None else {})
+        self.retire_hook = retire_hook
         self.scheduler.spans = spans
         self._req_spans: Dict[int, Dict[str, int]] = {}  # rid -> open ids
         # Bounded completed-request retention: ``results`` keeps at most
@@ -449,10 +480,12 @@ class ServingEngine:
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_SUBMIT, request_id=request_id,
                             prompt_len=int(prompt.size),
-                            max_new_tokens=int(request.max_new_tokens))
+                            max_new_tokens=int(request.max_new_tokens), **self._trace_tags)
         if self.spans is not None:
             root = self.spans.start("serve.request", kind="serve",
+                                    parent_id=request.span_parent,
                                     request_id=request_id,
+                                    replica=self.replica_id,
                                     prompt_len=int(prompt.size),
                                     max_new_tokens=int(
                                         request.max_new_tokens))
@@ -464,10 +497,13 @@ class ServingEngine:
 
     # -- terminal bookkeeping ----------------------------------------------
 
-    def _record_result(self, result: ServeResult) -> None:
+    def _record_result(self, result: ServeResult,
+                       placement: Optional[Dict[str, Any]] = None) -> None:
         """The ONE rollup path every terminal state goes through: status
         counters (exact forever), bounded ``results`` retention (oldest
-        evicted first), registry counter."""
+        evicted first), registry counter, and the fleet's
+        ``retire_hook`` (placement = the scheduler's attribution
+        snapshot for admitted requests, None for queue-side sheds)."""
         self._status_counts[result.status] = \
             self._status_counts.get(result.status, 0) + 1
         if result.flagged:
@@ -476,6 +512,8 @@ class ServingEngine:
         while len(self.results) > self.retain_results:
             del self.results[next(iter(self.results))]
         self._req_counter.inc(status=result.status)
+        if self.retire_hook is not None:
+            self.retire_hook(result, placement)
 
     def _close_request_spans(self, rid: int, status: str,
                              **attrs: Any) -> None:
@@ -516,13 +554,26 @@ class ServingEngine:
             "tokens": 0, "token_hash": attribution.token_hash([]),
         })
 
+    def _request_age_id(self, task: SlotTask, request: ServeRequest) -> int:
+        """Submission-order age for shed tie-breaks: the ORIGINAL
+        submission's id when the request is a retry
+        (``first_submit_id``), its own id otherwise.  Without the
+        anchor, a shed-and-resubmitted request gets a fresh (newest) id
+        and is shed again first under sustained pressure — a retry
+        starvation loop the fleet's fail-over path would otherwise
+        inherit."""
+        if request.first_submit_id is not None:
+            return int(request.first_submit_id)
+        return int(task.request_id)
+
     def _shed_for_slo(self) -> None:
         """The watcher's host-side shed hook: while an SLO rule is
         burning budget (or an anomaly is active), drop the
-        LOWEST-priority queued request (ties: newest first) — but only
-        when the queue exceeds the currently free capacity, so shedding
-        relieves real pressure instead of burning goodput.  At most one
-        shed per iteration: pressure is re-evaluated every step."""
+        LOWEST-priority queued request (ties: newest first, by ORIGINAL
+        submission age — retries inherit theirs) — but only when the
+        queue exceeds the currently free capacity, so shedding relieves
+        real pressure instead of burning goodput.  At most one shed per
+        iteration: pressure is re-evaluated every step."""
         breached = ((self.slo is not None and self.slo.breached)
                     or (self.anomaly is not None
                         and self.anomaly.any_active))
@@ -531,7 +582,8 @@ class ServingEngine:
         if len(self._queue) <= self.scheduler.allocator.free_count:
             return
         idx = min(range(len(self._queue)),
-                  key=lambda i: (self._queue[i][1].priority, -i))
+                  key=lambda i: (self._queue[i][1].priority,
+                                 -self._request_age_id(*self._queue[i])))
         task, _request = self._queue[idx]
         del self._queue[idx]
         rid = task.request_id
@@ -543,7 +595,7 @@ class ServingEngine:
         ))
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
-                            status="shed_slo", tokens=0, admitted=False)
+                            status="shed_slo", tokens=0, admitted=False, **self._trace_tags)
         self._close_request_spans(rid, "shed_slo")
         self._ledger_unadmitted(rid, "shed_slo")
 
@@ -576,7 +628,7 @@ class ServingEngine:
             self._inflight[rid] = (task, request)
             if self.trace is not None:
                 self.trace.emit(EventType.SERVE_ADMIT, request_id=rid,
-                                slot=int(task.slot))
+                                slot=int(task.slot), **self._trace_tags)
             handles = self._req_spans.get(rid)
             if handles is not None:
                 sid = handles.pop("queued", None)
@@ -693,7 +745,7 @@ class ServingEngine:
                         self.trace.emit(EventType.SERVE_RETIRE,
                                         request_id=rid,
                                         status="no_capacity", tokens=0,
-                                        admitted=False)
+                                        admitted=False, **self._trace_tags)
                     self._close_request_spans(rid, "no_capacity")
                     self._ledger_unadmitted(rid, "no_capacity")
                 break
@@ -727,26 +779,89 @@ class ServingEngine:
                 if self.trace is not None:
                     self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
                                     status="deadline_exceeded", tokens=0,
-                                    admitted=False)
+                                    admitted=False, **self._trace_tags)
                 self._close_request_spans(rid, "deadline_exceeded")
                 self._ledger_unadmitted(rid, "deadline_exceeded")
             else:
                 keep.append((task, request))
         self._queue = keep
 
+    def cancel(self, request_id: int, status: str = "cancelled") -> bool:
+        """Terminate a queued or in-flight request NOW with ``status``
+        (no monitor scoring, no quarantine): the fleet's migrate/hedge
+        hook — a draining replica's queue moves elsewhere, a lost
+        hedge's duplicate stream stops burning decode slots.  Resources
+        (slot, blocks) free immediately; partial tokens ride the result.
+        Returns False when the id is unknown/already terminal."""
+        for i in range(len(self._queue)):
+            task, _request = self._queue[i]
+            if task.request_id != request_id:
+                continue
+            del self._queue[i]
+            self._submit_t.pop(request_id, None)
+            self._record_result(ServeResult(
+                request_id=request_id, tokens=[], status=status,
+                ttft_s=None, itl_s=[],
+            ))
+            if self.trace is not None:
+                self.trace.emit(EventType.SERVE_RETIRE,
+                                request_id=request_id, status=status,
+                                tokens=0, admitted=False, **self._trace_tags)
+            self._close_request_spans(request_id, status)
+            self._ledger_unadmitted(request_id, status)
+            return True
+        pair = self._inflight.get(request_id)
+        if pair is None:
+            return False
+        task, _request = pair
+        placement = (self.scheduler.attribution_info(task)
+                     if self.ledger is not None
+                     or self.retire_hook is not None else None)
+        self.scheduler.retire(task, quarantine=False)
+        times = self._timing.pop(request_id, [])
+        t0 = self._submit_t.pop(request_id, None)
+        ttft = (times[0] - t0) if times and t0 is not None else None
+        self._record_result(ServeResult(
+            request_id=request_id, tokens=list(task.emitted),
+            status=status, ttft_s=ttft,
+            itl_s=[b - a for a, b in zip(times, times[1:])],
+        ), placement=placement)
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_RETIRE, request_id=request_id,
+                            status=status, tokens=len(task.emitted), **self._trace_tags)
+        if self.ledger is not None:
+            self.ledger.append({
+                "request_id": request_id, "status": status,
+                "admitted": True, **placement,
+                "kv_dtype": self.kv_dtype,
+                "weight_dtype": self.weight_dtype,
+                "kv_fallback_reason": self.kv_fallback_reason,
+                "flagged": False, "monitor_z": 0.0,
+                "tokens": len(task.emitted),
+                "token_hash": attribution.token_hash(task.emitted),
+                "ttft_s": ttft,
+            })
+        self._close_request_spans(request_id, status,
+                                  tokens=len(task.emitted))
+        self._inflight.pop(request_id, None)
+        return True
+
     def _finish(self, task: SlotTask, request: ServeRequest,
                 status: str) -> None:
         rid = task.request_id
         if self.chaos is not None:
             # Chaos hook point: a SERVE_POISON event for this request id
-            # rewrites the recorded entropy/margin signals before the
-            # monitor scores them (simulating a compromised replica).
-            self.chaos.on_serve_retire(task)
+            # (replica-gated — local ids are ambiguous across a fleet)
+            # or an active REPLICA_POISON on this replica rewrites the
+            # recorded entropy/margin signals before the monitor scores
+            # them (simulating a compromised replica).
+            self.chaos.on_serve_retire(task, replica=self.replica_id)
         # Placement snapshot BEFORE retire() clears the slot's table —
         # the attribution record must name the physical blocks the
         # stream actually decoded from.
         placement = (self.scheduler.attribution_info(task)
-                     if self.ledger is not None else None)
+                     if self.ledger is not None
+                     or self.retire_hook is not None else None)
         flagged, z = False, 0.0
         t_mon = time.perf_counter()
         if self.monitor is not None and task.entropies:
@@ -765,7 +880,7 @@ class ServingEngine:
         self._record_result(ServeResult(
             request_id=rid, tokens=list(task.emitted), status=status,
             ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
-        ))
+        ), placement=placement)
         if ttft is not None:
             self._ttft_hist.observe(ttft)
             if self.slo is not None:
@@ -783,10 +898,10 @@ class ServingEngine:
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
                             status=status, tokens=len(task.emitted),
-                            flagged=flagged, monitor_z=z)
+                            flagged=flagged, monitor_z=z, **self._trace_tags)
             if flagged:
                 self.trace.emit(EventType.SERVE_QUARANTINE, request_id=rid,
-                                slot=int(task.slot))
+                                slot=int(task.slot), **self._trace_tags)
         if self.ledger is not None:
             thash = attribution.token_hash(task.emitted)
             record = {
@@ -804,7 +919,7 @@ class ServingEngine:
                 self.trace.emit(EventType.ATTRIBUTION, request_id=rid,
                                 slot=int(task.slot),
                                 n_blocks=len(placement["block_ids"]),
-                                token_hash=thash, flagged=bool(flagged))
+                                token_hash=thash, flagged=bool(flagged), **self._trace_tags)
         self._close_request_spans(rid, status, tokens=len(task.emitted),
                                   flagged=bool(flagged))
         self.metrics.collect_batch_metrics({
@@ -822,6 +937,21 @@ class ServingEngine:
     def busy(self) -> bool:
         """Work still queued or in flight."""
         return bool(self._queue or self._inflight)
+
+    @property
+    def queued_ids(self) -> List[int]:
+        """Local request ids awaiting admission (fleet migrate hook)."""
+        return [task.request_id for task, _ in self._queue]
+
+    @property
+    def inflight_ids(self) -> List[int]:
+        """Local request ids holding a slot (fleet fail-over hook)."""
+        return list(self._inflight)
+
+    @property
+    def load(self) -> int:
+        """Queued + in-flight — the fleet router's least-loaded key."""
+        return len(self._queue) + len(self._inflight)
 
     @property
     def in_service_capacity(self) -> int:

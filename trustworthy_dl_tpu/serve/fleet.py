@@ -1,0 +1,1139 @@
+"""Trust-aware serving fleet: N engine replicas behind one ``submit()``.
+
+One ``ServingEngine`` is one failure domain — a wedged, preempted or
+poisoned replica takes "heavy traffic from millions of users" down with
+it.  ``ServingFleet`` is the robustness layer ROADMAP item 4 calls for,
+reusing the training trust stack at REPLICA granularity:
+
+* **Replica lifecycle state machine** — ``healthy → degraded →
+  draining → quarantined → restarting`` — driven by the obs signals the
+  engines already produce (anomaly-watcher episodes, SLO burn, output-
+  monitor flag rate, missed-tick heartbeat), not new instrumentation.
+  A replica whose monitor flag-rate crosses the quarantine threshold is
+  DRAINED (no new admissions; existing slots run out or migrate) and
+  QUARANTINED with a cool-off readmission probe — mirroring the
+  training-side ``elastic/`` evict → probation → readmit ladder, where
+  re-entry is earned by clean behaviour, not granted by time alone
+  (a still-poisoned replica re-flags during its probe and goes straight
+  back, with a doubled cool-off).
+* **Request fail-over** — a request on a crashed/stalled/draining
+  replica is resubmitted to a healthy one with bounded retries and
+  exponential backoff, inheriting its ORIGINAL submission age
+  (``ServeRequest.first_submit_id``) so sustained pressure cannot
+  starve retries via the shed tie-break.  Requests near their deadline
+  can launch a **hedged duplicate** on a second replica; dedup-at-
+  retire keeps exactly ONE canonical stream per fleet request id — the
+  first completed attempt wins, losers are cancelled and recorded
+  ``admitted: false, status: "hedge_lost"``.
+* **Fleet chaos** — the seeded ``chaos.FaultPlan`` REPLICA_* kinds
+  (crash / stall / poison / slow-start) drive drills whose exact
+  fail-over/drain/quarantine counts are pinned by
+  ``FaultPlan.predict_fleet()``; every attempt is replayed with the
+  request's own rng key, so a survivor's stream is bit-identical to a
+  single-engine ``generate()`` run no matter how many replicas it
+  crossed.
+
+Time: the fleet is a synchronous tick loop (``step()`` = one fleet
+tick: chaos hooks → step each live replica → process retirements →
+supervise lifecycles → retries/hedges).  Backoff, heartbeats, drains,
+cool-offs and restarts are all measured in TICKS so drills are
+deterministic; request deadlines stay wall-clock (they are the user's
+contract, not the scheduler's).
+
+Attribution: each engine runs ledger-less; the FLEET writes one
+canonical record per request at final retirement, carrying an
+``attempts`` list (placement + journal key per attempt) so one record's
+blocks can span two replicas' allocators and still reconcile —
+``verify_attribution`` checks each attempt against its replica
+GENERATION's lifecycle journal (``self.journals``; a crashed replica's
+journal is retained like a flight recording, its successor's fresh pool
+is a new generation).
+
+Streaming caveat: ``on_token`` fires for the PRIMARY attempt's tokens
+as they are produced — after a fail-over the new attempt re-streams
+from token 0, and a winning hedge's tokens may never have streamed
+(at-least-once streaming; exactly-once is the retired result/record).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+
+import jax
+
+from trustworthy_dl_tpu.obs import attribution
+from trustworthy_dl_tpu.obs.events import EventType
+from trustworthy_dl_tpu.obs.registry import get_registry
+from trustworthy_dl_tpu.serve.engine import ServeRequest, ServeResult, \
+    ServingEngine
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaState(str, enum.Enum):
+    """The replica lifecycle ladder (README §Fleet carries the
+    transition table)."""
+
+    HEALTHY = "healthy"          # admitting + serving
+    DEGRADED = "degraded"        # admitting, under suspicion
+    DRAINING = "draining"        # no admissions; slots run out or migrate
+    QUARANTINED = "quarantined"  # out of service, cool-off running
+    RESTARTING = "restarting"    # warming up (restart/probe/slow-start)
+
+
+#: States the router may place new work on.
+ADMITTING = (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+
+#: Statuses that end a fleet request (everything else is an attempt
+#: outcome the fleet recovers from).
+TERMINAL_STATUSES = ("completed", "deadline_exceeded", "shed_slo",
+                     "no_capacity", "failover_exhausted")
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Host-side fleet knobs.  Tick-denominated fields follow the fleet
+    clock (one ``step()`` = one tick), never wall time — drills must be
+    seed-deterministic."""
+
+    num_replicas: int = 2
+    # -- trust: output-monitor flag rate over a sliding retirement window
+    flag_window: int = 16          # retirements per replica remembered
+    flag_min_count: int = 2        # flags before the rate can trip
+    flag_rate_quarantine: float = 0.25  # drain+quarantine at/above this
+    # -- heartbeat (missed fleet ticks without replica progress)
+    heartbeat_miss_degraded: int = 2
+    heartbeat_miss_limit: int = 4  # drain + fail-over at/above this
+    # -- fail-over
+    max_retries: int = 3           # resubmissions per request (all causes)
+    backoff_base_ticks: int = 1    # retry n waits base * mult**(n-1)
+    backoff_mult: float = 2.0
+    # -- hedging (None = off): duplicate a request still unfinished when
+    # its remaining deadline drops below this
+    hedge_deadline_s: Optional[float] = None
+    # -- lifecycle timing (ticks)
+    restart_ticks: int = 2         # warmup after restart / probe re-entry
+    quarantine_cooloff_ticks: int = 32  # first cool-off (doubles each trip)
+    drain_grace_ticks: int = 8     # in-flight allowed this long to run out
+    # -- per-replica watcher attachment (SLO/anomaly watchers as extra
+    # degraded-signals; host-only, no registry gauges per replica)
+    attach_watchers: bool = False
+
+    def __post_init__(self) -> None:
+        if self.num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        if not 0.0 < self.flag_rate_quarantine <= 1.0:
+            raise ValueError("flag_rate_quarantine must be in (0, 1]")
+        if self.flag_min_count < 1 or self.flag_window < self.flag_min_count:
+            raise ValueError("need 1 <= flag_min_count <= flag_window")
+        if self.heartbeat_miss_limit < self.heartbeat_miss_degraded:
+            raise ValueError("heartbeat_miss_limit must be >= "
+                             "heartbeat_miss_degraded")
+        if self.max_retries < 0 or self.backoff_base_ticks < 0:
+            raise ValueError("max_retries/backoff_base_ticks must be >= 0")
+        if self.backoff_mult < 1.0:
+            raise ValueError("backoff_mult must be >= 1")
+
+
+def backoff_ticks(cfg: FleetConfig, attempt: int) -> int:
+    """Ticks resubmission number ``attempt`` (1-based) waits:
+    ``base * mult**(attempt-1)``, floored at the base."""
+    if attempt < 1:
+        raise ValueError("attempt is 1-based")
+    return int(cfg.backoff_base_ticks * cfg.backoff_mult ** (attempt - 1))
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """Terminal record of one fleet request (the canonical stream)."""
+
+    request_id: int                # fleet id
+    tokens: List[int]
+    status: str                    # see TERMINAL_STATUSES
+    replica: Optional[int]         # replica that produced the stream
+    attempts: int                  # submissions it took (1 = no fail-over)
+    ttft_s: Optional[float]        # FIRST fleet submit -> first token
+    flagged: bool = False
+    monitor_z: float = 0.0
+
+
+@dataclasses.dataclass
+class _Attempt:
+    replica: int
+    gen: int
+    local_id: int
+    submit_t: float
+    span: Optional[int] = None     # fleet.attempt span id
+    loser: bool = False            # cancelled as hedge/dedup loser
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    fid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    eos_id: Optional[int]
+    priority: int
+    rng: Any                       # resolved key — EVERY attempt reuses it
+    on_token: Optional[Callable[[int, int], None]]
+    deadline_at: Optional[float]   # absolute perf_counter deadline
+    submit_t: float = 0.0
+    live: Dict[int, _Attempt] = dataclasses.field(default_factory=dict)
+    closed: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    submissions: int = 0
+    retry_due: Optional[int] = None   # tick a pending resubmit is due
+    excluded: Set[int] = dataclasses.field(default_factory=set)
+    hedged: bool = False
+    done: bool = False
+    span_root: Optional[int] = None
+
+
+class _Replica:
+    """One replica's supervision state (host-only)."""
+
+    def __init__(self, index: int, engine: Any, flag_window: int):
+        self.index = index
+        self.engine = engine
+        self.gen = 0
+        self.state = ReplicaState.HEALTHY
+        self.last_progress_tick = 0
+        self.stalled_until = -1     # chaos wedge: step() suspended until
+        self.warm_until = -1        # RESTARTING exits at this tick
+        self.cooloff_until = -1     # QUARANTINED exits at this tick
+        self.cooloff_ticks = 0      # current cool-off length (doubles)
+        self.drain_deadline = -1
+        self.quarantine_pending = False
+        self.reason = ""
+        self.flags: Deque[int] = deque(maxlen=flag_window)
+
+    @property
+    def journal_key(self) -> str:
+        return f"{self.index}:{self.gen}"
+
+    @property
+    def flag_count(self) -> int:
+        return sum(self.flags)
+
+    @property
+    def flag_rate(self) -> float:
+        return self.flag_count / len(self.flags) if self.flags else 0.0
+
+
+class ServingFleet:
+    """N ``ServingEngine`` replicas behind one ``submit()`` surface with
+    replica supervision, fail-over and trust-aware routing (module
+    docstring).  ``engine_kwargs`` pass through to every engine build
+    (max_slots, max_seq, kv_dtype, paged geometry, ...); ``chaos`` is a
+    ``chaos.FaultInjector`` whose REPLICA_* events this loop executes.
+    ``engine_factory(replica_index, **kwargs)`` is the test seam — it
+    must honour the ``replica_id``/``retire_hook``/``monitor`` kwargs
+    the fleet threads through."""
+
+    def __init__(self, params: Any = None, cfg: Any = None, *,
+                 fleet_config: Optional[FleetConfig] = None,
+                 num_replicas: Optional[int] = None,
+                 chaos: Any = None, trace: Any = None, registry: Any = None,
+                 spans: Any = None, ledger: Any = None,
+                 rng: Optional[jax.Array] = None,
+                 engine_factory: Optional[Callable[..., Any]] = None,
+                 slo_rules: Any = None,
+                 **engine_kwargs: Any):
+        self.config = fleet_config or FleetConfig(
+            num_replicas=num_replicas or 2)
+        if num_replicas is not None:
+            self.config = dataclasses.replace(self.config,
+                                              num_replicas=num_replicas)
+        self.chaos = chaos
+        self.trace = trace
+        self.spans = spans
+        self.ledger = ledger
+        self._params = params
+        self._cfg = cfg
+        self._engine_kwargs = dict(engine_kwargs)
+        # Per-replica SLO rules (None + attach_watchers=False = no
+        # watchers).  Watchers are built per REPLICA, not per fleet —
+        # a breach is a replica-local signal (one slow replica must not
+        # shed the whole fleet's admissions) and feeds that replica's
+        # ``watcher_bad`` degraded signal.
+        self._slo_rules = slo_rules
+        self._factory = engine_factory or self._default_factory
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if registry is None:
+            registry = get_registry()
+        self.registry = registry
+        self._replicas_gauge = registry.gauge(
+            "tddl_fleet_replicas", "Replicas per lifecycle state",
+            labels=("state",),
+        )
+        self._failover_counter = registry.counter(
+            "tddl_fleet_failovers_total",
+            "Requests resubmitted after a replica failure/drain",
+        )
+        self._hedge_counter = registry.counter(
+            "tddl_fleet_hedges_total",
+            "Hedged duplicates launched for deadline-pressed requests",
+        )
+        self._transition_counter = registry.counter(
+            "tddl_fleet_transitions_total",
+            "Replica lifecycle transitions, by destination state",
+            labels=("to_state",),
+        )
+        # Fleet-wide occupancy aggregates, refreshed every tick.  The
+        # ENGINE serve gauges (tddl_serve_blocks_in_use, ...) are
+        # unlabelled singletons, so N replicas sharing one registry
+        # last-writer-win each other — autoscaling and dashboards must
+        # read THESE for deployment-level occupancy, and treat the
+        # tddl_serve_* gauges as "some replica's" sample under a fleet.
+        self._tif_gauge = registry.gauge(
+            "tddl_fleet_tokens_in_flight",
+            "Cached tokens backing live sequences, summed over replicas",
+        )
+        self._queue_gauge = registry.gauge(
+            "tddl_fleet_queue_depth",
+            "Queued + in-flight requests, summed over live replicas",
+        )
+        self.tick = 0
+        self._next_fid = 0
+        self.rejected = 0
+        self._max_seq: Optional[int] = None
+        self._max_bucket: Optional[int] = None
+        self.requests: Dict[int, _FleetRequest] = {}
+        self.results: Dict[int, FleetResult] = {}
+        self._local2fleet: Dict[Tuple[int, int], int] = {}
+        self._terminal: Deque[Tuple[int, ServeResult, Optional[dict]]] = \
+            deque()
+        #: journal key ("replica:gen") -> BlockAllocator — RETAINED
+        #: across restarts so records naming a dead generation's blocks
+        #: still reconcile (the post-mortem journal, not the live pool).
+        self.journals: Dict[str, Any] = {}
+        # Drill-facing recovery counters (diffed against predict_fleet).
+        self.counters: Dict[str, int] = {
+            "crashes": 0, "restarts": 0, "stalls": 0, "poisons": 0,
+            "slowstarts": 0, "failover_episodes": 0, "drains": 0,
+            "quarantines": 0, "readmissions": 0, "failovers": 0,
+            "hedges": 0, "hedge_lost": 0,
+        }
+        self.replicas: List[_Replica] = []
+        for i in range(self.config.num_replicas):
+            self.replicas.append(self._build_replica(i))
+        self._set_state_gauge()
+
+    @classmethod
+    def from_config(cls, params: Any, cfg: Any, serve_config: Any,
+                    **kwargs: Any) -> "ServingFleet":
+        """Build a fleet whose replicas all use a validated
+        ``core.config.ServeConfig`` — ONE source of truth for the
+        serving knobs, exactly like ``ServingEngine.from_config``
+        (``kwargs`` pass through for the fleet surfaces: fleet_config,
+        chaos, trace, ledger, ... and any extra engine kwargs)."""
+        return cls(
+            params, cfg,
+            max_slots=serve_config.max_slots,
+            max_seq=serve_config.max_seq,
+            queue_limit=serve_config.queue_limit,
+            kv_dtype=serve_config.kv_dtype,
+            weight_dtype=serve_config.weight_dtype,
+            paged=serve_config.paged,
+            block_size=serve_config.block_size,
+            num_blocks=serve_config.num_blocks,
+            prefix_cache=serve_config.prefix_cache,
+            prefill_chunk=serve_config.prefill_chunk,
+            **kwargs,
+        )
+
+    # -- replica construction ---------------------------------------------
+
+    def _default_factory(self, index: int, **kwargs: Any) -> Any:
+        return ServingEngine(self._params, self._cfg, **kwargs)
+
+    def _engine_build_kwargs(self, index: int) -> Dict[str, Any]:
+        kwargs = dict(self._engine_kwargs)
+        kwargs.setdefault("rng", jax.random.fold_in(self._rng, index))
+        kwargs["replica_id"] = index
+        kwargs["chaos"] = self.chaos
+        kwargs["trace"] = self.trace
+        kwargs["spans"] = self.spans
+        kwargs["registry"] = self.registry
+        kwargs["retire_hook"] = \
+            lambda result, placement, _i=index: \
+            self._terminal.append((_i, result, placement))
+        if self.config.attach_watchers or self._slo_rules is not None:
+            from trustworthy_dl_tpu.obs.anomaly import AnomalyWatcher
+            from trustworthy_dl_tpu.obs.slo import SLOWatcher, \
+                default_serve_rules
+
+            # Host-only per-replica watchers (no registry: N replicas
+            # would fight over one un-labelled gauge set).
+            kwargs.setdefault("slo", SLOWatcher(
+                self._slo_rules if self._slo_rules is not None
+                else default_serve_rules()))
+            kwargs.setdefault("anomaly", AnomalyWatcher())
+        return kwargs
+
+    def _build_replica(self, index: int,
+                       prev: Optional[_Replica] = None) -> _Replica:
+        engine = self._factory(index, **self._engine_build_kwargs(index))
+        rep = prev if prev is not None else _Replica(
+            index, engine, self.config.flag_window)
+        rep.engine = engine
+        rep.flags.clear()
+        self.journals[rep.journal_key] = self._engine_journal(engine)
+        # Geometry limits for submit-time validation, captured ONCE so
+        # impossible requests fail in submit() even when every engine is
+        # momentarily down mid-chaos (all replicas share one geometry).
+        sched = getattr(engine, "scheduler", None)
+        if sched is not None and self._max_seq is None:
+            self._max_seq = sched.max_seq
+            self._max_bucket = max(sched.buckets)
+        return rep
+
+    @staticmethod
+    def _engine_journal(engine: Any) -> Any:
+        sched = getattr(engine, "scheduler", None)
+        return getattr(sched, "blocks", None) or \
+            getattr(sched, "allocator", None)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, request: ServeRequest) -> Optional[int]:
+        """Enqueue one request; returns its FLEET id (engine-local ids
+        are namespaced per replica and never surface).  Returns None —
+        backpressure, exactly like the engine — when every admitting
+        replica rejected it (queues full).  A transiently replica-less
+        fleet (everything draining/restarting mid-chaos) instead PARKS
+        the accepted request and resubmits as capacity returns: an
+        accepted request is never silently dropped."""
+        now = time.perf_counter()
+        # Fail impossible requests HERE, with the engine's own submit
+        # semantics — a parked request must never explode inside the
+        # tick loop, and the record below must never be registered for
+        # a request no replica could ever serve (an orphan would keep
+        # ``busy`` True forever).
+        prompt_len = len(list(request.prompt))
+        if prompt_len == 0:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self._max_seq is not None:
+            total = prompt_len + int(request.max_new_tokens)
+            if total > self._max_seq:
+                raise ValueError(
+                    f"prompt+new = {total} exceeds max_seq="
+                    f"{self._max_seq}")
+            if prompt_len > self._max_bucket:
+                raise ValueError(
+                    f"prompt of {prompt_len} tokens exceeds the largest "
+                    f"prefill bucket {self._max_bucket}")
+        fid = self._next_fid
+        self._next_fid += 1
+        rng = request.rng
+        if rng is None:
+            # Resolved ONCE per fleet request: every attempt replays the
+            # same key stream, so the stream is replica-independent.
+            rng = jax.random.fold_in(self._rng, fid)
+        rec = _FleetRequest(
+            fid=fid, prompt=list(request.prompt),
+            max_new_tokens=int(request.max_new_tokens),
+            temperature=float(request.temperature), eos_id=request.eos_id,
+            priority=int(request.priority), rng=rng,
+            on_token=request.on_token,
+            deadline_at=(now + request.deadline_s
+                         if request.deadline_s is not None else None),
+            submit_t=now,
+        )
+        if self.spans is not None:
+            rec.span_root = self.spans.start(
+                "fleet.request", kind="serve", request_id=fid,
+                prompt_len=len(rec.prompt),
+                max_new_tokens=rec.max_new_tokens)
+        self.requests[fid] = rec
+        try:
+            outcome = self._try_submit(rec)
+        except Exception:
+            # Never leave an orphaned record behind an engine-side
+            # raise: unwind so ``busy`` reflects only servable work.
+            del self.requests[fid]
+            if self.spans is not None and rec.span_root is not None:
+                self.spans.end(rec.span_root, status="error")
+            raise
+        if outcome == "full":
+            # Real backpressure: admitting replicas exist and ALL shed.
+            del self.requests[fid]
+            self.rejected += 1
+            if self.spans is not None and rec.span_root is not None:
+                self.spans.end(rec.span_root, status="rejected")
+            return None
+        if outcome == "none_admitting":
+            # Transient chaos hole: park; the tick loop resubmits.
+            rec.retry_due = self.tick
+        return fid
+
+    def _pick_replicas(self, rec: _FleetRequest,
+                       exclude: Set[int] = frozenset()) -> List[_Replica]:
+        """Trust-aware routing order: admitting replicas only (healthy
+        before degraded), least-loaded first.  ``exclude`` avoids
+        replicas that already failed this request (ignored when it
+        would leave no candidates — availability beats affinity; a
+        replica already running an attempt of this request is never a
+        candidate)."""
+        live_on = set(rec.live)
+        avoid = set(exclude) | rec.excluded | live_on
+        candidates = [r for r in self.replicas
+                      if r.state in ADMITTING and r.engine is not None]
+        picked = [r for r in candidates if r.index not in avoid]
+        if not picked:
+            picked = [r for r in candidates if r.index not in live_on]
+        return sorted(picked,
+                      key=lambda r: (r.state is not ReplicaState.HEALTHY,
+                                     r.engine.load, r.index))
+
+    def _try_submit(self, rec: _FleetRequest,
+                    exclude: Set[int] = frozenset()) -> str:
+        """Returns ``"submitted"``, ``"full"`` (admitting replicas
+        existed but EVERY one's queue shed the request — backpressure)
+        or ``"none_admitting"`` (no replica can take work right now)."""
+        reps = self._pick_replicas(rec, exclude)
+        if not reps:
+            return "none_admitting"
+        for rep in reps:
+            if self._submit_to(rec, rep):
+                return "submitted"
+        return "full"
+
+    def _submit_to(self, rec: _FleetRequest, rep: _Replica) -> bool:
+        now = time.perf_counter()
+        deadline_s = None
+        if rec.deadline_at is not None:
+            deadline_s = max(rec.deadline_at - now, 0.0)
+        span = None
+        if self.spans is not None:
+            span = self.spans.start(
+                "fleet.attempt", kind="serve", parent_id=rec.span_root,
+                request_id=rec.fid, replica=rep.index,
+                attempt=rec.submissions + 1)
+        local = rep.engine.submit(ServeRequest(
+            prompt=rec.prompt, max_new_tokens=rec.max_new_tokens,
+            temperature=rec.temperature, eos_id=rec.eos_id,
+            deadline_s=deadline_s, rng=rec.rng,
+            on_token=self._token_forwarder(rec, rep.index),
+            priority=rec.priority, first_submit_id=rec.fid,
+            span_parent=span,
+        ))
+        if local is None:
+            if span is not None:
+                self.spans.end(span, outcome="queue_full")
+            return False
+        rec.submissions += 1
+        rec.retry_due = None
+        rec.live[rep.index] = _Attempt(
+            replica=rep.index, gen=rep.gen, local_id=local,
+            submit_t=now, span=span,
+        )
+        self._local2fleet[(rep.index, local)] = rec.fid
+        return True
+
+    def _token_forwarder(self, rec: _FleetRequest, replica: int
+                         ) -> Optional[Callable[[int, int], None]]:
+        if rec.on_token is None:
+            return None
+
+        def forward(_local_rid: int, token: int) -> None:
+            # Primary-attempt streaming: the earliest-submitted live
+            # attempt owns the stream (hedges stream only if promoted
+            # by the primary's failure) — and nothing streams after the
+            # record closed.
+            att = rec.live.get(replica)
+            if rec.done or att is None or att.loser:
+                return
+            primary = min(rec.live.values(), key=lambda a: a.submit_t)
+            if primary.replica == replica:
+                rec.on_token(rec.fid, token)
+
+        return forward
+
+    # -- the fleet tick ----------------------------------------------------
+
+    def step(self) -> int:
+        """One fleet tick: chaos → step live replicas → process
+        retirements → supervise lifecycles → due retries + hedges.
+        Returns tokens emitted across the fleet this tick."""
+        self.tick += 1
+        self._apply_chaos()
+        emitted = 0
+        for rep in self.replicas:
+            if rep.engine is None or rep.state is ReplicaState.QUARANTINED:
+                continue
+            if self.tick < rep.stalled_until:
+                continue  # chaos wedge: no progress, heartbeat will see
+            emitted += rep.engine.step()
+            rep.last_progress_tick = self.tick
+        self._process_terminals()
+        self._supervise()
+        self._run_retries_and_hedges()
+        self._set_state_gauge()
+        # Done records with every attempt settled leave the working set
+        # (their FleetResult stays in ``results`` until drained) — the
+        # tick loop stays O(live), not O(history).
+        for fid in [f for f, r in self.requests.items()
+                    if r.done and not r.live]:
+            del self.requests[fid]
+        return emitted
+
+    def run_until_idle(self, max_ticks: int = 100_000
+                       ) -> Dict[int, FleetResult]:
+        """Drive ``step()`` until every submitted request is terminal
+        (or ``max_ticks`` trips — the liveness backstop)."""
+        ticks = 0
+        while any(not r.done for r in self.requests.values()):
+            self.step()
+            ticks += 1
+            if ticks >= max_ticks:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_ticks} ticks "
+                    f"(states: {[r.state.value for r in self.replicas]})"
+                )
+        return self.results
+
+    # -- chaos mechanics ---------------------------------------------------
+
+    def _apply_chaos(self) -> None:
+        if self.chaos is None or not hasattr(self.chaos, "on_fleet_tick"):
+            return
+        from trustworthy_dl_tpu.chaos.plan import FaultKind
+
+        for event in self.chaos.on_fleet_tick(self.tick):
+            target = event.target
+            if not 0 <= target < len(self.replicas):
+                logger.warning("chaos: fleet event %s targets unknown "
+                               "replica %d", event.kind.value, target)
+                continue
+            rep = self.replicas[target]
+            if event.kind is FaultKind.REPLICA_CRASH:
+                self._crash_replica(rep)
+            elif event.kind is FaultKind.REPLICA_STALL:
+                self.counters["stalls"] += 1
+                rep.stalled_until = self.tick + max(int(event.severity), 1)
+            elif event.kind is FaultKind.REPLICA_POISON:
+                # The injector keeps the persistent signal overwrite;
+                # the monitor flag-rate ladder does the rest.
+                self.counters["poisons"] += 1
+            elif event.kind is FaultKind.REPLICA_SLOWSTART:
+                # Warm-up only makes sense for a replica IN service: a
+                # quarantined/draining replica must keep its ladder
+                # state (a slow-start must never cancel a pending
+                # quarantine or skip a cool-off); an already-restarting
+                # one just warms longer.
+                self.counters["slowstarts"] += 1
+                warm = self.tick + max(int(event.severity), 1)
+                if rep.state in ADMITTING:
+                    rep.warm_until = warm
+                    self._transition(rep, ReplicaState.RESTARTING,
+                                     "slowstart")
+                elif rep.state is ReplicaState.RESTARTING:
+                    rep.warm_until = max(rep.warm_until, warm)
+                else:
+                    logger.warning(
+                        "chaos: slowstart on replica %d ignored in "
+                        "state %s (ladder state preserved)",
+                        rep.index, rep.state.value)
+
+    def _crash_replica(self, rep: _Replica) -> None:
+        """Kill the engine outright: every fleet request it held fails
+        over (ONE episode), the replica restarts after
+        ``restart_ticks``.  The dead generation's allocator journal
+        stays in ``self.journals`` — its blocks must keep reconciling.
+        A crash must never LAUNDER trust state: a quarantined replica
+        stays quarantined (the cool-off probe path rebuilds the engine
+        when it fires), and a trust-drain in progress completes as a
+        quarantine — dying mid-drain is not an exit from the ladder."""
+        self.counters["crashes"] += 1
+        if rep.state is ReplicaState.QUARANTINED:
+            rep.engine = None   # probe exit rebuilds; cool-off intact
+            return
+        self.counters["failover_episodes"] += 1
+        victims = [(key, fid) for key, fid in self._local2fleet.items()
+                   if key[0] == rep.index]
+        for (replica, local), fid in victims:
+            del self._local2fleet[(replica, local)]
+            rec = self.requests[fid]
+            att = rec.live.pop(replica, None)
+            if att is not None:
+                self._close_attempt_span(att, "crashed")
+                rec.closed.append({
+                    "replica": replica, "gen": att.gen,
+                    "journal": f"{replica}:{att.gen}", "outcome": "crashed",
+                    "layout": None, "slot": -1, "block_ids": [],
+                    "prefix_block_ids": [], "prefix_publishers": {},
+                })
+            self._schedule_failover(rec, from_replica=rep.index,
+                                    reason="crash")
+        rep.engine = None
+        if rep.quarantine_pending:
+            # The suspect replica died mid-drain: impound it — the
+            # quarantine the flag-rate earned still happens, cool-off
+            # ladder intact (no crash-as-quarantine-escape).
+            rep.quarantine_pending = False
+            rep.cooloff_ticks = max(rep.cooloff_ticks * 2,
+                                    self.config.quarantine_cooloff_ticks)
+            rep.cooloff_until = self.tick + rep.cooloff_ticks
+            self._transition(rep, ReplicaState.QUARANTINED, "crash")
+        else:
+            rep.warm_until = self.tick + self.config.restart_ticks
+            self._transition(rep, ReplicaState.RESTARTING, "crash")
+
+    # -- terminal processing -----------------------------------------------
+
+    def _process_terminals(self) -> None:
+        while self._terminal:
+            replica, result, placement = self._terminal.popleft()
+            self._on_terminal(replica, result, placement)
+
+    def _attempt_record(self, att: _Attempt, result: ServeResult,
+                        placement: Optional[dict], outcome: str
+                        ) -> Dict[str, Any]:
+        placement = placement or {"layout": None, "slot": -1,
+                                  "block_ids": [], "prefix_block_ids": [],
+                                  "prefix_publishers": {}}
+        return {"replica": att.replica, "gen": att.gen,
+                "journal": f"{att.replica}:{att.gen}",
+                "local_id": att.local_id, "outcome": outcome,
+                **placement}
+
+    def _on_terminal(self, replica: int, result: ServeResult,
+                     placement: Optional[dict]) -> None:
+        fid = self._local2fleet.pop((replica, result.request_id), None)
+        if fid is None:
+            return  # already accounted (crash bookkeeping ran first)
+        rec = self.requests.get(fid)
+        if rec is None:
+            return
+        att = rec.live.pop(replica, None)
+        if att is None:
+            return
+        status = result.status
+        if (status in ("completed", "deadline_exceeded")
+                and placement is not None):
+            # The monitor scored this retirement (it held a slot — a
+            # queue-side deadline expiry has placement None and never
+            # ran, so feeding it would dilute the flag rate and let a
+            # poisoned replica hide behind tight-deadline sheds).
+            self.observe_retirement(replica, result.flagged)
+        if att.loser or (rec.done and status != "hedge_lost"):
+            # A dedup loser we cancelled — or the race variant: both
+            # attempts completed inside one tick and this one lost.
+            status = "hedge_lost"
+        self._close_attempt_span(att, status)
+        rec.closed.append(self._attempt_record(att, result, placement,
+                                               status))
+        if status == "hedge_lost":
+            self.counters["hedge_lost"] += 1
+            self._ledger_loser(rec, att)
+            return
+        if status == "completed":
+            self._finalize(rec, result, att)
+            return
+        if status == "deadline_exceeded":
+            # Absolute deadline: every sibling attempt is as dead.
+            self._cancel_siblings(rec, status="hedge_lost")
+            self._finalize(rec, result, att)
+            return
+        if status in ("migrated", "failover"):
+            # We cancelled it ourselves to move it; the resubmission is
+            # already scheduled by the drain/crash path.
+            return
+        if status in ("no_capacity", "shed_slo"):
+            # Engine-side shed: retry elsewhere while budget remains.
+            self._schedule_failover(rec, from_replica=replica,
+                                    reason=status)
+            return
+        # Unknown terminal: finalize loudly rather than lose the request.
+        logger.warning("fleet: request %d terminal status %r taken as "
+                       "final", fid, status)
+        self._finalize(rec, result, att)
+
+    def _cancel_siblings(self, rec: _FleetRequest, status: str) -> None:
+        for replica, att in list(rec.live.items()):
+            rep = self.replicas[replica]
+            att.loser = True
+            if rep.engine is not None:
+                rep.engine.cancel(att.local_id, status=status)
+
+    def _schedule_failover(self, rec: _FleetRequest, from_replica: int,
+                           reason: str) -> None:
+        if rec.done or rec.live or rec.retry_due is not None:
+            return
+        now = time.perf_counter()
+        if rec.deadline_at is not None and now > rec.deadline_at:
+            self._finalize_unserved(rec, "deadline_exceeded")
+            return
+        if rec.submissions > self.config.max_retries:
+            self._finalize_unserved(rec, "failover_exhausted")
+            return
+        rec.excluded.add(from_replica)
+        rec.retry_due = self.tick + backoff_ticks(self.config,
+                                                  max(rec.submissions, 1))
+        self.counters["failovers"] += 1
+        self._failover_counter.inc()
+        if self.trace is not None:
+            self.trace.emit(EventType.FLEET_FAILOVER, request_id=rec.fid,
+                            from_replica=from_replica, to_replica=None,
+                            attempt=rec.submissions + 1, reason=reason,
+                            due_tick=rec.retry_due)
+
+    # -- finalization ------------------------------------------------------
+
+    def _finalize(self, rec: _FleetRequest, result: ServeResult,
+                  att: _Attempt) -> None:
+        if rec.done:
+            return
+        rec.done = True
+        rec.retry_due = None
+        self._cancel_siblings(rec, status="hedge_lost")
+        ttft = None
+        if result.ttft_s is not None:
+            ttft = (att.submit_t - rec.submit_t) + result.ttft_s
+        self.results[rec.fid] = FleetResult(
+            request_id=rec.fid, tokens=list(result.tokens),
+            status=result.status, replica=att.replica,
+            attempts=rec.submissions, ttft_s=ttft,
+            flagged=result.flagged, monitor_z=result.monitor_z,
+        )
+        self._ledger_canonical(rec, result, att, ttft)
+        if self.spans is not None and rec.span_root is not None:
+            self.spans.end(rec.span_root, status=result.status,
+                           replica=att.replica, attempts=rec.submissions,
+                           tokens=len(result.tokens))
+
+    def _finalize_unserved(self, rec: _FleetRequest, status: str) -> None:
+        """Terminal without a serving attempt left: deadline ran out
+        between attempts, retry budget exhausted, or fleet-wide
+        starvation.  NEVER silent: the request gets a result, a ledger
+        record and a closed span like every other."""
+        if rec.done:
+            return
+        rec.done = True
+        rec.retry_due = None
+        self._cancel_siblings(rec, status="hedge_lost")
+        self.results[rec.fid] = FleetResult(
+            request_id=rec.fid, tokens=[], status=status, replica=None,
+            attempts=rec.submissions, ttft_s=None,
+        )
+        if self.ledger is not None:
+            self.ledger.append({
+                "request_id": rec.fid, "status": status,
+                "admitted": bool(rec.closed),
+                "replica": None, "attempts": list(rec.closed),
+                "flagged": False, "monitor_z": 0.0, "tokens": 0,
+                "token_hash": attribution.token_hash([]),
+                "ttft_s": None, "submissions": rec.submissions,
+            })
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_RETIRE, request_id=rec.fid,
+                            status=status, tokens=0, fleet=True)
+        if self.spans is not None and rec.span_root is not None:
+            self.spans.end(rec.span_root, status=status,
+                           attempts=rec.submissions)
+
+    def _ledger_canonical(self, rec: _FleetRequest, result: ServeResult,
+                          att: _Attempt, ttft: Optional[float]) -> None:
+        if self.ledger is None:
+            return
+        winner = rec.closed[-1] if rec.closed else {}
+        engine = self.replicas[att.replica].engine
+        self.ledger.append({
+            "request_id": rec.fid, "status": result.status,
+            "admitted": True, "replica": att.replica,
+            "journal": f"{att.replica}:{att.gen}",
+            "layout": winner.get("layout"), "slot": winner.get("slot", -1),
+            "block_ids": list(winner.get("block_ids") or []),
+            "prefix_block_ids": list(winner.get("prefix_block_ids") or []),
+            "prefix_publishers": dict(winner.get("prefix_publishers") or {}),
+            "attempts": list(rec.closed),
+            "kv_dtype": getattr(engine, "kv_dtype", None),
+            "weight_dtype": getattr(engine, "weight_dtype", None),
+            "kv_fallback_reason": getattr(engine, "kv_fallback_reason",
+                                          None),
+            "flagged": bool(result.flagged),
+            "monitor_z": float(result.monitor_z),
+            "tokens": len(result.tokens),
+            "token_hash": attribution.token_hash(result.tokens),
+            "ttft_s": ttft, "submissions": rec.submissions,
+        })
+
+    def _ledger_loser(self, rec: _FleetRequest, att: _Attempt) -> None:
+        if self.ledger is None:
+            return
+        self.ledger.append({
+            "request_id": rec.fid, "status": "hedge_lost",
+            "admitted": False, "replica": att.replica,
+            "journal": f"{att.replica}:{att.gen}",
+            "tokens": 0, "token_hash": attribution.token_hash([]),
+        })
+
+    def _close_attempt_span(self, att: _Attempt, outcome: str) -> None:
+        if self.spans is not None and att.span is not None:
+            self.spans.end(att.span, outcome=outcome)
+
+    # -- supervision -------------------------------------------------------
+
+    def _transition(self, rep: _Replica, to: ReplicaState,
+                    reason: str) -> None:
+        if rep.state is to:
+            return
+        frm = rep.state
+        rep.state = to
+        rep.reason = reason
+        self._transition_counter.inc(to_state=to.value)
+        if to is ReplicaState.DRAINING:
+            self.counters["drains"] += 1
+            rep.drain_deadline = self.tick + self.config.drain_grace_ticks
+        elif to is ReplicaState.QUARANTINED:
+            self.counters["quarantines"] += 1
+        logger.warning("fleet: replica %d %s -> %s (%s)", rep.index,
+                       frm.value, to.value, reason)
+        if self.trace is not None:
+            self.trace.emit(EventType.REPLICA_TRANSITION,
+                            replica=rep.index, from_state=frm.value,
+                            to_state=to.value, reason=reason,
+                            tick=self.tick)
+
+    def _migrate(self, rep: _Replica, ids: List[int], status: str,
+                 reason: str) -> None:
+        """Cancel the given local requests on ``rep`` and schedule their
+        resubmission elsewhere (the cancel's retire_hook lands them in
+        the terminal queue; the 'migrated'/'failover' status routes them
+        back through ``_schedule_failover``)."""
+        for local in ids:
+            fid = self._local2fleet.get((rep.index, local))
+            rep.engine.cancel(local, status=status)
+            if fid is None:
+                continue
+            rec = self.requests.get(fid)
+            if rec is not None and not rec.done:
+                # The cancel fired the hook synchronously; the terminal
+                # record is queued.  Schedule the move NOW so the
+                # resubmission carries the drain reason.
+                self._drain_moves.append((fid, rep.index, reason))
+
+    def _supervise(self) -> None:
+        cfg = self.config
+        self._drain_moves: List[Tuple[int, int, str]] = []
+        for rep in self.replicas:
+            if rep.state is ReplicaState.RESTARTING:
+                if self.tick >= rep.warm_until:
+                    if rep.engine is None:
+                        rep.gen += 1
+                        self._build_replica(rep.index, prev=rep)
+                        self.counters["restarts"] += 1
+                    # Fresh heartbeat epoch: the warmup gap must not
+                    # read as missed ticks the instant service resumes.
+                    rep.last_progress_tick = self.tick
+                    self._transition(rep, ReplicaState.HEALTHY,
+                                     "warmup_complete")
+                continue
+            if rep.state is ReplicaState.QUARANTINED:
+                if self.tick >= rep.cooloff_until:
+                    # Cool-off over: readmission PROBE — the replica
+                    # re-enters through RESTARTING and must serve clean;
+                    # a still-poisoned replica re-flags and goes back
+                    # with a doubled cool-off.
+                    self.counters["readmissions"] += 1
+                    rep.flags.clear()
+                    rep.warm_until = self.tick + cfg.restart_ticks
+                    self._transition(rep, ReplicaState.RESTARTING,
+                                     "readmission_probe")
+                continue
+            if rep.engine is None:
+                continue
+            missed = self.tick - rep.last_progress_tick
+            trip = (rep.flag_count >= cfg.flag_min_count
+                    and rep.flag_rate >= cfg.flag_rate_quarantine)
+            watcher_bad = (
+                (rep.engine.slo is not None and rep.engine.slo.breached)
+                or (rep.engine.anomaly is not None
+                    and rep.engine.anomaly.any_active))
+            if rep.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+                if trip:
+                    self._transition(rep, ReplicaState.DRAINING,
+                                     "monitor_flag_rate")
+                    rep.quarantine_pending = True
+                    # Queue moves now; in-flight gets the grace window.
+                    self._migrate(rep, rep.engine.queued_ids,
+                                  status="migrated", reason="drain")
+                elif missed >= cfg.heartbeat_miss_limit:
+                    self._transition(rep, ReplicaState.DRAINING,
+                                     "heartbeat")
+                    rep.quarantine_pending = False
+                    self.counters["failover_episodes"] += 1
+                    # No progress = nothing to wait for: migrate queue
+                    # AND in-flight immediately.
+                    self._migrate(rep, rep.engine.queued_ids,
+                                  status="migrated", reason="drain")
+                    self._migrate(rep, rep.engine.inflight_ids,
+                                  status="failover", reason="heartbeat")
+                elif rep.state is ReplicaState.HEALTHY and (
+                        rep.flag_count >= 1
+                        or missed >= cfg.heartbeat_miss_degraded
+                        or watcher_bad):
+                    self._transition(rep, ReplicaState.DEGRADED,
+                                     "early_warning")
+                elif rep.state is ReplicaState.DEGRADED and (
+                        rep.flag_count == 0
+                        and missed < cfg.heartbeat_miss_degraded
+                        and not watcher_bad):
+                    self._transition(rep, ReplicaState.HEALTHY,
+                                     "recovered")
+            if rep.state is ReplicaState.DRAINING:
+                if rep.engine.load and self.tick >= rep.drain_deadline:
+                    self._migrate(rep, rep.engine.queued_ids,
+                                  status="migrated", reason="drain")
+                    self._migrate(rep, rep.engine.inflight_ids,
+                                  status="failover", reason="drain_grace")
+                if rep.engine.load == 0:
+                    if rep.quarantine_pending:
+                        rep.quarantine_pending = False
+                        rep.cooloff_ticks = max(
+                            rep.cooloff_ticks * 2,
+                            cfg.quarantine_cooloff_ticks)
+                        rep.cooloff_until = self.tick + rep.cooloff_ticks
+                        self._transition(rep, ReplicaState.QUARANTINED,
+                                         rep.reason)
+                    else:
+                        rep.warm_until = max(rep.stalled_until,
+                                             self.tick + cfg.restart_ticks)
+                        self._transition(rep, ReplicaState.RESTARTING,
+                                         "drain_complete")
+        # Cancel hooks queued terminal records; drain them, then arm the
+        # scheduled moves (the terminal handler skips migrated/failover
+        # statuses precisely so this path owns their resubmission).
+        self._process_terminals()
+        for fid, from_replica, reason in self._drain_moves:
+            rec = self.requests.get(fid)
+            if rec is not None and not rec.done:
+                self._schedule_failover(rec, from_replica, reason)
+        self._drain_moves = []
+
+    def observe_retirement(self, replica: int, flagged: bool) -> None:
+        """Feed one retirement's monitor verdict into the replica's
+        flag-rate window (called from the terminal processing path)."""
+        if 0 <= replica < len(self.replicas):
+            self.replicas[replica].flags.append(1 if flagged else 0)
+
+    # -- retries + hedges --------------------------------------------------
+
+    def _run_retries_and_hedges(self) -> None:
+        now = time.perf_counter()
+        for rec in list(self.requests.values()):
+            if rec.done:
+                continue
+            if (rec.deadline_at is not None and now > rec.deadline_at
+                    and not rec.live):
+                self._finalize_unserved(rec, "deadline_exceeded")
+                continue
+            if rec.retry_due is not None and self.tick >= rec.retry_due:
+                # ONE FLEET_FAILOVER event per failover — emitted by
+                # _schedule_failover with the replica the request
+                # actually left; the destination rides the new
+                # fleet.attempt span.  (A second emit here would double
+                # the event-vs-counter reconciliation.)
+                self._try_submit(rec, exclude=rec.excluded)
+                # On failure: stay parked; deadline/liveness guards
+                # bound it.
+                continue
+            if (self.config.hedge_deadline_s is not None
+                    and rec.deadline_at is not None and not rec.hedged
+                    and len(rec.live) == 1
+                    and len(self.replicas) > 1
+                    and rec.deadline_at - now
+                    < self.config.hedge_deadline_s):
+                primary = next(iter(rec.live.values()))
+                if self._try_submit(rec,
+                                    exclude={primary.replica}
+                                    | rec.excluded) == "submitted":
+                    rec.hedged = True
+                    self.counters["hedges"] += 1
+                    self._hedge_counter.inc()
+                    if self.trace is not None:
+                        att = max(rec.live.values(),
+                                  key=lambda a: a.submit_t)
+                        self.trace.emit(EventType.FLEET_HEDGE,
+                                        request_id=rec.fid,
+                                        replica=att.replica,
+                                        primary=primary.replica)
+        # Cancels issued while finalizing (hedge losers) queued terminal
+        # records — settle them inside the same tick so a pruned record
+        # is never looked up by a straggler.
+        self._process_terminals()
+
+    # -- reporting ---------------------------------------------------------
+
+    def _set_state_gauge(self) -> None:
+        by_state = {s: 0 for s in ReplicaState}
+        tif = 0
+        load = 0
+        for rep in self.replicas:
+            by_state[rep.state] += 1
+            if rep.engine is not None:
+                load += rep.engine.load
+                sched = getattr(rep.engine, "scheduler", None)
+                if sched is not None:
+                    tif += sched.tokens_in_flight
+        for state, n in by_state.items():
+            self._replicas_gauge.set(float(n), state=state.value)
+        self._tif_gauge.set(float(tif))
+        self._queue_gauge.set(float(load))
+
+    @property
+    def busy(self) -> bool:
+        return any(not r.done for r in self.requests.values())
+
+    def drain_results(self) -> Dict[int, FleetResult]:
+        """Return finished results and clear them — the bounded-memory
+        retrieval API for long-lived fleet loops (engine parity)."""
+        out = self.results
+        self.results = {}
+        return out
+
+    def states(self) -> Dict[int, str]:
+        return {r.index: r.state.value for r in self.replicas}
+
+    def verify_attribution(self) -> Tuple[bool, List[str]]:
+        """Reconcile the fleet ledger against every replica
+        GENERATION's allocator journal (retained across restarts)."""
+        if self.ledger is None:
+            raise ValueError("fleet has no attribution ledger attached")
+        return attribution.verify_attribution(self.ledger.records(),
+                                              self.journals)
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        """Fleet rollup: terminal statuses, recovery counters, replica
+        states, canonical-stream goodput."""
+        statuses: Dict[str, int] = {}
+        tokens = 0
+        for res in self.results.values():
+            statuses[res.status] = statuses.get(res.status, 0) + 1
+            if res.status == "completed":
+                tokens += len(res.tokens)
+        out = {
+            "requests": len(self.requests),
+            "statuses": statuses,
+            "completed_tokens": tokens,
+            "replica_states": self.states(),
+            "ticks": self.tick,
+            **{f"fleet_{k}": v for k, v in self.counters.items()},
+        }
+        slo_active = {
+            rep.index: rep.engine.slo.active
+            for rep in self.replicas
+            if rep.engine is not None
+            and getattr(rep.engine, "slo", None) is not None
+        }
+        if slo_active:
+            out["replica_slo_active"] = slo_active
+        return out

@@ -1,0 +1,161 @@
+"""Seeded serving-workload generator: what "heavy traffic from millions
+of users" actually looks like, reduced to its three awkward properties —
+
+* **bursty arrivals**: a Poisson process whose rate is modulated by a
+  sinusoid (the diurnal/burst envelope), so offered load swings between
+  ``mean * (1 - burstiness)`` and ``mean * (1 + burstiness)`` instead of
+  arriving politely uniform;
+* **heavy-tailed lengths**: prompt and output lengths drawn lognormal
+  (median + sigma), clipped to the engine's geometry — most requests are
+  short, a few drag whole blocks of KV for a long time (exactly the mix
+  that separates token-bounded from request-bounded admission);
+* **tenant skew**: tenants drawn by weight (Zipf-ish when you pass such
+  weights), each with its own priority class — what SLO-breach shedding
+  and the fleet router's priority handling are actually for.
+
+Everything is driven by one ``numpy`` generator seeded from the config,
+so a workload is reproducible from its config alone (the same contract
+as ``chaos.FaultPlan``): drills and the ``TDDL_BENCH_FLEET`` sweep
+replay identical traffic on every arm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Tenant:
+    """One traffic class: relative arrival weight + priority (higher
+    survives shedding longer) + optional per-request deadline."""
+
+    name: str
+    weight: float = 1.0
+    priority: int = 0
+    deadline_s: Optional[float] = None
+
+
+#: Default three-class mix: a dominant bulk tenant, a latency-sensitive
+#: interactive tenant, and a trickle of high-priority traffic.
+DEFAULT_TENANTS = (
+    Tenant("bulk", weight=6.0, priority=0),
+    Tenant("interactive", weight=3.0, priority=1, deadline_s=30.0),
+    Tenant("premium", weight=1.0, priority=2, deadline_s=30.0),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    seed: int = 0
+    num_requests: int = 64
+    mean_rps: float = 16.0          # long-run offered rate
+    burstiness: float = 0.6         # rate swing fraction, in [0, 1)
+    burst_period_s: float = 2.0     # one burst cycle
+    prompt_median: int = 12         # lognormal median prompt length
+    prompt_sigma: float = 0.6       # lognormal sigma (tail heaviness)
+    output_median: int = 8
+    output_sigma: float = 0.7
+    min_prompt: int = 2
+    min_output: int = 1
+    #: Hard cap on max_new_tokens (None = max_seq // 2) — the CLI pins
+    #: this to --max-new-tokens so the heavy tail cannot exceed the
+    #: operator's stated per-request budget.
+    max_output: Optional[int] = None
+    tenants: Sequence[Tenant] = DEFAULT_TENANTS
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.burstiness < 1.0:
+            raise ValueError("burstiness must be in [0, 1)")
+        if self.mean_rps <= 0 or self.burst_period_s <= 0:
+            raise ValueError("mean_rps and burst_period_s must be > 0")
+        if not self.tenants:
+            raise ValueError("need at least one tenant")
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadItem:
+    """One arrival: submit at ``t_arrive`` (seconds from workload
+    start) as tenant ``tenant`` with the given shape."""
+
+    t_arrive: float
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    priority: int
+    tenant: str
+    deadline_s: Optional[float]
+
+
+def _lognormal_len(rng: np.random.Generator, median: int, sigma: float,
+                   lo: int, hi: int) -> int:
+    val = int(round(float(rng.lognormal(math.log(max(median, 1)), sigma))))
+    return int(np.clip(val, lo, hi))
+
+
+def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
+                      ) -> List[WorkloadItem]:
+    """Materialise the full arrival schedule.  Lengths are clipped so
+    ``prompt + new <= max_seq`` always holds — a generated workload is
+    submittable against any engine with that geometry."""
+    rng = np.random.default_rng(cfg.seed)
+    weights = np.asarray([t.weight for t in cfg.tenants], np.float64)
+    weights = weights / weights.sum()
+    items: List[WorkloadItem] = []
+    t = 0.0
+    for _ in range(cfg.num_requests):
+        # Non-homogeneous Poisson via rate modulation: the gap at time t
+        # is exponential at the CURRENT envelope rate — bursts pack
+        # arrivals, troughs stretch them.
+        rate = cfg.mean_rps * (1.0 + cfg.burstiness * math.sin(
+            2.0 * math.pi * t / cfg.burst_period_s))
+        rate = max(rate, cfg.mean_rps * (1.0 - cfg.burstiness), 1e-6)
+        t += float(rng.exponential(1.0 / rate))
+        tenant = cfg.tenants[int(rng.choice(len(cfg.tenants), p=weights))]
+        out_hi = max(max_seq // 2, 1)
+        if cfg.max_output is not None:
+            out_hi = max(min(out_hi, cfg.max_output), 1)
+        new = _lognormal_len(rng, cfg.output_median, cfg.output_sigma,
+                             cfg.min_output, out_hi)
+        plen = _lognormal_len(rng, cfg.prompt_median, cfg.prompt_sigma,
+                              cfg.min_prompt, max(max_seq - new - 1, 1))
+        items.append(WorkloadItem(
+            t_arrive=t,
+            prompt=tuple(int(x) for x in
+                         rng.integers(0, vocab_size, plen)),
+            max_new_tokens=new,
+            priority=tenant.priority,
+            tenant=tenant.name,
+            deadline_s=tenant.deadline_s,
+        ))
+    return items
+
+
+def replay_workload(target: Any, items: Sequence[WorkloadItem],
+                    make_request: Callable[[WorkloadItem], Any],
+                    idle_sleep_s: float = 0.05) -> int:
+    """Open-loop replay against anything with the serving surface
+    (``submit``/``step``/``busy`` — a ServingFleet or a ServingEngine):
+    each item is submitted when the wall clock passes its arrival time,
+    the target is stepped while busy, and idle gaps before the next
+    arrival sleep instead of spinning empty ticks.  ONE spelling of the
+    driver loop for the bench sweep and the CLI.  Returns how many
+    submissions were accepted (backpressure sheds return None)."""
+    t0 = time.perf_counter()
+    pending = list(items)
+    accepted = 0
+    while pending or target.busy:
+        now = time.perf_counter() - t0
+        while pending and pending[0].t_arrive <= now:
+            item = pending.pop(0)
+            if target.submit(make_request(item)) is not None:
+                accepted += 1
+        if not target.busy and pending:
+            time.sleep(min(max(pending[0].t_arrive - now, 0.0),
+                           idle_sleep_s))
+            continue
+        target.step()
+    return accepted
