@@ -115,6 +115,122 @@ def _write_probe_cache(count: int, platform: str) -> None:
         log(f"probe cache not persisted to {path}: {exc}")
 
 
+def _perf_ledger_path() -> str:
+    """Home of the rolling bench perf-fingerprint ledger
+    (TDDL_BENCH_PERF_LEDGER overrides; default: PERF_LEDGER.jsonl in the
+    cwd, which the driver runs from the repo root — one trajectory file
+    across rounds)."""
+    return os.environ.get("TDDL_BENCH_PERF_LEDGER", "PERF_LEDGER.jsonl")
+
+
+def _prior_ledger_pointer() -> "dict | None":
+    """Compact pointer at the prior round's perf-ledger entry, stamped
+    into SKIP records so BENCH_r04/r05-style infra skips stay
+    attributable in the perf trajectory: a reader sees what the LAST
+    healthy round measured instead of a bare {"skipped": true}."""
+    try:
+        from trustworthy_dl_tpu.obs.sentinel import PerfLedger
+
+        path = _perf_ledger_path()
+        entries = PerfLedger(path).read()
+        if not entries:
+            return None
+        last = entries[-1]
+        return {
+            "path": path,
+            "entries": len(entries),
+            "last": {k: last.get(k) for k in
+                     ("key", "t", "tokens_per_s", "compile_total",
+                      "hbm_watermark_bytes", "regressed")
+                     if k in last},
+        }
+    except Exception:  # the pointer must never break the skip contract
+        return None
+
+
+def _skip_record(reason: str, **extra) -> dict:
+    """The one-line skip JSON (driver contract: rc 0, parsable,
+    attributable).  Carries a HOST-ONLY run-metadata stamp — the
+    backend is the very thing that is broken, so device discovery must
+    not run — plus the prior-round ledger pointer."""
+    record = {
+        "metric": "skipped", "value": 0, "unit": "none",
+        "vs_baseline": None, "skipped": True, "reason": reason,
+        "prior_ledger": _prior_ledger_pointer(),
+    }
+    try:
+        from trustworthy_dl_tpu.obs.meta import run_metadata
+
+        record["run_metadata"] = run_metadata(host_only=True)
+    except Exception:
+        record["run_metadata"] = None
+    record.update(extra)
+    return record
+
+
+def _sentinel_rc(record: dict) -> int:
+    """Exit code for the sentinel CI arm: TDDL_BENCH_SENTINEL=1 turns a
+    confirmed regression (outside the ledger noise band) into rc 3 —
+    off by default so the driver's rc-0 contract is unchanged."""
+    if os.environ.get("TDDL_BENCH_SENTINEL") != "1":
+        return 0
+    sentinel = record.get("sentinel") or {}
+    return 3 if sentinel.get("regressed") else 0
+
+
+def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
+    """The performance-observability sections every NON-SKIP bench
+    record carries: "compile" (XLA compilations observed during the
+    body), "hbm" (live-buffer sweep + watermark), "sentinel" (the perf
+    fingerprint appended to the rolling ledger + the noise-band
+    verdict against prior rounds)."""
+    from trustworthy_dl_tpu.obs.compilewatch import CompileRegistry
+    from trustworthy_dl_tpu.obs.hbm import HbmMonitor
+    from trustworthy_dl_tpu.obs.sentinel import (
+        PerfLedger,
+        PerfSentinel,
+        fingerprint,
+    )
+
+    if compiles is None:
+        compiles = CompileRegistry()   # uninstalled: an all-zero section
+    record["compile"] = compiles.summary()
+    if hbm is None:
+        hbm = HbmMonitor()
+    sweep = hbm.sweep()
+    record["hbm"] = {
+        "live_bytes_per_device": sweep["per_device"],
+        "total_bytes": sweep["total_bytes"],
+        "watermark_bytes": sweep["watermark_bytes"],
+    }
+    ledger = PerfLedger(_perf_ledger_path())
+    fp = fingerprint(
+        "bench",
+        metric=record.get("metric"),
+        tokens_per_s=record.get("value") or None,
+        compile_total=(record.get("compile") or {}).get("total"),
+        compile_seconds=(record.get("compile") or {}).get("seconds"),
+        hbm_watermark_bytes=sweep["watermark_bytes"] or None,
+        run_metadata=record.get("run_metadata"),
+        extra={"vs_baseline": record.get("vs_baseline")},
+    )
+    verdict = PerfSentinel(ledger).check(fp)
+    fp["regressed"] = verdict["regressed"]
+    ledger.append(fp)
+    record["sentinel"] = {
+        "ledger": ledger.path,
+        "baseline_n": verdict["baseline_n"],
+        "regressed": verdict["regressed"],
+        "checks": verdict["checks"],
+        "fingerprint": fp,
+    }
+    if verdict["regressed"]:
+        log(f"perf sentinel: REGRESSION outside the noise band: "
+            f"{[c['metric'] for c in verdict['checks'] if c.get('regressed')]}"
+            f" (TDDL_BENCH_SENTINEL=1 makes this exit non-zero)")
+    return record
+
+
 def _invalidate_probe_cache(reason: str) -> None:
     """Drop the healthy-probe record: the backend just proved unhealthy
     AFTER a cached probe (watchdog fire, body failure), so the next
@@ -1165,17 +1281,15 @@ def main() -> None:
                     float(os.environ.get("TDDL_BENCH_RETRY_SLEEP", "10"))
                     * (attempt + 1))
     if n_chips is None:
-        print(json.dumps({
-            "metric": "skipped", "value": 0, "unit": "none",
-            "vs_baseline": None, "skipped": True,
-            "reason": f"backend unavailable after 3 attempts: "
-                      f"{type(last_err).__name__}: {last_err}",
+        print(json.dumps(_skip_record(
+            f"backend unavailable after 3 attempts: "
+            f"{type(last_err).__name__}: {last_err}",
             # Triage hint: True means an earlier round DID reach this
             # backend (the disk cache holds a healthy probe — so either
             # TDDL_BENCH_PROBE_REFRESH=1 was set or the backend broke
             # since); False means no round has ever probed healthy here.
-            "prior_healthy_probe": _read_probe_cache() is not None,
-        }))
+            prior_healthy_probe=_read_probe_cache() is not None,
+        )))
         sys.exit(0)
 
     # The measured body runs in a SUBPROCESS under a hard wall-clock
@@ -1204,12 +1318,10 @@ def main() -> None:
         proc.kill()
         proc.wait()
         _invalidate_probe_cache("watchdog expired")
-        print(json.dumps({
-            "metric": "skipped", "value": 0, "unit": "none",
-            "vs_baseline": None, "skipped": True,
-            "reason": f"bench body exceeded the {watchdog:.0f}s watchdog "
-                      "(backend wedged after the liveness probe)",
-        }))
+        print(json.dumps(_skip_record(
+            f"bench body exceeded the {watchdog:.0f}s watchdog "
+            "(backend wedged after the liveness probe)",
+        )))
         sys.exit(0)
     record = None
     for line in reversed((out or "").splitlines()):
@@ -1225,14 +1337,16 @@ def main() -> None:
         # either way a re-probe next round costs seconds, while trusting
         # a stale cache against a dead backend costs the full watchdog.
         _invalidate_probe_cache(f"body failed rc={proc.returncode}")
-        print(json.dumps({
-            "metric": "skipped", "value": 0, "unit": "none",
-            "vs_baseline": None, "skipped": True,
-            "reason": f"bench body failed (rc={proc.returncode}, "
-                      f"parsable JSON line: {record is not None})",
-        }))
+        print(json.dumps(_skip_record(
+            f"bench body failed (rc={proc.returncode}, "
+            f"parsable JSON line: {record is not None})",
+        )))
         sys.exit(0)
     print(json.dumps(record))
+    # Sentinel CI arm (off by default): a confirmed regression outside
+    # the ledger noise band exits non-zero AFTER the record is out —
+    # the one-JSON-line contract holds either way.
+    sys.exit(_sentinel_rc(record))
 
 
 def _inner_main() -> None:
@@ -1267,6 +1381,16 @@ def _inner_main() -> None:
             os.path.join(os.environ.get("TDDL_BENCH_OBS_DIR")
                          or tempfile.gettempdir(), "tddl_bench_jax_cache")
         log(f"persistent compilation cache: {enable_persistent_cache(cache_dir)}")
+
+    # Performance observability for the whole measured body: every XLA
+    # compilation metered from here on (obs/compilewatch.py), live-HBM
+    # swept at the end — both land in the record's "compile"/"hbm"
+    # sections with the sentinel fingerprint/verdict.
+    from trustworthy_dl_tpu.obs.compilewatch import CompileRegistry
+    from trustworthy_dl_tpu.obs.hbm import HbmMonitor
+
+    compiles = CompileRegistry().install()
+    hbm_monitor = HbmMonitor()
 
     is_lm = model.startswith("gpt")
     log(f"bench: {model} nodes={num_nodes} batch/node={per_node_batch} "
@@ -1319,6 +1443,10 @@ def _inner_main() -> None:
         ratio = sps_on / sps_off
 
     tps_on = sps_on * tokens_per_step / n_chips
+    # Watermark sweep while the measured trainers' state is still live —
+    # the optional legs below free/rebuild models, and the final sweep in
+    # _attach_perf_sections would miss the training-footprint peak.
+    hbm_monitor.sweep()
     overhead_pct = (1.0 - ratio) * 100.0
     log(f"detection overhead: {overhead_pct:.1f}% (target <=15%)")
     # Run-metadata stamp + MFU via the shared obs helpers — the bench
@@ -1393,6 +1521,7 @@ def _inner_main() -> None:
         "mfu": mfu,
         "run_metadata": meta,
     }
+    _attach_perf_sections(record, compiles=compiles, hbm=hbm_monitor)
     if serve_records is not None:
         record["serve"] = serve_records
     if paged_record is not None:

@@ -39,6 +39,13 @@ def _single_json_line(proc):
 
 
 def test_bench_emits_skip_json_when_backend_unavailable(tmp_path):
+    # A doctored prior-round ledger proves the skip record POINTS at the
+    # perf trajectory instead of being a bare {"skipped": true} blob.
+    ledger = tmp_path / "PERF_LEDGER.jsonl"
+    ledger.write_text(json.dumps({
+        "schema": "tddl-perf-v1", "t": 1.0, "source": "bench",
+        "key": "bench:m:tpu:v5e", "tokens_per_s": 90500.0,
+    }) + "\n")
     proc = _run_bench({
         "JAX_PLATFORMS": "bogus",        # unknown backend → init raises
         "PALLAS_AXON_POOL_IPS": "",      # keep the axon hook out of the way
@@ -47,12 +54,25 @@ def test_bench_emits_skip_json_when_backend_unavailable(tmp_path):
         # by ANOTHER test (or a real bench round) must not short-circuit
         # this test's dead-backend path.
         "TDDL_BENCH_PROBE_CACHE": str(tmp_path / "probe.json"),
+        "TDDL_BENCH_PERF_LEDGER": str(ledger),
     })
     rec = _single_json_line(proc)
     assert rec["skipped"] is True
     assert "backend unavailable" in rec["reason"]
     # Triage field: no round has ever probed healthy against this cache.
     assert rec["prior_healthy_probe"] is False
+    # Skip records are attributable: HOST-ONLY run metadata (device
+    # discovery must not run — the backend is the broken thing) + the
+    # prior-round perf-ledger pointer.
+    meta = rec["run_metadata"]
+    assert meta["platform"] == "unprobed"
+    for key in ("schema", "python_version", "framework_version",
+                "hostname", "timestamp", "jax_version"):
+        assert key in meta, key
+    prior = rec["prior_ledger"]
+    assert prior["entries"] == 1
+    assert prior["last"]["tokens_per_s"] == 90500.0
+    assert prior["path"] == str(ledger)
 
 
 def test_bench_serve_leg_keeps_skip_contract(tmp_path):
@@ -224,6 +244,55 @@ def test_bench_quant_ab_records(monkeypatch):
         for key in ("slots", "kv_bytes", "kv_dtype", "weight_dtype",
                     "tokens_per_s", "wall_s"):
             assert key in row, row
+
+
+def test_bench_perf_sections_and_sentinel_fingerprint(monkeypatch,
+                                                      tmp_path):
+    """CONTRACT: every non-skip bench record carries the perf
+    observability sections — "compile" (XLA compilations), "hbm"
+    (live-buffer sweep + watermark) and "sentinel" (the ledger
+    fingerprint + noise-band verdict) — and the fingerprint really
+    lands in the rolling ledger.  ``_attach_perf_sections`` is the one
+    function ``_inner_main`` routes every measured record through."""
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.obs.sentinel import PerfLedger
+
+    ledger_path = tmp_path / "PERF_LEDGER.jsonl"
+    monkeypatch.setenv("TDDL_BENCH_PERF_LEDGER", str(ledger_path))
+
+    def record(value):
+        return {"metric": "gpt2_tokens_per_sec_per_chip_detection_on",
+                "value": value, "unit": "tokens/sec/chip",
+                "vs_baseline": 1.0,
+                "run_metadata": {"platform": "cpu",
+                                 "device_kind": "cpu"}}
+
+    rec = bench._attach_perf_sections(record(1000.0))
+    for section in ("compile", "hbm", "sentinel"):
+        assert section in rec, section
+    assert rec["hbm"]["watermark_bytes"] >= 0
+    sentinel = rec["sentinel"]
+    assert sentinel["ledger"] == str(ledger_path)
+    assert sentinel["fingerprint"]["tokens_per_s"] == 1000.0
+    assert sentinel["regressed"] is False        # no baseline yet
+    assert len(PerfLedger(str(ledger_path)).read()) == 1
+    # `_inner_main` routes the measured record through the helper.
+    src = (REPO / "bench.py").read_text()
+    assert "_attach_perf_sections(record" in src
+
+    # Build a baseline, then a collapsed round -> confirmed regression.
+    for value in (1010.0, 990.0, 1005.0):
+        bench._attach_perf_sections(record(value))
+    bad = bench._attach_perf_sections(record(100.0))
+    assert bad["sentinel"]["regressed"] is True
+    # The CI arm: rc 3 only when BOTH the env is on and the record
+    # confirmed a regression (both arms covered).
+    monkeypatch.delenv("TDDL_BENCH_SENTINEL", raising=False)
+    assert bench._sentinel_rc(bad) == 0          # off by default
+    monkeypatch.setenv("TDDL_BENCH_SENTINEL", "1")
+    assert bench._sentinel_rc(bad) == 3
+    assert bench._sentinel_rc(rec) == 0          # clean record stays rc 0
 
 
 def test_bench_fleet_records(monkeypatch, tmp_path):

@@ -852,22 +852,63 @@ def test_fleet_events_and_gauges_are_inside_the_lint_perimeter():
         assert f'"{name}"' in src, name
 
 
+def test_perf_tier_events_and_metrics_inside_the_lint_perimeter():
+    """PR 10 extension: the performance-tier event types carry full
+    schemas (so the emit lint + validate_event cover them like every
+    other type) and the compile/HBM/sentinel metric surface keeps the
+    ``tddl_`` naming contract via literal names the metric-name lint
+    scans."""
+    assert EVENT_SCHEMAS[EventType.COMPILE]["fields"] == \
+        ("key", "seconds")
+    assert EVENT_SCHEMAS[EventType.COMPILE_STORM]["fields"] == \
+        ("scope", "compiles")
+    assert EVENT_SCHEMAS[EventType.HBM_SWEEP]["fields"] == \
+        ("live_bytes", "watermark_bytes")
+    assert EVENT_SCHEMAS[EventType.HBM_PRESSURE]["fields"] == \
+        ("requested_bytes", "headroom_bytes")
+    assert EVENT_SCHEMAS[EventType.PERF_REGRESSION]["fields"] == \
+        ("metric", "value", "baseline")
+    assert EVENT_SCHEMAS[EventType.TRACE_ROTATE]["fields"] == \
+        ("path", "segment")
+    obs = REPO / "trustworthy_dl_tpu" / "obs"
+    cw = (obs / "compilewatch.py").read_text()
+    for name in ("tddl_compile_total", "tddl_compile_seconds",
+                 "tddl_compile_storms_total"):
+        assert f'"{name}"' in cw, name
+    hbm = (obs / "hbm.py").read_text()
+    for name in ("tddl_hbm_live_bytes", "tddl_hbm_watermark_bytes",
+                 "tddl_hbm_pressure_total"):
+        assert f'"{name}"' in hbm, name
+    assert '"tddl_perf_regressions_total"' in \
+        (obs / "sentinel.py").read_text()
+
+
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
     (counter/gauge/histogram) starts with ``tddl_`` — the naming
     convention the Prometheus surface promises."""
     import re
 
-    pattern = re.compile(
-        r"\.(?:counter|gauge|histogram)\(\s*\n?\s*([fF]?[\"'])([^\"']+)"
+    patterns = (
+        re.compile(
+            r"\.(?:counter|gauge|histogram)\(\s*\n?\s*([fF]?[\"'])([^\"']+)"
+        ),
+        # serve/engine.py's degrade-on-conflict wrapper: the name is the
+        # wrapper's second argument — still a literal, still linted.
+        re.compile(
+            r"_metric\(\s*\n?\s*\w+\.(?:counter|gauge|histogram),"
+            r"\s*\n?\s*([fF]?[\"'])([^\"']+)"
+        ),
     )
     offenders = []
     for module in _package_sources():
         if module.name == "registry.py":
             continue  # defines the methods; registers nothing itself
-        for m in pattern.finditer(module.read_text()):
-            if not m.group(2).startswith("tddl_"):
-                offenders.append(f"{module.name}: {m.group(2)!r}")
+        source = module.read_text()
+        for pattern in patterns:
+            for m in pattern.finditer(source):
+                if not m.group(2).startswith("tddl_"):
+                    offenders.append(f"{module.name}: {m.group(2)!r}")
     assert not offenders, offenders
 
 

@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--metrics-snapshot-every", type=int, default=0,
                         help="re-write the metrics snapshot every N steps "
                              "(0 = only at run end); needs --obs-dir")
+    parser.add_argument("--trace-max-bytes", type=int, default=0,
+                        help="rotate trace.jsonl once it exceeds this "
+                             "many bytes (trace.1.jsonl, trace.2.jsonl, "
+                             "...; 0 = no rotation; env "
+                             "TDDL_TRACE_MAX_BYTES is the default)")
     return parser
 
 
@@ -139,6 +144,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         obs_session = ObsSession(
             args.obs_dir,
             metrics_snapshot_every=args.metrics_snapshot_every,
+            trace_max_bytes=args.trace_max_bytes,
         )
         # Active plane: per-step spans (train.step → per-phase children)
         # and the EWMA anomaly watcher on step-time/loss/grad-norm; no
@@ -146,6 +152,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # percentile sketch still lands in slo_status.json.
         obs_session.enable_spans()
         obs_session.install_watchers(slo_rules=())
+        # Performance tier: every XLA compile metered + the train-step
+        # compile-once contract enforced at runtime, live-HBM watermark
+        # gauges, and the perf fingerprint appended at finalize.
+        obs_session.enable_compile_watch()
+        obs_session.enable_hbm()
         trainer.attach_obs(obs_session)
     if args.resume:
         trainer.load_checkpoint()
@@ -197,11 +208,29 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"final state {stats['training_state']}")
     trainer.save_checkpoint()
     if obs_session is not None:
+        obs_session.hbm.sweep(emit=True)
         obs_session.finalize()
         print(f"obs artifacts in {args.obs_dir}: trace.jsonl, "
               "metrics_snapshot.json, metrics.prom, obs_report.json")
+        _print_perf_verdict(obs_session)
     trainer.cleanup()
     return 0
+
+
+def _print_perf_verdict(obs_session) -> None:
+    """One-line sentinel summary at the end of an instrumented run."""
+    verdict = obs_session.perf_verdict
+    if verdict is None:
+        return
+    if verdict["regressed"]:
+        bad = [f"{c['metric']} {c.get('delta_pct', 0):+.1f}%"
+               for c in verdict["checks"] if c.get("regressed")]
+        print(f"perf sentinel: REGRESSION vs {verdict['baseline_n']} "
+              f"baseline run(s): {', '.join(bad)}")
+    else:
+        print(f"perf sentinel: within the noise band "
+              f"({verdict['baseline_n']} baseline run(s), ledger "
+              f"{obs_session.perf_ledger_path})")
 
 
 def build_generate_parser() -> argparse.ArgumentParser:
@@ -432,6 +461,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "remaining deadline drops below this "
                              "(first completed attempt wins; the loser "
                              "is cancelled and recorded hedge_lost)")
+    parser.add_argument("--trace-max-bytes", type=int, default=0,
+                        help="rotate trace.jsonl once it exceeds this "
+                             "many bytes (trace.1.jsonl, ...; 0 = no "
+                             "rotation; env TDDL_TRACE_MAX_BYTES is the "
+                             "default)")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -514,9 +548,15 @@ def serve_main(argv: Optional[List[str]] = None,
     if args.obs_dir:
         from trustworthy_dl_tpu.obs import ObsSession
 
-        obs_session = ObsSession(args.obs_dir)
+        obs_session = ObsSession(args.obs_dir,
+                                 trace_max_bytes=args.trace_max_bytes)
         obs_session.enable_spans()
         obs_session.open_ledger()
+        # Performance tier: compile watcher (the decode loop's
+        # compile-once pin enforced live), HBM watermark gauges + the
+        # pool headroom gate, cost ledger + perf fingerprint at exit.
+        obs_session.enable_compile_watch()
+        obs_session.enable_hbm()
     if args.fleet_replicas > 1:
         # Fleet mode builds PER-REPLICA watchers from the SLO flags (a
         # breach is a replica-local signal) — the session-level watcher
@@ -530,7 +570,9 @@ def serve_main(argv: Optional[List[str]] = None,
             itl_target_s=args.slo_itl_ms / 1e3,
         ))
         extra = dict(spans=obs_session.spans, ledger=obs_session.ledger,
-                     slo=obs_session.slo, anomaly=obs_session.anomaly)
+                     slo=obs_session.slo, anomaly=obs_session.anomaly,
+                     compilewatch=obs_session.compilewatch,
+                     hbm=obs_session.hbm)
     engine = ServingEngine.from_config(
         trainer.state.params, cfg, serve_config,
         enable_monitor=not args.no_monitor,
@@ -586,8 +628,18 @@ def serve_main(argv: Optional[List[str]] = None,
             print(f"  !! {p}")
         if obs_session.slo.active:
             print(f"SLO breaches active: {obs_session.slo.active}")
+        # Performance tier artifacts: per-program cost ledger into
+        # obs_report.json, a final HBM sweep, and the compile-watch
+        # verdict (zero storms = the compile-once pin held live).
+        engine.analyze_programs(obs_session.cost_ledger)
+        obs_session.hbm.sweep(emit=True)
+        compiles = obs_session.compiles.summary()
+        print(f"compiles: {compiles['total']} "
+              f"({compiles['seconds']:.2f}s), decode storms: "
+              f"{obs_session.compilewatch.storm_total}")
         obs_session.finalize()
         print(f"obs artifacts in {args.obs_dir}")
+        _print_perf_verdict(obs_session)
     trainer.cleanup()
     return 0
 
@@ -636,6 +688,11 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
         ledger=obs_session.ledger if obs_session else None,
         slo_rules=slo_rules,
         enable_monitor=not args.no_monitor,
+        # Performance tier rides every replica build (and rebuild): the
+        # decode loops share one compile watcher scope, and each
+        # replica's pool allocation consults the HBM headroom gate.
+        compilewatch=obs_session.compilewatch if obs_session else None,
+        hbm=obs_session.hbm if obs_session else None,
     )
     workload = generate_workload(
         WorkloadConfig(seed=args.seed, num_requests=args.num_requests,
@@ -667,8 +724,15 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
               f"{'OK' if ok else 'FAILED'}")
         for p in problems[:5]:
             print(f"  !! {p}")
+        if fleet.replicas:
+            fleet.replicas[0].engine.analyze_programs(
+                obs_session.cost_ledger)
+        obs_session.hbm.sweep(emit=True)
+        print(f"compiles: {obs_session.compiles.summary()['total']}, "
+              f"decode storms: {obs_session.compilewatch.storm_total}")
         obs_session.finalize()
         print(f"obs artifacts in {args.obs_dir}")
+        _print_perf_verdict(obs_session)
     trainer.cleanup()
     return 0
 
@@ -719,10 +783,14 @@ def build_obs_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="trustworthy-dl-obs",
         description="Render an obs directory: tail/filter trace.jsonl by "
-                    "request/step id, convert spans to a Chrome/Perfetto "
+                    "request/step id (rotated trace.N.jsonl segments are "
+                    "walked in order), convert spans to a Chrome/Perfetto "
                     "timeline, pretty-print obs_report.json and the "
                     "SLO/anomaly status.  With no action flags, prints a "
-                    "summary of everything the directory holds.",
+                    "summary of everything the directory holds.  The "
+                    "'diff' subcommand (trustworthy-dl-obs diff A B) "
+                    "renders two obs_report/perf-ledger artifacts side "
+                    "by side with deltas.",
     )
     parser.add_argument("obs_dir", type=str,
                         help="directory a run wrote with --obs-dir")
@@ -754,16 +822,23 @@ def obs_main(argv: Optional[List[str]] = None) -> int:
     the obs directory (host-only; imports no jax)."""
     import json
     import os
+    import sys as _sys
 
-    from trustworthy_dl_tpu.obs.events import read_jsonl
+    from trustworthy_dl_tpu.obs.events import read_jsonl_rotated
     from trustworthy_dl_tpu.obs.spans import chrome_trace_from_events
 
+    if argv is None:
+        argv = _sys.argv[1:]
+    if argv and argv[0] == "diff":
+        return _obs_diff(argv[1:])
     args = build_obs_parser().parse_args(argv)
     if not os.path.isdir(args.obs_dir):
         print(f"no such obs directory: {args.obs_dir}")
         return 2
     trace_path = os.path.join(args.obs_dir, "trace.jsonl")
-    events = read_jsonl(trace_path) if os.path.exists(trace_path) else []
+    # Rotated segments (trace.1.jsonl, ...) are walked oldest-first so a
+    # size-capped long run reads exactly like an uncapped one.
+    events = read_jsonl_rotated(trace_path)
 
     filtered = events
     if args.request_id is not None:
@@ -798,6 +873,40 @@ def obs_main(argv: Optional[List[str]] = None) -> int:
         _print_slo_status(args.obs_dir)
     if not acted:
         _print_obs_summary(args.obs_dir, events)
+    return 0
+
+
+def _obs_diff(argv: List[str]) -> int:
+    """``trustworthy-dl-obs diff A B`` — two obs artifact sets side by
+    side (obs dirs, obs_report.json files, or PERF_LEDGER.jsonl files;
+    host-only, imports no jax)."""
+    import argparse as _argparse
+
+    from trustworthy_dl_tpu.obs.sentinel import (
+        load_perf_artifact,
+        render_diff,
+    )
+
+    parser = _argparse.ArgumentParser(
+        prog="trustworthy-dl-obs diff",
+        description="Pretty-print two obs_report/perf-ledger artifacts "
+                    "side by side: step time, phase fractions, MFU "
+                    "(nominal + analyzed), per-program FLOPs/temp "
+                    "bytes, compile counts, HBM watermark — with "
+                    "relative deltas.",
+    )
+    parser.add_argument("a", type=str, help="first artifact (obs dir, "
+                                            "obs_report.json, or "
+                                            "PERF_LEDGER.jsonl)")
+    parser.add_argument("b", type=str, help="second artifact")
+    args = parser.parse_args(argv)
+    try:
+        view_a = load_perf_artifact(args.a)
+        view_b = load_perf_artifact(args.b)
+    except FileNotFoundError as exc:
+        print(f"diff: {exc}")
+        return 2
+    print(render_diff(view_a, view_b))
     return 0
 
 

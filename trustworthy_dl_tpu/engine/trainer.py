@@ -49,6 +49,7 @@ from trustworthy_dl_tpu.engine.step import StepMetrics, \
     build_node_eval_step, \
     build_train_step
 from trustworthy_dl_tpu.models.factory import ModelFactory
+from trustworthy_dl_tpu.obs.compilewatch import guarded
 from trustworthy_dl_tpu.obs.events import EventType
 from trustworthy_dl_tpu.trust.manager import TrustManager
 from trustworthy_dl_tpu.trust.state import NodeStatus
@@ -518,6 +519,15 @@ class DistributedTrainer:
             model_kind=self.model.kind,
             num_chips=len(list(self.mesh.devices.flat)),
         )
+        ledger = getattr(self.obs, "cost_ledger", None)
+        if ledger is not None and "train_step" not in ledger.programs:
+            # XLA's own cost view of THE train step (obs/hbm.py):
+            # analyzed FLOPs/bytes from one lowering pass (no backend
+            # compile unless TDDL_OBS_MEMORY_ANALYSIS=1 adds the
+            # temp-allocation block) — obs_report.json's cost ledger
+            # and the analyzed-FLOPs MFU come from this entry.
+            ledger.analyze("train_step", self._train_step, self.state,
+                           node_batch, self.attack_plan)
 
     # ------------------------------------------------------------------
     # Batch plumbing
@@ -706,7 +716,16 @@ class DistributedTrainer:
                 if timer is not None:
                     self._obs_note_model_info(node_batch)
                     timer.lap("data")  # loader + host assembly + placement
-                with step_annotation(self.global_step):
+                # Compile-once runtime contract (obs/compilewatch.py):
+                # the dispatch runs under the watcher's "train_step"
+                # guard — the first guarded step's compile is warmup,
+                # any later recompile storms (rebuild sites reset the
+                # scope so planned recompiles stay silent).
+                compilewatch = getattr(self.obs, "compilewatch", None) \
+                    if self.obs is not None else None
+                with step_annotation(self.global_step), \
+                        guarded(compilewatch, "train_step",
+                                step=self.global_step):
                     self.state, metrics = self._train_step(
                         self.state, node_batch, self.attack_plan
                     )
@@ -820,12 +839,21 @@ class DistributedTrainer:
         ML-detector refit + secondary verdicts (attack_detector.py:381-425)."""
         if self.config.adaptive_thresholds:
             self.trust_manager.adaptive_threshold_adjustment()
-            self.state = self.state._replace(
-                trust=self.state.trust._replace(
-                    threshold=jnp.asarray(
-                        self.trust_manager.trust_threshold, jnp.float32
-                    )
+            threshold = jnp.asarray(
+                self.trust_manager.trust_threshold, jnp.float32
+            )
+            if len(list(self.mesh.devices.flat)) > 1:
+                # Same replicated placement as init/_place_on_mesh: a
+                # bare jnp scalar is an UNCOMMITTED SingleDeviceSharding
+                # leaf, which changes the jitted step's input signature
+                # and silently recompiled the whole train step on the
+                # first step of every post-adjustment epoch (caught by
+                # the compile watcher's train_step guard).
+                threshold = jax.device_put(
+                    threshold, NamedSharding(self.mesh, P())
                 )
+            self.state = self.state._replace(
+                trust=self.state.trust._replace(threshold=threshold)
             )
         if self._ml_enabled:
             self.attack_detector.update_detection_models()
@@ -1538,6 +1566,11 @@ class DistributedTrainer:
         # must not depend on which caller runs next).
         self.attack_plan = self._place_plan(null_plan(n))
         self.state = None  # template must be rebuilt with the new shapes
+        if self.obs is not None and \
+                getattr(self.obs, "compilewatch", None) is not None:
+            # The step was legitimately rebuilt for the new topology —
+            # its next compile is warmup, not a storm.
+            self.obs.compilewatch.reset("train_step")
 
     def load_checkpoint(self, step: Optional[int] = None) -> TrainState:
         """Restore the full world-view — weights AND trust state — then

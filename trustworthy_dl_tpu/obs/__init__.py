@@ -50,7 +50,27 @@ from trustworthy_dl_tpu.obs.attribution import (
     token_hash,
     verify_attribution,
 )
-from trustworthy_dl_tpu.obs.events import EVENT_SCHEMAS, EventType, TraceBus
+from trustworthy_dl_tpu.obs.compilewatch import (
+    CompileRegistry,
+    CompileWatcher,
+)
+from trustworthy_dl_tpu.obs.events import (
+    EVENT_SCHEMAS,
+    EventType,
+    TraceBus,
+    read_jsonl_rotated,
+)
+from trustworthy_dl_tpu.obs.hbm import (
+    CostLedger,
+    HbmMonitor,
+    analyze_program,
+    live_buffer_bytes,
+)
+from trustworthy_dl_tpu.obs.sentinel import (
+    PerfLedger,
+    PerfSentinel,
+    fingerprint as perf_fingerprint,
+)
 from trustworthy_dl_tpu.obs.meta import run_metadata
 from trustworthy_dl_tpu.obs.recorder import FlightRecorder
 from trustworthy_dl_tpu.obs.registry import (
@@ -75,25 +95,35 @@ from trustworthy_dl_tpu.obs.spans import (
 __all__ = [
     "AnomalyWatcher",
     "AttributionLedger",
+    "CompileRegistry",
+    "CompileWatcher",
+    "CostLedger",
     "EVENT_SCHEMAS",
     "EventType",
     "EwmaDetector",
     "FlightRecorder",
+    "HbmMonitor",
     "MetricsRegistry",
     "ObsSession",
     "P2Quantile",
     "PHASES",
+    "PerfLedger",
+    "PerfSentinel",
     "SLORule",
     "SLOWatcher",
     "SpanTracker",
     "StepTimeReporter",
     "StreamingPercentiles",
     "TraceBus",
+    "analyze_program",
     "chrome_trace_from_events",
     "default_serve_rules",
     "get_registry",
+    "live_buffer_bytes",
     "mfu_from_throughput",
     "peak_flops_per_chip",
+    "perf_fingerprint",
+    "read_jsonl_rotated",
     "read_ledger",
     "run_metadata",
     "token_hash",

@@ -1,0 +1,278 @@
+"""Compile watcher: every XLA compilation as a typed, metered event —
+and the compile-once pins enforced at RUNTIME, not just in pytest.
+
+The serving engine's fused decode step and the trainer's jitted train
+step are both built to compile exactly once (block tables and trust
+masks are traced VALUES; geometry never changes mid-run).  The test
+suite pins that with ``_cache_size()`` deltas, but production only
+found out when tokens/sec fell off a cliff: a recompile storm inside
+the decode loop is silent in every artifact the obs plane produced
+before this module.
+
+Two pieces:
+
+* :class:`CompileRegistry` — a ``jax.monitoring`` duration listener
+  that records every XLA compilation in the process: per-stage counts
+  and wall time (``tddl_compile_total`` /
+  ``tddl_compile_seconds{stage=}``) plus one typed ``compile`` trace
+  event per backend compile.  Listeners in jax are process-global and
+  irremovable one-by-one, so ONE module-level dispatcher is registered
+  lazily and fans out to the currently-installed registries —
+  ``install()`` / ``uninstall()`` are cheap and test-safe.
+* :class:`CompileWatcher` — the runtime contract.  A hot loop wraps its
+  jitted dispatch in ``watcher.guard(scope)``; compiles landing inside
+  the first ``warmup_calls`` guarded calls of a scope are warmup (the
+  legitimate first build), any compile after that is a **storm**: a
+  typed ``compile_storm`` event, a ``tddl_compile_storms_total{scope=}``
+  bump, and a once-per-episode flight dump (consecutive storming calls
+  are one episode; a clean guarded call closes it).  A legitimate
+  rebuild (elastic topology change rebuilding the train step) calls
+  ``reset(scope)`` so the next compile is warmup again.
+
+Host-only at import time: jax is imported lazily inside ``install()``
+(the obs CLI must keep importing this package without jax).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+from trustworthy_dl_tpu.obs.events import EventType
+
+logger = logging.getLogger(__name__)
+
+#: The jax.monitoring duration event that fires once per actual XLA
+#: backend compilation (tracing/lowering stages fire their own events,
+#: recorded per stage but not counted as "a compile").
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_COMPILE_PREFIX = "/jax/core/compile/"
+
+_DISPATCH_LOCK = threading.Lock()
+_ACTIVE: "set[CompileRegistry]" = set()
+_DISPATCHER_INSTALLED = False
+
+
+def _dispatch_duration(event: str, duration: float, **_kw: Any) -> None:
+    for registry in list(_ACTIVE):
+        registry._on_duration(event, duration)
+
+
+def _install_dispatcher() -> None:
+    global _DISPATCHER_INSTALLED
+    with _DISPATCH_LOCK:
+        if _DISPATCHER_INSTALLED:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _dispatch_duration
+        )
+        _DISPATCHER_INSTALLED = True
+
+
+def _stage_name(event: str) -> str:
+    stage = event.rsplit("/", 1)[-1]
+    return stage[:-len("_duration")] if stage.endswith("_duration") \
+        else stage
+
+
+class CompileRegistry:
+    """Process-wide XLA compilation record for one obs session.
+
+    ``total`` / ``total_seconds`` count backend compiles only — the
+    number a recompile storm moves; per-stage counts (jaxpr trace,
+    MLIR lowering, backend compile) live in ``by_stage`` and the
+    ``tddl_compile_seconds{stage=}`` counter.
+    """
+
+    def __init__(self, trace: Any = None, registry: Any = None,
+                 keep: int = 256):
+        self.trace = trace
+        self._lock = threading.Lock()
+        self.total = 0
+        self.total_seconds = 0.0
+        self.by_stage: Dict[str, Dict[str, float]] = {}
+        self.recent: collections.deque = collections.deque(maxlen=keep)
+        self._installed = False
+        self._count_metric = None
+        self._seconds_metric = None
+        if registry is not None:
+            self._count_metric = registry.counter(
+                "tddl_compile_total",
+                "XLA backend compilations observed via jax.monitoring",
+            )
+            self._seconds_metric = registry.counter(
+                "tddl_compile_seconds",
+                "Wall time spent compiling, by jax.monitoring stage",
+                labels=("stage",),
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> "CompileRegistry":
+        """Start receiving compile events (idempotent).  Imports jax."""
+        _install_dispatcher()
+        with _DISPATCH_LOCK:
+            _ACTIVE.add(self)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        with _DISPATCH_LOCK:
+            _ACTIVE.discard(self)
+        self._installed = False
+
+    # -- listener ----------------------------------------------------------
+
+    def _on_duration(self, event: str, seconds: float) -> None:
+        if not event.startswith(_COMPILE_PREFIX):
+            return
+        stage = _stage_name(event)
+        is_compile = event == BACKEND_COMPILE_EVENT
+        with self._lock:
+            entry = self.by_stage.setdefault(stage,
+                                             {"count": 0, "seconds": 0.0})
+            entry["count"] += 1
+            entry["seconds"] += float(seconds)
+            if is_compile:
+                self.total += 1
+                self.total_seconds += float(seconds)
+                self.recent.append((stage, float(seconds)))
+        if self._seconds_metric is not None:
+            self._seconds_metric.inc(float(seconds), stage=stage)
+        if is_compile:
+            if self._count_metric is not None:
+                self._count_metric.inc()
+            if self.trace is not None:
+                self.trace.emit(EventType.COMPILE, key=stage,
+                                seconds=float(seconds))
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "total": self.total,
+                "seconds": round(self.total_seconds, 6),
+                "by_stage": {k: {"count": int(v["count"]),
+                                 "seconds": round(v["seconds"], 6)}
+                             for k, v in sorted(self.by_stage.items())},
+            }
+
+
+class _ScopeState:
+    __slots__ = ("calls", "storms", "episode_open")
+
+    def __init__(self) -> None:
+        self.calls = 0
+        self.storms = 0
+        self.episode_open = False
+
+
+class CompileWatcher:
+    """Turns the compile-once pins into a production contract (module
+    docstring).  ``dump`` has the :meth:`ObsSession.dump_flight`
+    signature; every storm EPISODE produces exactly one dump."""
+
+    def __init__(self, compiles: CompileRegistry, trace: Any = None,
+                 registry: Any = None, dump: Any = None,
+                 warmup_calls: int = 1):
+        if warmup_calls < 1:
+            raise ValueError("warmup_calls must be >= 1")
+        self.compiles = compiles
+        self.trace = trace
+        self.dump = dump
+        self.warmup_calls = warmup_calls
+        self._scopes: Dict[str, _ScopeState] = {}
+        self._lock = threading.Lock()
+        self._storm_metric = None
+        if registry is not None:
+            self._storm_metric = registry.counter(
+                "tddl_compile_storms_total",
+                "Post-warmup recompiles inside a guarded hot loop",
+                labels=("scope",),
+            )
+
+    def _scope(self, name: str) -> _ScopeState:
+        with self._lock:
+            state = self._scopes.get(name)
+            if state is None:
+                state = self._scopes[name] = _ScopeState()
+            return state
+
+    def reset(self, scope: str) -> None:
+        """Back to cold: the next compile in ``scope`` is warmup again
+        (call at LEGITIMATE rebuild points — elastic topology changes,
+        ``reset_for_run`` — so a planned recompile is not a storm)."""
+        with self._lock:
+            self._scopes.pop(scope, None)
+
+    @property
+    def storm_total(self) -> int:
+        with self._lock:
+            return sum(s.storms for s in self._scopes.values())
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {name: {"calls": s.calls, "storms": s.storms,
+                           "warm": s.calls >= self.warmup_calls}
+                    for name, s in sorted(self._scopes.items())}
+
+    @contextmanager
+    def guard(self, scope: str, step: Optional[int] = None
+              ) -> Iterator[None]:
+        """Wrap ONE dispatch of a compile-once program.  Compiles inside
+        the first ``warmup_calls`` guarded calls are absorbed; later
+        ones storm."""
+        before = self.compiles.total
+        try:
+            yield
+        finally:
+            self._after(scope, before, step)
+
+    def _after(self, scope: str, before: int,
+               step: Optional[int]) -> None:
+        state = self._scope(scope)
+        delta = self.compiles.total - before
+        warm = state.calls >= self.warmup_calls
+        state.calls += 1
+        if delta <= 0:
+            state.episode_open = False
+            return
+        if not warm:
+            return
+        state.storms += delta
+        logger.warning(
+            "compile storm: %d recompile(s) inside the %r loop after "
+            "warmup (step %s) — the compile-once contract is broken",
+            delta, scope, step,
+        )
+        if self._storm_metric is not None:
+            self._storm_metric.inc(delta, scope=scope)
+        if self.trace is not None:
+            self.trace.emit(EventType.COMPILE_STORM, step=step,
+                            scope=scope, compiles=int(delta))
+        if not state.episode_open:
+            state.episode_open = True
+            if self.dump is not None:
+                self.dump("compile_storm", step=step,
+                          extra={"scope": scope, "compiles": int(delta)})
+
+
+_NULL_CONTEXT = contextlib.nullcontext()
+
+
+def guarded(watcher: Optional[CompileWatcher], scope: str,
+            step: Optional[int] = None):
+    """``watcher.guard(...)`` or a shared no-op context — the one-liner
+    hot loops use so the unwatched path stays allocation-free
+    (``nullcontext`` is stateless and reentrant; one module-level
+    instance serves every caller)."""
+    if watcher is None:
+        return _NULL_CONTEXT
+    return watcher.guard(scope, step=step)

@@ -25,9 +25,11 @@ from __future__ import annotations
 
 import enum
 import json
+import os
+import re
 import threading
 import time
-from typing import Any, Dict, IO, Optional
+from typing import Any, Dict, IO, List, Optional, Tuple
 
 
 class EventType(str, enum.Enum):
@@ -77,6 +79,17 @@ class EventType(str, enum.Enum):
     REPLICA_TRANSITION = "replica_transition"
     FLEET_FAILOVER = "fleet_failover"
     FLEET_HEDGE = "fleet_hedge"
+    # Performance tier (obs/compilewatch.py, hbm.py, sentinel.py):
+    # every XLA compilation, compile-once contract violations, live-HBM
+    # sweeps/pressure denials, and perf-ledger regressions.
+    COMPILE = "compile"
+    COMPILE_STORM = "compile_storm"
+    HBM_SWEEP = "hbm_sweep"
+    HBM_PRESSURE = "hbm_pressure"
+    PERF_REGRESSION = "perf_regression"
+    # Trace-bus housekeeping: the first event of a fresh segment after a
+    # size-based rotation names the segment the bus just sealed.
+    TRACE_ROTATE = "trace_rotate"
 
 
 #: type -> {"requires": base correlation keys, "fields": required extras}.
@@ -145,7 +158,33 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     },
     EventType.FLEET_HEDGE: {"requires": ("request_id",),
                             "fields": ("replica",)},
+    # Performance tier.  ``compile`` rows are per-XLA-compilation (key =
+    # the jax.monitoring stage, seconds = backend compile wall time);
+    # ``compile_storm`` marks a post-warmup recompile inside a guarded
+    # hot loop (scope = which loop).  HBM rows carry byte counts; a
+    # ``perf_regression`` names the fingerprint metric that left the
+    # ledger's noise band.
+    EventType.COMPILE: {"requires": (), "fields": ("key", "seconds")},
+    EventType.COMPILE_STORM: {"requires": (),
+                              "fields": ("scope", "compiles")},
+    EventType.HBM_SWEEP: {"requires": (),
+                          "fields": ("live_bytes", "watermark_bytes")},
+    EventType.HBM_PRESSURE: {
+        "requires": (),
+        "fields": ("requested_bytes", "headroom_bytes"),
+    },
+    EventType.PERF_REGRESSION: {
+        "requires": (), "fields": ("metric", "value", "baseline"),
+    },
+    EventType.TRACE_ROTATE: {"requires": (),
+                             "fields": ("path", "segment")},
 }
+
+
+#: Floor for ``TraceBus.max_bytes``: a rotation cap must comfortably
+#: hold the trace_rotate announcement line plus real events, or the
+#: fresh segment would immediately re-trip the cap.
+MIN_ROTATE_BYTES = 4096
 
 
 def validate_event(event: Dict[str, Any]) -> None:
@@ -178,8 +217,22 @@ class TraceBus:
 
     def __init__(self, jsonl_path: Optional[str] = None,
                  recorder: Any = None, registry: Any = None,
-                 validate: bool = True):
+                 validate: bool = True, max_bytes: int = 0):
+        # ``max_bytes`` > 0 enables size-based rotation: when the live
+        # file crosses the cap it is sealed as ``trace.<n>.jsonl`` (n
+        # monotonically increasing) and a fresh ``trace.jsonl`` opens
+        # whose FIRST event is a typed ``trace_rotate`` row naming the
+        # sealed segment — long serve/fleet runs stay disk-bounded per
+        # segment and the reader side (:func:`read_jsonl_rotated`, the
+        # obs CLI, the offline Chrome export) walks segments in order.
         self.jsonl_path = str(jsonl_path) if jsonl_path else None
+        self.max_bytes = int(max_bytes)
+        if 0 < self.max_bytes < MIN_ROTATE_BYTES:
+            # A cap smaller than a handful of event lines would make the
+            # rotation ANNOUNCEMENT itself trip the cap — emit → rotate
+            # → emit recursion producing thousands of one-line segments.
+            self.max_bytes = MIN_ROTATE_BYTES
+        self.rotations = 0
         self.recorder = recorder
         self.validate = validate
         self._file: Optional[IO[str]] = None
@@ -210,6 +263,7 @@ class TraceBus:
         event.update(data)
         if self.validate:
             validate_event(event)
+        rotated: Optional[tuple] = None
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
@@ -221,11 +275,34 @@ class TraceBus:
                 if self._file is None:
                     self._file = open(self.jsonl_path, "a", buffering=1)
                 self._file.write(json.dumps(event) + "\n")
+                if self.max_bytes > 0 \
+                        and self._file.tell() >= self.max_bytes:
+                    rotated = self._rotate_locked()
         if self.recorder is not None:
             self.recorder.record(event)
         if self._counter is not None:
             self._counter.inc(type=etype)
+        if rotated is not None:
+            # Outside the lock: the rotation announcement is a normal
+            # typed event and lands as the FIRST line of the fresh
+            # segment (the fresh file cannot itself trip the cap here).
+            path, segment, size = rotated
+            self.emit(EventType.TRACE_ROTATE, path=path, segment=segment,
+                      bytes=size)
         return event
+
+    def _rotate_locked(self) -> "tuple[str, int, int]":
+        """Seal the live file as the next ``<stem>.<n>.jsonl`` segment
+        (caller holds the lock).  Returns (sealed path, segment, bytes)."""
+        size = self._file.tell()
+        self._file.close()
+        self._file = None
+        existing = [n for _, n in rotated_segments(self.jsonl_path)]
+        segment = (max(existing) + 1) if existing else 1
+        sealed = _segment_path(self.jsonl_path, segment)
+        os.replace(self.jsonl_path, sealed)
+        self.rotations += 1
+        return sealed, segment, size
 
     def close(self) -> None:
         with self._lock:
@@ -243,4 +320,41 @@ def read_jsonl(path: str) -> list:
             line = line.strip()
             if line:
                 events.append(json.loads(line))
+    return events
+
+
+def _segment_path(path: str, segment: int) -> str:
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.{segment}{ext}"
+
+
+def rotated_segments(path: str) -> "List[Tuple[str, int]]":
+    """(path, segment) of the sealed rotation segments belonging to
+    ``path`` (``trace.jsonl`` → ``trace.1.jsonl``, ``trace.2.jsonl``,
+    ...), ordered oldest first."""
+    stem, ext = os.path.splitext(os.path.basename(path))
+    directory = os.path.dirname(path) or "."
+    pattern = re.compile(
+        rf"^{re.escape(stem)}\.(\d+){re.escape(ext)}$"
+    )
+    out: List[Tuple[str, int]] = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            m = pattern.match(name)
+            if m:
+                out.append((os.path.join(directory, name), int(m.group(1))))
+    out.sort(key=lambda item: item[1])
+    return out
+
+
+def read_jsonl_rotated(path: str) -> list:
+    """Load a trace INCLUDING its sealed rotation segments, oldest
+    events first — the reader every offline consumer (obs CLI, Chrome
+    export) should use; a never-rotated trace reads identically to
+    :func:`read_jsonl`."""
+    events: list = []
+    for segment_path, _ in rotated_segments(path):
+        events.extend(read_jsonl(segment_path))
+    if os.path.exists(path):
+        events.extend(read_jsonl(path))
     return events

@@ -1,0 +1,283 @@
+"""Live-HBM accounting + per-program cost ledger.
+
+Answers the two runtime questions the passive obs plane could not:
+*how much accelerator memory is live right now* (and at peak), and
+*what does each compiled program actually cost* — analyzed FLOPs and
+bytes from XLA itself instead of the 6·params·tokens estimate and a
+marketing peak table.
+
+* :func:`live_buffer_bytes` — one ``jax.live_arrays()`` sweep grouped
+  by device.  :class:`HbmMonitor` turns sweeps into
+  ``tddl_hbm_live_bytes{device=}`` gauges, a monotone
+  ``tddl_hbm_watermark_bytes{device=}`` watermark, typed ``hbm_sweep``
+  events, and a **headroom gate**: the serve engine (and each fleet
+  replica build/restart) calls :meth:`HbmMonitor.admit` before
+  allocating a paged KV pool — low headroom shrinks/denies the growth
+  instead of discovering the OOM at ``device_put`` time
+  (``hbm_pressure`` event + ``tddl_hbm_pressure_total``).
+* :func:`analyze_program` / :class:`CostLedger` — the
+  ``lowered.cost_analysis()`` / ``compiled.memory_analysis()`` pattern
+  proven in ``experiments/pipeline_study.py``, generalized: per-program
+  FLOPs + bytes accessed from lowering (cheap — no backend compile),
+  temp/argument/output allocation from the compiled executable when
+  ``memory=True`` (one extra AOT compile; default gated on
+  ``TDDL_OBS_MEMORY_ANALYSIS=1`` so attaching obs never doubles a big
+  model's compile time silently).  The ledger lands in
+  ``obs_report.json`` and feeds the **analyzed-FLOPs MFU** that
+  replaces the nominal-peak-table guess (obs/report.py).
+
+jax is imported lazily inside the functions — the obs CLI imports this
+package with no jax present.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from trustworthy_dl_tpu.obs.events import EventType
+
+logger = logging.getLogger(__name__)
+
+
+def live_buffer_bytes() -> Dict[str, int]:
+    """Bytes of live (undeleted, undonated) jax arrays per device.
+    Committed single-device arrays count fully on their device; sharded
+    arrays split their bytes evenly across their device set (addressable
+    shard sizes are not exposed uniformly on 0.4.x)."""
+    import jax
+
+    out: Dict[str, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            devices = list(arr.devices())
+            nbytes = int(arr.nbytes)
+        except Exception:  # deleted/donated mid-sweep
+            continue
+        if not devices:
+            continue
+        share = nbytes // len(devices)
+        for dev in devices:
+            key = str(dev)
+            out[key] = out.get(key, 0) + share
+    return out
+
+
+def device_budget_bytes() -> Optional[int]:
+    """Per-device HBM budget: ``TDDL_HBM_BUDGET_BYTES`` env wins, else
+    the backend's own ``memory_stats()['bytes_limit']`` (TPU/GPU), else
+    None (unknown — CPU backends report no limit)."""
+    env = os.environ.get("TDDL_HBM_BUDGET_BYTES")
+    if env:
+        return int(float(env))
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return None
+
+
+class HbmMonitor:
+    """Watermark gauges + the pool-growth headroom gate."""
+
+    def __init__(self, registry: Any = None, trace: Any = None,
+                 budget_bytes: Optional[int] = None,
+                 reserve_fraction: float = 0.0):
+        # ``reserve_fraction``: slack kept free even when admitting (a
+        # pool sized to the last byte leaves nothing for activations).
+        self.trace = trace
+        self.budget_bytes = (int(budget_bytes) if budget_bytes is not None
+                             else device_budget_bytes())
+        self.reserve_fraction = float(reserve_fraction)
+        self.watermark: Dict[str, int] = {}
+        self.last_sweep: Dict[str, int] = {}
+        #: Headroom measured by the LAST admit()/headroom_bytes() call —
+        #: a denied caller sizes its shrunk allocation from THIS value,
+        #: so the deny decision and the re-size use one sweep (a second
+        #: sweep could report different headroom than the gate enforced).
+        self.last_headroom: Optional[int] = None
+        self.pressure_denials = 0
+        self._live_gauge = None
+        self._mark_gauge = None
+        self._pressure_metric = None
+        if registry is not None:
+            self._live_gauge = registry.gauge(
+                "tddl_hbm_live_bytes",
+                "Live jax array bytes, by device (last sweep)",
+                labels=("device",),
+            )
+            self._mark_gauge = registry.gauge(
+                "tddl_hbm_watermark_bytes",
+                "Peak live jax array bytes ever swept, by device",
+                labels=("device",),
+            )
+            self._pressure_metric = registry.counter(
+                "tddl_hbm_pressure_total",
+                "Pool growths denied/shrunk by the headroom gate",
+            )
+
+    # -- sweeps ------------------------------------------------------------
+
+    def sweep(self, step: Optional[int] = None,
+              emit: bool = False) -> Dict[str, Any]:
+        """One live-buffer sweep: update gauges + watermark; optionally
+        emit a typed ``hbm_sweep`` event (sweeps can be frequent — the
+        event is for cadence points, the gauges for dashboards)."""
+        per_device = live_buffer_bytes()
+        self.last_sweep = per_device
+        for device, nbytes in per_device.items():
+            peak = max(self.watermark.get(device, 0), nbytes)
+            self.watermark[device] = peak
+            if self._live_gauge is not None:
+                self._live_gauge.set(float(nbytes), device=device)
+                self._mark_gauge.set(float(peak), device=device)
+        summary = {
+            "per_device": per_device,
+            "total_bytes": sum(per_device.values()),
+            "watermark_bytes": self.watermark_bytes,
+        }
+        if emit and self.trace is not None:
+            self.trace.emit(EventType.HBM_SWEEP, step=step,
+                            live_bytes=summary["total_bytes"],
+                            watermark_bytes=summary["watermark_bytes"],
+                            devices=len(per_device))
+        return summary
+
+    @property
+    def watermark_bytes(self) -> int:
+        """Peak single-device live bytes (the OOM-relevant number)."""
+        return max(self.watermark.values()) if self.watermark else 0
+
+    def headroom_bytes(self) -> Optional[int]:
+        """Budget minus the busiest device's CURRENT live bytes (after a
+        fresh sweep), minus the reserve.  None when no budget is known."""
+        if self.budget_bytes is None:
+            self.last_headroom = None
+            return None
+        self.sweep()
+        used = max(self.last_sweep.values()) if self.last_sweep else 0
+        reserve = int(self.budget_bytes * self.reserve_fraction)
+        self.last_headroom = self.budget_bytes - used - reserve
+        return self.last_headroom
+
+    # -- the growth gate ---------------------------------------------------
+
+    def admit(self, requested_bytes: int, what: str = "",
+              step: Optional[int] = None) -> bool:
+        """May ``requested_bytes`` of new device allocation proceed?
+        Unknown budget → always True (the gate never blocks dev boxes);
+        a denial emits ``hbm_pressure`` so the refusal is attributable."""
+        headroom = self.headroom_bytes()
+        if headroom is None or requested_bytes <= headroom:
+            return True
+        self.pressure_denials += 1
+        logger.warning(
+            "HBM pressure: %s wants %d bytes but headroom is %d "
+            "(budget %d, reserve %.0f%%) — growth denied",
+            what or "allocation", requested_bytes, headroom,
+            self.budget_bytes, self.reserve_fraction * 100,
+        )
+        if self._pressure_metric is not None:
+            self._pressure_metric.inc()
+        if self.trace is not None:
+            self.trace.emit(EventType.HBM_PRESSURE, step=step,
+                            requested_bytes=int(requested_bytes),
+                            headroom_bytes=int(headroom),
+                            what=what or None)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Per-program cost ledger
+# ---------------------------------------------------------------------------
+
+
+def _normalize_cost(cost: Any) -> Dict[str, float]:
+    """jax's cost_analysis returns a dict (Lowered) or a 1-list of dicts
+    (Compiled) depending on path/version — normalize to one dict."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def memory_analysis_enabled() -> bool:
+    return os.environ.get("TDDL_OBS_MEMORY_ANALYSIS") == "1"
+
+
+def analyze_program(fn: Any, *args: Any, memory: Optional[bool] = None,
+                    **kwargs: Any) -> Dict[str, Any]:
+    """Cost block for one jitted callable at concrete ``args``:
+    ``flops`` / ``bytes_accessed`` from ``lower().cost_analysis()``
+    (no backend compile), plus compiled ``memory_analysis`` fields
+    (temp/argument/output/code bytes) when ``memory`` is on."""
+    if memory is None:
+        memory = memory_analysis_enabled()
+    lowered = fn.lower(*args, **kwargs)
+    cost = _normalize_cost(lowered.cost_analysis())
+    out: Dict[str, Any] = {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "cost_source": "lowered.cost_analysis",
+    }
+    if memory:
+        compiled = lowered.compile()
+        ccost = _normalize_cost(compiled.cost_analysis())
+        if ccost.get("flops"):
+            out["flops"] = float(ccost["flops"])
+            out["bytes_accessed"] = float(ccost.get("bytes accessed", 0.0))
+            out["cost_source"] = "compiled.cost_analysis"
+        try:
+            mem = compiled.memory_analysis()
+            out["temp_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0))
+            out["argument_bytes"] = int(
+                getattr(mem, "argument_size_in_bytes", 0))
+            out["output_bytes"] = int(
+                getattr(mem, "output_size_in_bytes", 0))
+            out["generated_code_bytes"] = int(
+                getattr(mem, "generated_code_size_in_bytes", 0))
+        except Exception:  # backend without memory_analysis
+            pass
+    return out
+
+
+class CostLedger:
+    """Named compiled programs → analyzed cost blocks, stamped into
+    ``obs_report.json`` (StepTimeReporter reads ``programs``)."""
+
+    def __init__(self) -> None:
+        self.programs: Dict[str, Dict[str, Any]] = {}
+
+    def note(self, name: str, cost: Dict[str, Any]) -> None:
+        self.programs[str(name)] = dict(cost)
+
+    def analyze(self, name: str, fn: Any, *args: Any,
+                memory: Optional[bool] = None, **kwargs: Any) -> None:
+        """Analyze-and-note; failures degrade to an ``error`` entry — a
+        cost stamp must never be the reason a run dies."""
+        try:
+            self.note(name, analyze_program(fn, *args, memory=memory,
+                                            **kwargs))
+        except Exception as exc:
+            logger.debug("cost analysis of %r failed", name, exc_info=True)
+            self.programs[str(name)] = {
+                "error": f"{type(exc).__name__}: {str(exc)[:120]}"
+            }
+
+    def flops(self, name: str) -> Optional[float]:
+        entry = self.programs.get(name)
+        if entry and entry.get("flops"):
+            return float(entry["flops"])
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: dict(entry)
+                for name, entry in sorted(self.programs.items())}
+
+    def __bool__(self) -> bool:
+        return bool(self.programs)

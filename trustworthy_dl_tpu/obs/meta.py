@@ -61,8 +61,13 @@ def _device_info() -> Dict[str, Any]:
         }
 
 
-def run_metadata() -> Dict[str, Any]:
-    """The metadata block every published JSON artifact embeds."""
+def run_metadata(host_only: bool = False) -> Dict[str, Any]:
+    """The metadata block every published JSON artifact embeds.
+
+    ``host_only=True`` skips device discovery entirely (platform
+    ``"unprobed"``) — for writers that must never touch the backend,
+    like bench's probe-first parent emitting a SKIP record while the
+    backend is the very thing that is wedged."""
     from trustworthy_dl_tpu import __version__
 
     meta = {
@@ -72,5 +77,19 @@ def run_metadata() -> Dict[str, Any]:
         "hostname": _platform.node(),
         "timestamp": time.time(),
     }
+    if host_only:
+        try:
+            import importlib.metadata as _md
+
+            jax_version = _md.version("jax")
+        except Exception:
+            jax_version = "unknown"
+        meta.update({
+            "platform": "unprobed",
+            "device_kind": "unknown",
+            "num_devices": 0,
+            "jax_version": jax_version,
+        })
+        return meta
     meta.update(_device_info())
     return meta

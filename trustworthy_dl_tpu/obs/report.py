@@ -133,6 +133,12 @@ class StepTimeReporter:
         #: marks the phase accounting used — the trainer loop needs no
         #: extra instrumentation for its timeline.
         self.spans: Any = None
+        #: Optional obs.hbm.CostLedger — per-program XLA cost blocks
+        #: (flops / bytes / temp allocation) stamped into the report,
+        #: and the source of the analyzed-FLOPs MFU that replaces the
+        #: nominal 6·params·tokens guess when a ``train_step`` entry
+        #: exists.
+        self.cost_ledger: Any = None
         self.last_step_total: Optional[float] = None
         self.n_params: Optional[int] = None
         self.tokens_per_step: Optional[int] = None
@@ -223,6 +229,26 @@ class StepTimeReporter:
     def num_steps(self) -> int:
         return len(self._steps)
 
+    @property
+    def step_time_mean(self) -> Optional[float]:
+        if not self._steps:
+            return None
+        return float(np.mean([s["_total"] for s in self._steps]))
+
+    def phase_fractions(self) -> Dict[str, float]:
+        """Per-phase share of the accounted wall time (the fingerprint's
+        compact view of the full ``phases`` report block)."""
+        steps = list(self._steps)
+        if not steps:
+            return {}
+        grand = sum(s["_total"] for s in steps)
+        out: Dict[str, float] = {}
+        for phase in PHASES:
+            total = sum(s.get(phase, 0.0) for s in steps)
+            if total > 0.0 and grand > 0.0:
+                out[phase] = total / grand
+        return out
+
     # -- reporting ---------------------------------------------------------
 
     def report(self) -> Dict[str, Any]:
@@ -276,7 +302,41 @@ class StepTimeReporter:
                     "note": "MFU defined for LM (6 FLOPs/param/token) "
                             "only",
                 }
+        ledger = self.cost_ledger
+        if ledger:
+            out["cost_ledger"] = ledger.to_dict()
+            analyzed = self._analyzed_mfu(out)
+            if analyzed is not None:
+                out["mfu_analyzed"] = analyzed
         return out
+
+    def _analyzed_mfu(self, out: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """MFU from XLA's OWN flop count of the train step program
+        (``cost_ledger['train_step'].flops`` per execution) over the
+        measured mean step time — no 6 FLOPs/param/token modelling, no
+        samples-vs-tokens ambiguity, and it covers remat recompute and
+        the detection battery the nominal estimate ignores.  The peak
+        denominator stays the per-device_kind table (its source is
+        named, as always)."""
+        flops = self.cost_ledger.flops("train_step") \
+            if self.cost_ledger is not None else None
+        mean_step = (out.get("step_time_s") or {}).get("mean")
+        if not flops or not mean_step:
+            return None
+        from trustworthy_dl_tpu.obs.meta import run_metadata
+
+        device_kind = run_metadata()["device_kind"]
+        peak, source = peak_flops_per_chip(device_kind)
+        achieved = flops / mean_step / max(self.num_chips, 1)
+        return {
+            "flops_per_step": flops,
+            "flops_source": "xla-cost-analysis",
+            "achieved_flops_per_s_per_chip": achieved,
+            "peak_flops_per_chip": peak,
+            "peak_flops_source": source,
+            "num_chips": self.num_chips,
+            "mfu": achieved / peak if peak > 0 else None,
+        }
 
     def write(self, path: str) -> Dict[str, Any]:
         report = self.report()

@@ -58,18 +58,28 @@ class ObsSession:
                  registry: Optional[MetricsRegistry] = None,
                  recorder_capacity: int = 2048,
                  metrics_snapshot_every: int = 0,
-                 validate_events: bool = True):
+                 validate_events: bool = True,
+                 trace_max_bytes: int = 0,
+                 perf_ledger: Optional[str] = None,
+                 cost_analysis: Optional[bool] = None):
         self.obs_dir = str(obs_dir) if obs_dir else None
         if self.obs_dir:
             os.makedirs(self.obs_dir, exist_ok=True)
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.recorder = FlightRecorder(recorder_capacity)
+        # ``trace_max_bytes`` (or TDDL_TRACE_MAX_BYTES) bounds the live
+        # trace file: past the cap it is sealed as trace.<n>.jsonl and a
+        # fresh segment opens (obs/events.py rotation; readers walk the
+        # segments in order).
+        if trace_max_bytes == 0:
+            trace_max_bytes = int(os.environ.get("TDDL_TRACE_MAX_BYTES",
+                                                 "0"))
         self.trace = TraceBus(
             os.path.join(self.obs_dir, "trace.jsonl")
             if self.obs_dir else None,
             recorder=self.recorder, registry=self.registry,
-            validate=validate_events,
+            validate=validate_events, max_bytes=trace_max_bytes,
         )
         self.step_timer = StepTimeReporter(registry=self.registry)
         self.metrics_snapshot_every = int(metrics_snapshot_every)
@@ -80,6 +90,33 @@ class ObsSession:
         self.slo: Any = None              # obs.slo.SLOWatcher
         self.anomaly: Any = None          # obs.anomaly.AnomalyWatcher
         self.ledger: Any = None           # obs.attribution.AttributionLedger
+        # Performance tier (None until enabled): the compile registry/
+        # watcher pair and the HBM monitor.  The cost ledger defaults ON
+        # for artifact-producing sessions (obs_dir set) and OFF for
+        # in-memory ones: its one lowering per analyzed program is cheap
+        # but not free, and a bench arm's ObsSession(None) must not pay
+        # it inside a measured loop.
+        self.compiles: Any = None         # obs.compilewatch.CompileRegistry
+        self.compilewatch: Any = None     # obs.compilewatch.CompileWatcher
+        self.hbm: Any = None              # obs.hbm.HbmMonitor
+        if cost_analysis is None:
+            cost_analysis = self.obs_dir is not None
+        self.cost_ledger: Any = None
+        if cost_analysis:
+            from trustworthy_dl_tpu.obs.hbm import CostLedger
+
+            self.cost_ledger = CostLedger()
+        self.step_timer.cost_ledger = self.cost_ledger
+        # Perf-fingerprint ledger path: explicit arg, else
+        # TDDL_PERF_LEDGER (the cross-run trajectory file), else a
+        # run-local PERF_LEDGER.jsonl beside the other artifacts.
+        if perf_ledger is None:
+            perf_ledger = os.environ.get("TDDL_PERF_LEDGER") or (
+                os.path.join(self.obs_dir, "PERF_LEDGER.jsonl")
+                if self.obs_dir else None
+            )
+        self.perf_ledger_path = perf_ledger
+        self.perf_verdict: Optional[Dict[str, Any]] = None
         self.trace.emit(EventType.RUN_START, obs_dir=self.obs_dir)
 
     # -- active plane ------------------------------------------------------
@@ -117,6 +154,40 @@ class ObsSession:
                 dump=self.dump_flight,
             )
         return self.slo, self.anomaly
+
+    def enable_compile_watch(self, warmup_calls: int = 1) -> Any:
+        """Install the jax.monitoring compile listener + the runtime
+        compile-once watcher (obs/compilewatch.py).  Hot loops that
+        received this session guard their jitted dispatch; idempotent.
+        Imports jax — call only where a backend is expected."""
+        if self.compilewatch is None:
+            from trustworthy_dl_tpu.obs.compilewatch import (
+                CompileRegistry,
+                CompileWatcher,
+            )
+
+            self.compiles = CompileRegistry(
+                trace=self.trace, registry=self.registry
+            ).install()
+            self.compilewatch = CompileWatcher(
+                self.compiles, trace=self.trace, registry=self.registry,
+                dump=self.dump_flight, warmup_calls=warmup_calls,
+            )
+        return self.compilewatch
+
+    def enable_hbm(self, budget_bytes: Optional[int] = None,
+                   reserve_fraction: float = 0.0) -> Any:
+        """Attach the live-HBM monitor (gauges + watermark + the pool
+        headroom gate the serve engine consults).  Idempotent."""
+        if self.hbm is None:
+            from trustworthy_dl_tpu.obs.hbm import HbmMonitor
+
+            self.hbm = HbmMonitor(
+                registry=self.registry, trace=self.trace,
+                budget_bytes=budget_bytes,
+                reserve_fraction=reserve_fraction,
+            )
+        return self.hbm
 
     def open_ledger(self, keep: int = 4096) -> Any:
         """Open the per-request attribution ledger (JSONL beside the
@@ -203,6 +274,63 @@ class ObsSession:
                 json.dump(status, f, indent=2)
         return status
 
+    def perf_fingerprint(self) -> Dict[str, Any]:
+        """The compact perf fingerprint this run appends to the rolling
+        ledger (obs/sentinel.py): step time, tokens/s (when the timer
+        knows the model), compile counts/seconds, HBM watermark."""
+        from trustworthy_dl_tpu.obs.meta import run_metadata
+        from trustworthy_dl_tpu.obs.sentinel import fingerprint
+
+        timer = self.step_timer
+        mean = timer.step_time_mean
+        tokens_per_s = None
+        if mean and timer.has_model_info and timer.tokens_per_step \
+                and timer.model_kind == "lm":
+            tokens_per_s = timer.tokens_per_step / mean
+        compiles = self.compiles
+        hbm = self.hbm
+        if hbm is not None:
+            hbm.sweep()
+        return fingerprint(
+            "session",
+            metric=timer.model_kind if timer.has_model_info else None,
+            tokens_per_s=tokens_per_s,
+            step_time_s=mean,
+            phase_fractions=timer.phase_fractions() or None,
+            compile_total=compiles.total if compiles else None,
+            compile_seconds=(round(compiles.total_seconds, 6)
+                             if compiles else None),
+            hbm_watermark_bytes=(hbm.watermark_bytes or None)
+            if hbm is not None else None,
+            run_metadata=run_metadata(),
+            extra={"num_steps": timer.num_steps},
+        )
+
+    def write_perf(self) -> Optional[Dict[str, Any]]:
+        """Sentinel pass + ledger append: compare this run's fingerprint
+        against the rolling ledger's noise band (typed
+        ``perf_regression`` events on breach), then append the
+        fingerprint — verdict stamped on it — as the newest entry."""
+        if not self.perf_ledger_path:
+            return None
+        from trustworthy_dl_tpu.obs.sentinel import PerfLedger, PerfSentinel
+
+        ledger = PerfLedger(self.perf_ledger_path)
+        fp = self.perf_fingerprint()
+        sentinel = PerfSentinel(ledger, trace=self.trace,
+                                registry=self.registry)
+        self.perf_verdict = sentinel.check(fp)
+        fp["regressed"] = self.perf_verdict["regressed"]
+        ledger.append(fp)
+        if self.perf_verdict["regressed"]:
+            logger.warning("perf sentinel: regression outside the noise "
+                           "band — %s", [
+                               c["metric"] for c in
+                               self.perf_verdict["checks"]
+                               if c.get("regressed")
+                           ])
+        return self.perf_verdict
+
     def finalize(self) -> None:
         """Final snapshot + report + active-plane artifacts + close the
         trace file.  Idempotent."""
@@ -212,11 +340,17 @@ class ObsSession:
         self.snapshot_metrics()
         self.write_report()
         self.write_slo_status()
+        self.write_perf()
         if self.spans is not None and self.obs_dir:
             self.spans.export_chrome(
                 os.path.join(self.obs_dir, "trace_events.json")
             )
         if self.ledger is not None:
             self.ledger.close()
+        if self.compiles is not None:
+            # Stop the process-global dispatcher from feeding a finished
+            # session (tests build many; a dead registry must not keep
+            # counting other runs' compiles).
+            self.compiles.uninstall()
         self.trace.emit(EventType.RUN_END)  # last event in the trace
         self.trace.close()

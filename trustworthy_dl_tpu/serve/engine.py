@@ -61,6 +61,26 @@ from trustworthy_dl_tpu.utils.metrics import MetricsCollector
 logger = logging.getLogger(__name__)
 
 
+class _NullMetric:
+    """No-op stand-in when a registry rejects a (re-)registration — the
+    one case is a label-shape clash (an unlabelled standalone engine
+    and a replica-labelled fleet engine sharing one registry).  The
+    engine's own rollup counters stay exact; only this engine's export
+    series is dropped, loudly at debug level."""
+
+    def inc(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def set(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def observe(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def value(self, *a: Any, **kw: Any) -> None:
+        return None
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One generation request.  ``temperature<=0`` decodes greedily;
@@ -177,7 +197,8 @@ class ServingEngine:
                  slo: Any = None, anomaly: Any = None,
                  retain_results: int = 1024,
                  replica_id: Optional[int] = None,
-                 retire_hook: Optional[Callable[..., None]] = None):
+                 retire_hook: Optional[Callable[..., None]] = None,
+                 compilewatch: Any = None, hbm: Any = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -196,6 +217,44 @@ class ServingEngine:
                 # exactly (max_slots full stripes), so paged-by-default
                 # is a strict superset before any knob is touched.
                 num_blocks = max_slots * (max_seq // block_size)
+        # HBM headroom gate (obs/hbm.py): the KV pool is the one
+        # construction-time allocation an operator sizes to fill HBM —
+        # consult the monitor BEFORE allocating and shrink to what the
+        # live budget actually has room for (floor: one full stripe /
+        # one slot), instead of discovering the OOM at device_put.  The
+        # denial itself is attributable: ``hbm_pressure`` event +
+        # ``tddl_hbm_pressure_total``.
+        self.hbm = hbm
+        if hbm is not None:
+            bpt = kv_bytes_per_token(cfg, jnp.int8) \
+                if kv_dtype == "int8" else kv_bytes_per_token(cfg)
+            if paged:
+                requested = num_blocks * block_size * bpt
+                if not hbm.admit(requested, what="serve_paged_pool"):
+                    # Size the shrunk pool from the SAME sweep that made
+                    # the deny decision (admit() stored it) — a second
+                    # sweep could report headroom the gate never saw.
+                    headroom = max(hbm.last_headroom or 0, 0)
+                    floor = max_seq // block_size
+                    allowed = max(int(headroom // (block_size * bpt)),
+                                  floor)
+                    logger.warning(
+                        "HBM headroom gate: paged pool shrunk %d -> %d "
+                        "blocks (requested %d bytes, headroom %d)",
+                        num_blocks, allowed, requested, headroom,
+                    )
+                    num_blocks = allowed
+            else:
+                requested = max_slots * max_seq * bpt
+                if not hbm.admit(requested, what="serve_stripe_pool"):
+                    headroom = max(hbm.last_headroom or 0, 0)
+                    allowed = max(int(headroom // (max_seq * bpt)), 1)
+                    logger.warning(
+                        "HBM headroom gate: stripe pool shrunk %d -> %d "
+                        "slots (requested %d bytes, headroom %d)",
+                        max_slots, allowed, requested, headroom,
+                    )
+                    max_slots = allowed
         # Quantization tier (quant/int8.py).  Unknown dtype strings fail
         # HERE; the int8 KV swap is additionally parity-gated: a short
         # eager greedy-token probe against the full-precision path, with
@@ -281,63 +340,100 @@ class ServingEngine:
         self.trace = trace
         if registry is None:
             registry = get_registry()
-        self.metrics = metrics or MetricsCollector(namespace="serve",
-                                                   registry=registry)
-        self._req_counter = registry.counter(
-            "tddl_serve_requests_total",
-            "Requests retired/shed, by terminal status", labels=("status",),
+        # Fleet-mode metric labelling: under a ServingFleet every engine
+        # shares ONE registry, so the per-engine serve gauges would
+        # last-writer-win each other (documented in PR 8 as "read only
+        # the fleet aggregates").  With a ``replica_id`` the whole
+        # tddl_serve_* surface gains a ``replica=`` label instead —
+        # per-replica occupancy/blocks/tokens individually readable —
+        # while standalone engines keep the unlabelled form.
+        self.replica_id = replica_id
+        self._rlabel_names = ("replica",) if replica_id is not None else ()
+        self._rlabels = ({"replica": str(replica_id)}
+                         if replica_id is not None else {})
+        self.metrics = metrics or MetricsCollector(
+            namespace="serve", registry=registry,
+            labels=self._rlabels or None,
         )
-        self._tok_counter = registry.counter(
-            "tddl_serve_tokens_total", "Tokens emitted"
+        # A registry that ALREADY holds a metric under the other label
+        # shape (a standalone engine registered the unlabelled form
+        # before a fleet replica arrived, or vice versa) would raise on
+        # re-registration; degrade that engine's series to a no-op
+        # instead — the rollup dicts stay the source of truth, exactly
+        # like MetricsCollector's export path.
+        def _metric(register, name, help, labels=(), **kw):
+            try:
+                return register(name, help, labels=labels, **kw)
+            except ValueError:
+                logger.debug("serve metrics: registry rejected %s%s",
+                             name, labels, exc_info=True)
+                return _NullMetric()
+
+        self._req_counter = _metric(
+            registry.counter, "tddl_serve_requests_total",
+            "Requests retired/shed, by terminal status",
+            labels=("status",) + self._rlabel_names,
         )
-        self._ttft_hist = registry.histogram(
-            "tddl_serve_ttft_seconds", "Submit -> first token"
+        self._tok_counter = _metric(
+            registry.counter, "tddl_serve_tokens_total", "Tokens emitted",
+            labels=self._rlabel_names,
         )
-        self._itl_hist = registry.histogram(
-            "tddl_serve_itl_seconds", "Inter-token latency"
+        self._ttft_hist = _metric(
+            registry.histogram, "tddl_serve_ttft_seconds",
+            "Submit -> first token", labels=self._rlabel_names,
+        )
+        self._itl_hist = _metric(
+            registry.histogram, "tddl_serve_itl_seconds",
+            "Inter-token latency", labels=self._rlabel_names,
         )
         # KV-pool capacity surface: bytes resident (values + scales) and
         # slot count by storage dtype — the numbers the quantization
         # A/B moves (int8 ≈ halves bytes/slot → ~2x slots at fixed HBM).
         kv = self.scheduler.kv
         kv_dtype_label = str(kv.k.dtype)
-        registry.gauge(
-            "tddl_serve_kv_bytes",
+        _metric(
+            registry.gauge, "tddl_serve_kv_bytes",
             "KV slot-pool HBM footprint (values + quant scales)",
-        ).set(float(kv.pool_bytes))
-        registry.gauge(
-            "tddl_serve_slots_total",
-            "KV slots in the pool, by storage dtype", labels=("dtype",),
-        ).set(float(max_slots), dtype=kv_dtype_label)
+            labels=self._rlabel_names,
+        ).set(float(kv.pool_bytes), **self._rlabels)
+        _metric(
+            registry.gauge, "tddl_serve_slots_total",
+            "KV slots in the pool, by storage dtype",
+            labels=("dtype",) + self._rlabel_names,
+        ).set(float(max_slots), dtype=kv_dtype_label, **self._rlabels)
         # Quantization-error histogram: per-matrix weight roundtrip
         # relative errors (weight-only int8) — empty when nothing is
         # quantized.  Buckets span the int8 regime (~1e-3 rel err).
-        self._quant_err_hist = registry.histogram(
-            "tddl_serve_quant_error",
+        self._quant_err_hist = _metric(
+            registry.histogram, "tddl_serve_quant_error",
             "Relative quantization error (weight roundtrip, per matrix)",
+            labels=self._rlabel_names,
             buckets=(1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 1.0),
         )
         if weight_dtype == "int8":
             for err in q8.weight_roundtrip_errors(base_view, cfg,
                                                   qview=view):
-                self._quant_err_hist.observe(err)
+                self._quant_err_hist.observe(err, **self._rlabels)
         # Paged-pool occupancy surface: blocks referenced (requests +
         # prefix cache), tokens in flight, and prefix-cache reuse.  The
         # gauges/counter are registered on BOTH pool layouts so every
         # serve snapshot carries them (stripe reports 0 blocks — it has
         # no block pool to occupy).
-        self._blocks_gauge = registry.gauge(
-            "tddl_serve_blocks_in_use",
+        self._blocks_gauge = _metric(
+            registry.gauge, "tddl_serve_blocks_in_use",
             "Paged-KV blocks currently referenced (requests + prefix "
             "cache); 0 on the legacy stripe pool",
+            labels=self._rlabel_names,
         )
-        self._tif_gauge = registry.gauge(
-            "tddl_serve_tokens_in_flight",
+        self._tif_gauge = _metric(
+            registry.gauge, "tddl_serve_tokens_in_flight",
             "Cached tokens currently backing live sequences",
+            labels=self._rlabel_names,
         )
-        self._prefix_counter = registry.counter(
-            "tddl_serve_prefix_hits_total",
+        self._prefix_counter = _metric(
+            registry.counter, "tddl_serve_prefix_hits_total",
             "Admissions that reused cached prefix blocks",
+            labels=self._rlabel_names,
         )
         self._prefix_hits_seen = 0
         self.peak_tokens_in_flight = 0
@@ -374,8 +470,8 @@ class ServingEngine:
         # every terminal state — placement is the scheduler's
         # attribution snapshot for admitted requests (None otherwise) —
         # so the fleet sees failures the instant they happen instead of
-        # polling ``results``.
-        self.replica_id = replica_id
+        # polling ``results``.  (``self.replica_id`` itself is set up
+        # top with the replica-labelled metric surface.)
         # Every engine trace event carries the replica index in fleet
         # mode: request ids are replica-LOCAL, so without the tag a
         # shared TraceBus cannot tell replica 0's request 3 from
@@ -385,6 +481,11 @@ class ServingEngine:
                             if replica_id is not None else {})
         self.retire_hook = retire_hook
         self.scheduler.spans = spans
+        # Performance tier (obs/compilewatch.py): the fused decode
+        # dispatch runs under the watcher's "serve_decode" guard — the
+        # compile-once pin enforced at runtime.
+        self.compilewatch = compilewatch
+        self.scheduler.compilewatch = compilewatch
         self._req_spans: Dict[int, Dict[str, int]] = {}  # rid -> open ids
         # Bounded completed-request retention: ``results`` keeps at most
         # ``retain_results`` finished records (oldest evicted first);
@@ -460,7 +561,7 @@ class ServingEngine:
             )
         if len(self._queue) >= self.queue_limit:
             self.rejected += 1
-            self._req_counter.inc(status="rejected")
+            self._req_counter.inc(status="rejected", **self._rlabels)
             return None
         request_id = self._next_id
         self._next_id += 1
@@ -511,7 +612,7 @@ class ServingEngine:
         self.results[result.request_id] = result
         while len(self.results) > self.retain_results:
             del self.results[next(iter(self.results))]
-        self._req_counter.inc(status=result.status)
+        self._req_counter.inc(status=result.status, **self._rlabels)
         if self.retire_hook is not None:
             self.retire_hook(result, placement)
 
@@ -687,20 +788,22 @@ class ServingEngine:
                 self._finish(task, request, "deadline_exceeded")
         self._tokens_emitted += emitted
         if emitted:
-            self._tok_counter.inc(emitted)
+            self._tok_counter.inc(emitted, **self._rlabels)
 
         tif = self.scheduler.tokens_in_flight
         self.peak_tokens_in_flight = max(self.peak_tokens_in_flight, tif)
         self.peak_active = max(self.peak_active,
                                self.scheduler.active_count)
-        self._tif_gauge.set(float(tif))
+        self._tif_gauge.set(float(tif), **self._rlabels)
         if self.slo is not None:
             self.slo.observe("occupancy", self.scheduler.occupancy)
         if self.paged:
-            self._blocks_gauge.set(float(self.scheduler.blocks_in_use))
+            self._blocks_gauge.set(float(self.scheduler.blocks_in_use),
+                                    **self._rlabels)
             hits = self.scheduler.prefix_hits
             if hits > self._prefix_hits_seen:
-                self._prefix_counter.inc(hits - self._prefix_hits_seen)
+                self._prefix_counter.inc(hits - self._prefix_hits_seen,
+                                         **self._rlabels)
                 self._prefix_hits_seen = hits
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
@@ -882,13 +985,13 @@ class ServingEngine:
             ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
         ), placement=placement)
         if ttft is not None:
-            self._ttft_hist.observe(ttft)
+            self._ttft_hist.observe(ttft, **self._rlabels)
             if self.slo is not None:
                 self.slo.observe("ttft_s", ttft)
             else:
                 self._ttft_est.observe(ttft)
         for dt in itl:
-            self._itl_hist.observe(dt)
+            self._itl_hist.observe(dt, **self._rlabels)
             if self.slo is not None:
                 self.slo.observe("itl_s", dt)
             else:
@@ -1022,6 +1125,17 @@ class ServingEngine:
                 out[f"{name}_p50_ms"] = float(p50 * 1e3)
                 out[f"{name}_p99_ms"] = float(p99 * 1e3)
         return out
+
+    def analyze_programs(self, ledger: Any,
+                         memory: Optional[bool] = None) -> Any:
+        """Stamp this engine's serve programs (prefill/chunk/decode)
+        into an ``obs.hbm.CostLedger`` — analyzed FLOPs and bytes per
+        program, temp allocation too when ``memory`` (or
+        ``TDDL_OBS_MEMORY_ANALYSIS=1``) is on.  Lowering-only by
+        default: no extra backend compile, safe to call after a serve
+        run on any engine."""
+        self.scheduler.analyze_costs(ledger, memory=memory)
+        return ledger
 
     def verify_attribution(self) -> "tuple[bool, list]":
         """Reconcile the attached ledger's records against the paged

@@ -285,11 +285,12 @@ class ServingFleet:
             labels=("to_state",),
         )
         # Fleet-wide occupancy aggregates, refreshed every tick.  The
-        # ENGINE serve gauges (tddl_serve_blocks_in_use, ...) are
-        # unlabelled singletons, so N replicas sharing one registry
-        # last-writer-win each other — autoscaling and dashboards must
-        # read THESE for deployment-level occupancy, and treat the
-        # tddl_serve_* gauges as "some replica's" sample under a fleet.
+        # ENGINE serve gauges (tddl_serve_blocks_in_use, ...) carry a
+        # ``replica=`` label in fleet mode (the fleet threads
+        # replica_id into every engine build), so per-replica
+        # occupancy/blocks/tokens are individually readable; THESE
+        # aggregates remain the deployment-level sums an autoscaler
+        # reads without summing label sets itself.
         self._tif_gauge = registry.gauge(
             "tddl_fleet_tokens_in_flight",
             "Cached tokens backing live sequences, summed over replicas",

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trustworthy_dl_tpu.obs.compilewatch import guarded
 from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.quant import int8 as q8
@@ -409,6 +410,10 @@ class ContinuousBatchingScheduler:
         self.tasks: Dict[int, SlotTask] = {}   # slot -> task
         self.max_seq = max_seq
         self.spans: Any = None  # optional obs.spans.SpanTracker (engine)
+        # Optional obs.compilewatch.CompileWatcher (engine): the fused
+        # decode dispatch runs under its "serve_decode" guard, so a
+        # post-warmup recompile storms at runtime, not just in pytest.
+        self.compilewatch: Any = None
 
     def attribution_info(self, task: SlotTask) -> Dict[str, Any]:
         """What the attribution ledger records about THIS task's
@@ -487,12 +492,13 @@ class ContinuousBatchingScheduler:
             keys[slot] = task.keys[len(task.emitted)]
             temps[slot] = max(task.temperature, 1e-6)
             greedy[slot] = task.greedy
-        packed, new_k, new_v, new_ks, new_vs = _programs()["decode"](
-            self.cfg, self.kv.k, self.kv.v,
-            self.kv.k_scale, self.kv.v_scale, self.view,
-            jnp.asarray(tokens), jnp.asarray(self.lengths),
-            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
-        )
+        with guarded(self.compilewatch, "serve_decode"):
+            packed, new_k, new_v, new_ks, new_vs = _programs()["decode"](
+                self.cfg, self.kv.k, self.kv.v,
+                self.kv.k_scale, self.kv.v_scale, self.view,
+                jnp.asarray(tokens), jnp.asarray(self.lengths),
+                jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
+            )
         self.kv = SlotKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         # ONE host pull for the whole tick (the cache stays on device);
         # the per-slot feed below reads the already-landed numpy rows.
@@ -543,6 +549,29 @@ class ContinuousBatchingScheduler:
         says this is 1 for the scheduler's lifetime)."""
         prog = _PROGRAMS.get("decode")
         return prog._cache_size() if prog is not None else 0
+
+    def analyze_costs(self, ledger: Any,
+                      memory: Optional[bool] = None) -> None:
+        """Stamp this engine's serve programs into an obs.hbm.CostLedger
+        (lowering-only by default — no extra backend compile)."""
+        kv = self.kv
+        ms = self.allocator.max_slots
+        bucket = max(self.buckets)
+        prog = _programs()
+        pool = (kv.k, kv.v, kv.k_scale, kv.v_scale)
+        ledger.analyze(
+            "serve.prefill", prog["prefill"], self.cfg, *pool, self.view,
+            jnp.zeros(bucket, jnp.int32), jnp.asarray(1, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.zeros(2, jnp.uint32),
+            jnp.asarray(1.0, jnp.float32), jnp.asarray(True),
+            memory=memory,
+        )
+        ledger.analyze(
+            "serve.decode", prog["decode"], self.cfg, *pool, self.view,
+            jnp.zeros(ms, jnp.int32), jnp.asarray(self.lengths),
+            jnp.zeros((ms, 2), jnp.uint32), jnp.ones(ms, jnp.float32),
+            jnp.ones(ms, bool), memory=memory,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -647,6 +676,9 @@ class PagedBatchingScheduler:
         # AFTER retire() has already cleared the live table.
         self._attrib: Dict[int, Dict[str, Any]] = {}
         self.spans: Any = None  # optional obs.spans.SpanTracker (engine)
+        # Optional obs.compilewatch.CompileWatcher (engine) — the fused
+        # paged decode dispatch runs under its "serve_decode" guard.
+        self.compilewatch: Any = None
         # slot -> block ids the slot's request PUBLISHED to the prefix
         # cache (newly cached at its prefill completion) — what a
         # quarantine-retire must purge from the cache.
@@ -866,12 +898,16 @@ class PagedBatchingScheduler:
             greedy[slot] = task.greedy
             tables[slot] = self._table_row(slot)
         kv = self.kv
-        packed, new_k, new_v, new_ks, new_vs = _programs()["paged_decode"](
-            self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale, self.view,
-            jnp.asarray(tokens), jnp.asarray(tables),
-            jnp.asarray(self.lengths),
-            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(greedy),
-        )
+        with guarded(self.compilewatch, "serve_decode"):
+            packed, new_k, new_v, new_ks, new_vs = \
+                _programs()["paged_decode"](
+                    self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale,
+                    self.view,
+                    jnp.asarray(tokens), jnp.asarray(tables),
+                    jnp.asarray(self.lengths),
+                    jnp.asarray(keys), jnp.asarray(temps),
+                    jnp.asarray(greedy),
+                )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         host = np.asarray(packed)
         next_tok, ent, margin = host[0], host[1], host[2]
@@ -940,3 +976,38 @@ class PagedBatchingScheduler:
         pin: block-table churn must keep this at 1)."""
         prog = _PROGRAMS.get("paged_decode")
         return prog._cache_size() if prog is not None else 0
+
+    def analyze_costs(self, ledger: Any,
+                      memory: Optional[bool] = None) -> None:
+        """Stamp the paged serve programs into an obs.hbm.CostLedger
+        (lowering-only by default — no extra backend compile)."""
+        kv = self.kv
+        ms = self.allocator.max_slots
+        c = self.chunk
+        bsz = self.block_size
+        prog = _programs()
+        pool = (kv.k, kv.v, kv.k_scale, kv.v_scale)
+        ledger.analyze(
+            "serve.paged_prefill", prog["paged_prefill"], self.cfg,
+            *pool, self.view, jnp.zeros(c, jnp.int32),
+            jnp.asarray(1, jnp.int32),
+            jnp.zeros(c // bsz, jnp.int32), jnp.zeros(2, jnp.uint32),
+            jnp.asarray(1.0, jnp.float32), jnp.asarray(True),
+            memory=memory,
+        )
+        ledger.analyze(
+            "serve.paged_chunk", prog["paged_chunk"], self.cfg,
+            *pool, self.view, jnp.zeros(c, jnp.int32),
+            jnp.zeros((1, self.nbps), jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.zeros(2, jnp.uint32), jnp.asarray(1.0, jnp.float32),
+            jnp.asarray(True), memory=memory,
+        )
+        ledger.analyze(
+            "serve.paged_decode", prog["paged_decode"], self.cfg,
+            *pool, self.view, jnp.zeros(ms, jnp.int32),
+            jnp.zeros((ms, self.nbps), jnp.int32),
+            jnp.asarray(self.lengths), jnp.zeros((ms, 2), jnp.uint32),
+            jnp.ones(ms, jnp.float32), jnp.ones(ms, bool),
+            memory=memory,
+        )

@@ -34,6 +34,13 @@ _NON_METRIC_KEYS = frozenset({"timestamp", "step", "epoch", "request_id"})
 _KEY_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+class _NullHist:
+    """No-op histogram stand-in after a registration conflict."""
+
+    def observe(self, *a: Any, **kw: Any) -> None:
+        pass
+
+
 def _make_tb_writer(logdir: str):
     try:
         from torch.utils.tensorboard import SummaryWriter
@@ -50,7 +57,14 @@ class MetricsCollector:
 
     def __init__(self, max_records: int = 100_000,
                  tensorboard_dir: Optional[str] = None,
-                 registry: Any = None, namespace: str = "train"):
+                 registry: Any = None, namespace: str = "train",
+                 labels: Optional[Dict[str, str]] = None):
+        # ``labels``: constant label set stamped on every registry
+        # series this collector produces (the serving fleet passes
+        # ``{"replica": i}`` so N replicas' occupancy/queue/token gauges
+        # are individually readable instead of last-writer-winning one
+        # unlabelled singleton).
+        self._const_labels = {k: str(v) for k, v in (labels or {}).items()}
         self.max_records = max_records
         self.batch_metrics: List[Dict[str, Any]] = []
         self.epoch_metrics: List[Dict[str, Any]] = []
@@ -74,10 +88,20 @@ class MetricsCollector:
         are not contaminated by the process-wide default registry)."""
         self._registry = registry
         self._gauges: Dict[str, Any] = {}
-        self._tick_hist = registry.histogram(
-            f"tddl_{self._ns}_step_time_seconds",
-            "step/iteration wall time",
-        )
+        const = tuple(self._const_labels)
+        try:
+            self._tick_hist = registry.histogram(
+                f"tddl_{self._ns}_step_time_seconds",
+                "step/iteration wall time", labels=const,
+            )
+        except ValueError:
+            # Label-shape clash (an unlabelled collector registered the
+            # series before a replica-labelled one, or vice versa):
+            # degrade this collector's export, keep the record lists.
+            logger.debug("metrics: registry rejected "
+                         "tddl_%s_step_time_seconds%s", self._ns, const,
+                         exc_info=True)
+            self._tick_hist = _NullHist()
 
     def _registry_gauge(self, key: str, value: Any,
                         node: Optional[Any] = None) -> None:
@@ -86,14 +110,15 @@ class MetricsCollector:
         gauge = self._gauges.get(cache_key)
         try:
             if gauge is None:
-                gauge = self._registry.gauge(
-                    name, labels=("node",) if node is not None else ()
-                )
+                labels = tuple(self._const_labels)
+                if node is not None:
+                    labels = ("node",) + labels
+                gauge = self._registry.gauge(name, labels=labels)
                 self._gauges[cache_key] = gauge
             if node is not None:
-                gauge.set(float(value), node=node)
+                gauge.set(float(value), node=node, **self._const_labels)
             else:
-                gauge.set(float(value))
+                gauge.set(float(value), **self._const_labels)
         except ValueError:
             # Name/kind collision or cardinality bound: the record list
             # is the source of truth — never let export kill training.
@@ -154,7 +179,7 @@ class MetricsCollector:
         if self._last_tick is not None:
             dt = now - self._last_tick
             self._step_times.append(dt)
-            self._tick_hist.observe(dt)
+            self._tick_hist.observe(dt, **self._const_labels)
         self._last_tick = now
 
     def step_time_stats(self) -> Dict[str, float]:
