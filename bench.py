@@ -26,7 +26,11 @@ long-context A/B), TDDL_BENCH_GEN=1 (decode), TDDL_BENCH_SERVE=1
 (continuous-batching offered-load sweep + paged-vs-stripe KV A/B at
 equal HBM: concurrent-request capacity ratio, tokens-in-flight
 occupancy, prefix-cache hit rate — "serve_paged" record key,
-TDDL_BENCH_PAGED_* knobs), TDDL_BENCH_CHAOS=1 (seeded
+TDDL_BENCH_PAGED_* knobs; TDDL_BENCH_SPEC=1 rides it and adds the
+speculative-decode A/B — spec off vs spec_k ∈ {2,4} over identical
+seeded traffic, accepted_rate + draft/verify tick fractions +
+tokens/s per arm, "spec" record key whose accepted_rate feeds the
+sentinel fingerprint, TDDL_BENCH_SPEC_* knobs), TDDL_BENCH_CHAOS=1 (seeded
 chaos survival sweep through the self-healing supervisor),
 TDDL_BENCH_ASYNC=1 (async host-pipeline A/B: trainer loop at
 async_host_depth 0 vs default, tokens/sec + obs phase shares),
@@ -211,6 +215,10 @@ def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
         compile_total=(record.get("compile") or {}).get("total"),
         compile_seconds=(record.get("compile") or {}).get("seconds"),
         hbm_watermark_bytes=sweep["watermark_bytes"] or None,
+        # Speculative-decode draft quality (TDDL_BENCH_SPEC rounds):
+        # rides the fingerprint so the sentinel bands it (direction
+        # higher-is-better) like any perf metric.
+        accepted_rate=(record.get("spec") or {}).get("accepted_rate"),
         run_metadata=record.get("run_metadata"),
         extra={"vs_baseline": record.get("vs_baseline")},
     )
@@ -492,6 +500,73 @@ def bench_longctx() -> None:
                     f"{type(exc).__name__}: {str(exc)[:120]}")
 
 
+def _drive_serve_open_loop(engine, workload) -> int:
+    """Drive seeded ``(t_arrive, request)`` pairs through an engine
+    open-loop (arrivals honoured against the wall clock, so queueing
+    delay is real) — the ONE spelling of the serve-bench driver, shared
+    by the offered-load sweep and the speculative-decode A/B so their
+    rows measure the same thing.  Returns how many requests were shed."""
+    t0 = time.perf_counter()
+    pending = list(workload)
+    shed = 0
+    while pending or engine.busy:
+        # A slot is only quarantined at retirement, so zero capacity
+        # implies nothing is in flight either.
+        if engine.in_service_capacity == 0:
+            # Every slot quarantined mid-bench: nothing queued or
+            # pending can ever be served — shed the remainder rather
+            # than spin until the watchdog kills the whole body
+            # (run_until_idle has the same guard).
+            shed += len(pending)
+            pending.clear()
+            engine.run_until_idle()  # records queued as no_capacity
+            break
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, req = pending.pop(0)
+            if engine.submit(req) is None:
+                shed += 1
+        if not engine.busy and pending:
+            # Idle gap before the next arrival: sleep instead of
+            # spinning step() — empty iterations would pile metrics
+            # bookkeeping onto the numbers this sweep reports.
+            time.sleep(min(max(pending[0][0] - now, 0.0), 0.05))
+            continue
+        engine.step()
+    return shed
+
+
+def _serve_sweep_row(engine, watcher, rate, shed) -> dict:
+    """The serve-bench record row (throughput/latency/SLO keys) — one
+    builder, so every arm that claims "today's serve record shape"
+    really has it."""
+    summary = engine.metrics_summary()
+    status = watcher.status()
+    return {
+        "offered_rps": rate,
+        "tokens_per_s": round(summary["tokens_per_s"], 1),
+        "itl_p50_ms": round(summary.get("itl_p50_ms", 0.0), 3),
+        "itl_p99_ms": round(summary.get("itl_p99_ms", 0.0), 3),
+        "ttft_p50_ms": round(summary.get("ttft_p50_ms", 0.0), 3),
+        "completed": summary["requests_completed"],
+        "shed": shed,
+        "slo": {
+            "rules": [{"name": r["name"], "target": r["target"],
+                       "burn_rate": round(r["burn_rate"], 4),
+                       "active": r["active"]}
+                      for r in status["rules"]],
+            "breach_total": status["breach_total"],
+            "shed_slo": summary.get("requests_shed_slo", 0),
+            "ttft_s": {k: round(v, 6) if isinstance(v, float) else v
+                       for k, v in watcher.percentiles(
+                           "ttft_s").items()},
+            "itl_s": {k: round(v, 6) if isinstance(v, float) else v
+                      for k, v in watcher.percentiles(
+                          "itl_s").items()},
+        },
+    }
+
+
 def bench_serve() -> "list[dict]":
     """Serving-engine leg (TDDL_BENCH_SERVE=1): offered-load sweep over the
     continuous-batching engine (serve/) — tokens/s, p50/p99 inter-token
@@ -552,58 +627,8 @@ def bench_serve() -> "list[dict]":
                                                 max_new + 1)),
                 temperature=0.8,
             )))
-        t0 = time.perf_counter()
-        pending = list(workload)
-        shed = 0
-        while pending or engine.busy:
-            # A slot is only quarantined at retirement, so zero capacity
-            # implies nothing is in flight either.
-            if engine.in_service_capacity == 0:
-                # Every slot quarantined mid-bench: nothing queued or
-                # pending can ever be served — shed the remainder rather
-                # than spin until the watchdog kills the whole body
-                # (run_until_idle has the same guard).
-                shed += len(pending)
-                pending.clear()
-                engine.run_until_idle()  # records queued as no_capacity
-                break
-            now = time.perf_counter() - t0
-            while pending and pending[0][0] <= now:
-                _, req = pending.pop(0)
-                if engine.submit(req) is None:
-                    shed += 1
-            if not engine.busy and pending:
-                # Idle gap before the next arrival: sleep instead of
-                # spinning step() — empty iterations would pile metrics
-                # bookkeeping onto the numbers this sweep reports.
-                time.sleep(min(max(pending[0][0] - now, 0.0), 0.05))
-                continue
-            engine.step()
-        summary = engine.metrics_summary()
-        status = watcher.status()
-        row = {
-            "offered_rps": rate,
-            "tokens_per_s": round(summary["tokens_per_s"], 1),
-            "itl_p50_ms": round(summary.get("itl_p50_ms", 0.0), 3),
-            "itl_p99_ms": round(summary.get("itl_p99_ms", 0.0), 3),
-            "ttft_p50_ms": round(summary.get("ttft_p50_ms", 0.0), 3),
-            "completed": summary["requests_completed"],
-            "shed": shed,
-            "slo": {
-                "rules": [{"name": r["name"], "target": r["target"],
-                           "burn_rate": round(r["burn_rate"], 4),
-                           "active": r["active"]}
-                          for r in status["rules"]],
-                "breach_total": status["breach_total"],
-                "shed_slo": summary.get("requests_shed_slo", 0),
-                "ttft_s": {k: round(v, 6) if isinstance(v, float) else v
-                           for k, v in watcher.percentiles(
-                               "ttft_s").items()},
-                "itl_s": {k: round(v, 6) if isinstance(v, float) else v
-                          for k, v in watcher.percentiles(
-                              "itl_s").items()},
-            },
-        }
+        shed = _drive_serve_open_loop(engine, workload)
+        row = _serve_sweep_row(engine, watcher, rate, shed)
         log(f"serve offered={rate:6.1f} req/s: "
             f"{row['tokens_per_s']:8.1f} tok/s, ITL p50 "
             f"{row['itl_p50_ms']:.2f} ms / p99 {row['itl_p99_ms']:.2f} ms, "
@@ -753,6 +778,103 @@ def bench_paged() -> "dict":
         f"({budget / 1e6:.1f} MB), prefix hit rate "
         f"{record['prefix']['hit_rate']} "
         f"({record['prefix']['tokens_reused']} tokens reused)")
+    return record
+
+
+def bench_spec() -> "dict":
+    """Speculative-decode A/B (TDDL_BENCH_SPEC=1, riding
+    TDDL_BENCH_SERVE=1): the SAME seeded open-loop workload through a
+    spec-off arm and spec_k ∈ {2, 4} arms of the paged engine.  The off
+    arm's row is built by the exact same helpers as the offered-load
+    sweep — today's serve record shape, key for key — so the contract
+    test can pin that enabling spec never mutates the baseline record;
+    the spec arms add a "spec" block: accepted_rate (drafted tokens the
+    model-dtype verify kept), draft/verify tick fractions, near-tie
+    flips, and the end-to-end tokens/s already in the shared row.
+    Greedy workload: acceptance is then the pure int8-draft-vs-target
+    agreement the sentinel fingerprint tracks."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.obs.slo import SLOWatcher, default_serve_rules
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_SERVE_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    max_slots = int(os.environ.get("TDDL_BENCH_SPEC_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_SPEC_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_SPEC_REQUESTS", "16"))
+    max_new = int(os.environ.get("TDDL_BENCH_SPEC_NEW", "32"))
+    rate = float(os.environ.get("TDDL_BENCH_SPEC_RATE", "64"))
+    ks = [int(x) for x in os.environ.get("TDDL_BENCH_SPEC_KS",
+                                         "2,4").split(",")]
+    plen_hi = min(64, max_seq - max_new + 1)
+    if plen_hi <= 8:
+        raise ValueError(
+            f"TDDL_BENCH_SPEC_SEQ={max_seq} leaves no room for prompts "
+            f">= 8 tokens at TDDL_BENCH_SPEC_NEW={max_new}"
+        )
+
+    def build_workload():
+        # Re-seeded per arm: every arm serves an IDENTICAL request
+        # sequence, so tokens/s differences are the spec tier's alone.
+        rng = np.random.default_rng(17)
+        workload = []
+        t_arrive = 0.0
+        for _ in range(n_requests):
+            t_arrive += rng.exponential(1.0 / rate)
+            plen = int(rng.integers(8, plen_hi))
+            workload.append((t_arrive, ServeRequest(
+                prompt=rng.integers(0, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=int(rng.integers(min(4, max_new),
+                                                max_new + 1)),
+                temperature=0.0,
+            )))
+        return workload
+
+    record: dict = {"arms": {}, "offered_rps": rate}
+    for label, spec_k in [("off", 0)] + [(f"k{k}", k) for k in ks]:
+        watcher = SLOWatcher(default_serve_rules())
+        engine = ServingEngine(params, cfg, max_slots=max_slots,
+                               max_seq=max_seq, queue_limit=n_requests,
+                               rng=jax.random.PRNGKey(1), slo=watcher,
+                               spec_k=spec_k)
+        shed = _drive_serve_open_loop(engine, build_workload())
+        row = _serve_sweep_row(engine, watcher, rate, shed)
+        if spec_k:
+            sched = engine.scheduler
+            wall = max(sched.spec_draft_s + sched.spec_verify_s, 1e-9)
+            summary = engine.metrics_summary()
+            row["spec"] = {
+                "spec_k": spec_k,
+                "proposed": summary["spec_proposed"],
+                "accepted": summary["spec_accepted"],
+                "accepted_rate": summary["accepted_rate"],
+                "near_tie_flips": summary["spec_near_tie_flips"],
+                "spec_ticks": summary["spec_ticks"],
+                "fallback_ticks": summary["spec_fallback_ticks"],
+                # Fractions of the spec-phase wall (host-observed; the
+                # draft chain syncs at its token pull, the verify at
+                # the packed pull) — where a tick's time actually goes.
+                "draft_frac": round(sched.spec_draft_s / wall, 4),
+                "verify_frac": round(sched.spec_verify_s / wall, 4),
+            }
+            log(f"spec k={spec_k}: {row['tokens_per_s']:8.1f} tok/s, "
+                f"accepted_rate {row['spec']['accepted_rate']:.3f} "
+                f"(draft {row['spec']['draft_frac']:.0%} / verify "
+                f"{row['spec']['verify_frac']:.0%} of spec time)")
+        else:
+            log(f"spec off:  {row['tokens_per_s']:8.1f} tok/s (baseline)")
+        record["arms"][label] = row
+    best = f"k{max(ks)}"
+    record["accepted_rate"] = \
+        record["arms"][best]["spec"]["accepted_rate"]
+    off_tps = record["arms"]["off"]["tokens_per_s"]
+    record["tokens_per_s_ratio"] = round(
+        record["arms"][best]["tokens_per_s"] / max(off_tps, 1e-9), 3)
     return record
 
 
@@ -1490,9 +1612,12 @@ def _inner_main() -> None:
         bench_generate()
     serve_records = None
     paged_record = None
+    spec_record = None
     if os.environ.get("TDDL_BENCH_SERVE") == "1":
         serve_records = bench_serve()
         paged_record = bench_paged()
+        if os.environ.get("TDDL_BENCH_SPEC") == "1":
+            spec_record = bench_spec()
     fleet_record = None
     if os.environ.get("TDDL_BENCH_FLEET") == "1":
         fleet_record = bench_fleet()
@@ -1521,6 +1646,11 @@ def _inner_main() -> None:
         "mfu": mfu,
         "run_metadata": meta,
     }
+    if spec_record is not None:
+        # Attached BEFORE the perf sections: the sentinel fingerprint
+        # lifts accepted_rate from it, so draft-quality regressions
+        # band-check (and page) exactly like throughput regressions.
+        record["spec"] = spec_record
     _attach_perf_sections(record, compiles=compiles, hbm=hbm_monitor)
     if serve_records is not None:
         record["serve"] = serve_records

@@ -215,6 +215,55 @@ def test_bench_paged_ab_records(monkeypatch):
     assert prefix["completed"] == 6
 
 
+def test_bench_spec_ab_records(monkeypatch):
+    """bench_spec's spec-off vs spec_k A/B on a tiny model: the off arm
+    carries EXACTLY today's serve-sweep record shape (enabling the spec
+    leg must not mutate the baseline contract), every arm serves the
+    identical seeded workload to completion, and the spec arms report
+    accepted_rate + draft/verify tick fractions; the record's top-level
+    accepted_rate is what the sentinel fingerprint lifts."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_SPEC_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_SPEC_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_SPEC_REQUESTS", "5")
+    monkeypatch.setenv("TDDL_BENCH_SPEC_NEW", "6")
+    monkeypatch.setenv("TDDL_BENCH_SPEC_RATE", "100")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_REQUESTS", "5")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_NEW", "4")
+    monkeypatch.setenv("TDDL_BENCH_SERVE_RATES", "100")
+    record = bench.bench_spec()
+    assert set(record["arms"]) == {"off", "k2", "k4"}
+    off = record["arms"]["off"]
+    # The off arm IS today's serve record shape, key for key.
+    serve_row = bench.bench_serve()[0]
+    assert set(off) == set(serve_row)
+    for label in ("off", "k2", "k4"):
+        row = record["arms"][label]
+        assert row["completed"] + row["shed"] == 5
+        assert row["tokens_per_s"] > 0
+    assert record["arms"]["k2"]["completed"] == off["completed"]
+    for label in ("k2", "k4"):
+        spec = record["arms"][label]["spec"]
+        assert spec["proposed"] > 0
+        assert 0.0 <= spec["accepted_rate"] <= 1.0
+        assert spec["accepted"] <= spec["proposed"]
+        assert abs(spec["draft_frac"] + spec["verify_frac"] - 1.0) < 1e-3
+    assert record["accepted_rate"] \
+        == record["arms"]["k4"]["spec"]["accepted_rate"]
+    assert record["tokens_per_s_ratio"] > 0
+
+
 def test_bench_quant_ab_records(monkeypatch):
     """bench_quant's equal-HBM A/B on a tiny model: the int8 arm admits
     >= 1.5x slots inside the baseline pool's byte budget, serves the

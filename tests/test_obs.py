@@ -883,6 +883,34 @@ def test_perf_tier_events_and_metrics_inside_the_lint_perimeter():
         (obs / "sentinel.py").read_text()
 
 
+def test_spec_surface_inside_the_lint_perimeter():
+    """Speculative-decoding extension: the spec counters are literal
+    ``tddl_`` names the metric-name lint scans, registered through the
+    same ``_metric`` replica-label surface as the rest of the
+    tddl_serve_* family (fleet mode labels them ``replica=``), and the
+    per-tick verify span rides the schema-typed ``span`` event under
+    the existing serve span namespace."""
+    import re
+
+    engine_src = (REPO / "trustworthy_dl_tpu" / "serve"
+                  / "engine.py").read_text()
+    for name in ("tddl_serve_spec_proposed_total",
+                 "tddl_serve_spec_accepted_total"):
+        assert f'"{name}"' in engine_src, name
+        # Replica labels in fleet mode: the registration passes the
+        # engine's replica label-name tuple, like every serve metric.
+        pattern = re.compile(
+            rf'"{name}",.*?labels=self\._rlabel_names', re.DOTALL)
+        assert pattern.search(engine_src), f"{name} not replica-labelled"
+    sched_src = (REPO / "trustworthy_dl_tpu" / "serve"
+                 / "scheduler.py").read_text()
+    assert '"serve.spec_verify"' in sched_src
+    # Spans are schema-typed events — the verify span carries the span
+    # schema's required fields via SpanTracker like every other span.
+    assert EVENT_SCHEMAS[EventType.SPAN]["fields"] == \
+        ("name", "kind", "span_id", "duration_s")
+
+
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
     (counter/gauge/histogram) starts with ``tddl_`` — the naming

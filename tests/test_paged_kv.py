@@ -37,7 +37,7 @@ from trustworthy_dl_tpu.serve import (
     kv_bytes_per_token,
     paged_pool_blocks,
 )
-from trustworthy_dl_tpu.serve.kv_slots import TRASH_BLOCK
+from trustworthy_dl_tpu.serve.kv_slots import TRASH_BLOCK, blocks_for_span
 from trustworthy_dl_tpu.serve.scheduler import SlotTask, request_key_stream
 
 pytestmark = pytest.mark.paged
@@ -161,6 +161,78 @@ def test_out_of_blocks_backpressure_leaks_nothing(params):
     # Oversized requests stay a loud error, not backpressure.
     with pytest.raises(ValueError, match="exceeds max_seq"):
         sched.admit(_task(3, list(range(14)), 4))
+
+
+def test_spec_claims_span_block_boundary_and_rollback():
+    """Speculative-claim COW edge case 1 (rejected draft tokens
+    spanning a block boundary): the claim set covers every DISTINCT
+    block the draft window touches — the partially-filled current block
+    and the next one — excluding trash padding and positions past the
+    table; rollback (release_speculative) restores every refcount, and
+    releasing a claim that was never taken stays a loud double-free."""
+    table = [3, 7, 5]
+    # Window [6, 11) with block_size 4 crosses the 7→5 boundary.
+    assert blocks_for_span(table, 4, 6, 11) == [7, 5]
+    assert blocks_for_span(table, 4, 10, 14) == [5]   # past table: trash
+    assert blocks_for_span(table, 4, 12, 15) == []    # fully past
+    assert blocks_for_span([TRASH_BLOCK, 7], 4, 0, 8) == [7]
+    alloc = BlockAllocator(8)
+    a, b = alloc.alloc(2)
+    claimed = [a, b]
+    alloc.claim_speculative(claimed)
+    assert alloc.refcount(a) == 2 and alloc.refcount(b) == 2
+    alloc.release_speculative(claimed)                # THE rollback
+    assert alloc.refcount(a) == 1 and alloc.refcount(b) == 1
+    assert alloc.free_count == 6                      # nothing freed
+    alloc.release(a)
+    with pytest.raises(ValueError):
+        alloc.release(a)                              # still loud
+
+
+def test_spec_rollback_spares_published_prefix_block():
+    """Edge case 2 (rollback of a block the prefix cache just
+    published): a draft window overlapping a cache-published block only
+    ever drops ITS OWN claim — the cache's reference and the owning
+    table's reference survive, and the prefix stays servable."""
+    blocks = BlockAllocator(8)
+    ids = blocks.alloc(2)
+    cache = PrefixCache(4, blocks)
+    tokens = list(range(60, 68))
+    cache.insert(tokens, ids)                 # publish: rc 2 each
+    blocks.claim_speculative([ids[1]])        # draft window touches it
+    assert blocks.refcount(ids[1]) == 3
+    blocks.release_speculative([ids[1]])      # reject: refcount decrement
+    assert blocks.refcount(ids[1]) == 2       # table + cache intact
+    held = cache.lookup(tokens, 1)            # prefix still served
+    assert held == ids[:1]
+    blocks.release(held[0])
+
+
+def test_quarantine_retire_purges_slot_with_unverified_draft_claims(params):
+    """Edge case 3 (quarantine-at-retire with un-verified draft
+    blocks): a flagged slot retiring while speculative claims are still
+    outstanding — the abort path — must unwind the claims FIRST, or the
+    table release would see the claimed block as 'shared' and FREE the
+    suspect KV back into the pool instead of impounding it."""
+    sched = PagedBatchingScheduler(params, CFG, max_slots=2, max_seq=16,
+                                   block_size=4, num_blocks=8,
+                                   prefix_cache=False)
+    t = _task(0, [1, 2, 3, 4, 5, 6], 8)       # 14 tokens -> 4 blocks
+    assert sched.admit(t)
+    table = list(sched.tables[t.slot])
+    # Simulate a tick aborted between claim and release: the draft
+    # window's blocks carry live speculative refs at retire time.
+    claimed = blocks_for_span(table, 4, 6, 9)
+    sched.blocks.claim_speculative(claimed)
+    sched._spec_claims[t.slot] = claimed
+    sched.retire(t, quarantine=True)
+    # Every block impounded — the claimed ones included — none freed.
+    assert sched.blocks.quarantined == set(table)
+    assert sched.blocks.free_count == 4
+    assert not sched._spec_claims
+    assert all(sched.blocks.refcount(b) == 0 for b in table)
+    sched.release_quarantine(t.slot)
+    assert sched.blocks.free_count == 8 and sched.blocks.in_use == 0
 
 
 def test_prefix_cache_insert_lookup_refcounts():

@@ -408,6 +408,34 @@ def test_sentinel_noise_band_verdicts(tmp_path):
 
 
 @perfwatch
+def test_sentinel_accepted_rate_pages_like_perf(tmp_path):
+    """PR 11 extension: ``accepted_rate`` (speculative draft quality)
+    is a sentinel metric with direction higher-is-better — a draft that
+    stops matching the target pages exactly like a tokens/s regression
+    — and the obs diff renders it."""
+    from trustworthy_dl_tpu.obs.sentinel import (
+        SENTINEL_METRICS,
+        load_perf_artifact,
+        render_diff,
+    )
+
+    assert SENTINEL_METRICS["accepted_rate"] == "higher"
+    ledger = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    for _ in range(3):
+        ledger.append(_fp(100.0, accepted_rate=0.9))
+    sentinel = PerfSentinel(ledger)
+    assert not sentinel.check(_fp(100.0, accepted_rate=0.88))["regressed"]
+    verdict = sentinel.check(_fp(100.0, accepted_rate=0.4))
+    check = next(c for c in verdict["checks"]
+                 if c["metric"] == "accepted_rate")
+    assert verdict["regressed"] and check["regressed"]
+    assert check["direction"] == "higher"
+    # `trustworthy-dl-obs diff` renders the fingerprint's rate.
+    view = load_perf_artifact(str(tmp_path / "ledger.jsonl"))
+    assert "accepted_rate" in render_diff(view, view)
+
+
+@perfwatch
 def test_session_finalize_appends_fingerprint_and_checks(tmp_path):
     """ObsSession.finalize() runs the sentinel against the rolling
     ledger and appends this run's fingerprint (verdict stamped)."""

@@ -410,6 +410,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "tick (multiple of --block-size; default "
                              "auto) — bounds how long one admission can "
                              "stall the fused decode step")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative decoding draft depth: per "
+                             "decode tick draft this many tokens per "
+                             "active slot with the int8 weight tier "
+                             "(built automatically as the draft model) "
+                             "and verify them in ONE batched "
+                             "model-dtype forward — streams stay "
+                             "bit-identical to spec off except where a "
+                             "greedy near-tie (top-1 margin under the "
+                             "int8 parity tolerance) lets a draft flip "
+                             "through, counted in spec_near_tie_flips; "
+                             "rejected draft KV rolls back by COW "
+                             "refcount decrement. "
+                             "0 disables (default).  Requires the paged "
+                             "pool and weight-dtype 'model'; README "
+                             "§Serving/'Speculative decoding'")
+    parser.add_argument("--no-spec-decode", action="store_true",
+                        help="force speculative decoding OFF even when "
+                             "--spec-k is set (A/B escape hatch; fleet "
+                             "replica restarts inherit whichever the "
+                             "config resolved to)")
     parser.add_argument("--kv-dtype", type=str, default="model",
                         choices=["model", "bfloat16", "float32", "int8"],
                         help="KV slot-pool storage dtype; int8 stores "
@@ -489,6 +510,14 @@ def serve_main(argv: Optional[List[str]] = None,
     if not args.model.startswith("gpt") or args.model.endswith("-moe"):
         print("serving supports the dense GPT-2 family")
         return 2
+    spec_k = 0 if args.no_spec_decode else args.spec_k
+    if spec_k > args.max_new_tokens:
+        # A draft deeper than the longest possible stream can never be
+        # accepted past the budget — loud operator error, not silence.
+        print(f"--spec-k {spec_k} exceeds --max-new-tokens "
+              f"{args.max_new_tokens}: every draft past the request "
+              "budget is discarded; lower --spec-k")
+        return 2
     # Construction-time validation of the serving knobs (loud, before any
     # model init) — the dtype strings fail here, never at trace time.
     serve_config = ServeConfig(
@@ -499,6 +528,7 @@ def serve_main(argv: Optional[List[str]] = None,
         num_blocks=args.num_blocks,
         prefix_cache=not args.no_prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        spec_k=spec_k,
     )
     if args.compile_cache:
         import os
@@ -612,7 +642,9 @@ def serve_main(argv: Optional[List[str]] = None,
                 "requests_flagged", "tokens_emitted", "tokens_per_s",
                 "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms",
                 "peak_tokens_in_flight", "blocks_in_use",
-                "prefix_hits", "prefix_hit_rate"):
+                "prefix_hits", "prefix_hit_rate",
+                "spec_k", "spec_proposed", "spec_accepted",
+                "accepted_rate", "spec_near_tie_flips"):
         if key in summary:
             value = summary[key]
             shown = f"{value:.3f}" if isinstance(value, float) else value

@@ -26,6 +26,49 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 #  - "hybrid":   explicit mesh_shape dict combining several axes
 PARALLELISM_MODES = ("data", "model", "tensor", "sequence", "expert", "hybrid")
 
+#: Largest accepted ``ServeConfig.spec_k`` — the speculative draft depth
+#: is a compile-time shape (one fixed-[R, k+1] verify program per
+#: engine lifetime); acceptance rates past a handful of tokens decay
+#: geometrically, so a deeper draft only burns verify FLOPs.
+SPEC_K_MAX = 8
+
+
+def validate_spec(spec_k: int, paged: bool, weight_dtype: str) -> None:
+    """Loud construction-time validation of the speculative-decoding
+    knob — shared by ``ServeConfig`` and the serving engine so a bad
+    combination fails where the operator typed it.
+
+    * ``spec_k`` must sit in [0, SPEC_K_MAX] (0 = disabled — the
+      serve path is bit-for-bit today's).
+    * spec decoding runs over the PAGED pool only: rejected draft KV
+      rolls back by COW refcount decrement, which the legacy stripe
+      pool has no machinery for.
+    * the draft model IS the weight-only int8 tier, built automatically
+      at engine construction; the verify pass is the MODEL-dtype tier —
+      ``weight_dtype="int8"`` would collapse draft and verify onto the
+      same weights (no cheap draft left, and fallback ticks would emit
+      int8-decoded tokens inside a model-dtype-verified stream), so it
+      is rejected loudly.
+    """
+    if not 0 <= int(spec_k) <= SPEC_K_MAX:
+        raise ValueError(
+            f"spec_k must be in [0, {SPEC_K_MAX}], got {spec_k}"
+        )
+    if spec_k > 0 and not paged:
+        raise ValueError(
+            "spec_k > 0 requires the paged KV pool (paged=True): "
+            "rejected draft tokens roll back by releasing COW block "
+            "claims, which the legacy stripe pool cannot express"
+        )
+    if spec_k > 0 and weight_dtype != "model":
+        raise ValueError(
+            f"spec_k > 0 requires weight_dtype='model' (got "
+            f"{weight_dtype!r}): the int8 weight tier is the DRAFT — "
+            "it is built automatically — and the verify pass must be "
+            "the model-dtype tier, or draft and verify would share one "
+            "set of weights"
+        )
+
 
 @dataclass
 class NodeConfig:
@@ -319,6 +362,19 @@ class ServeConfig:
     * ``prefill_chunk``: positions fed per chunked-prefill tick (a
       multiple of ``block_size``); ``None`` auto-sizes.
 
+    ``spec_k`` enables self-speculative decoding (README §Serving
+    /"Speculative decoding"): per decode tick the engine drafts
+    ``spec_k`` tokens per active slot with the int8 weight tier (built
+    automatically as the draft model), verifies them all in ONE batched
+    model-dtype forward over the same paged cache, accepts the longest
+    draft/target-matching prefix (a greedy near-tie flip under the
+    parity-probe margin is tolerated and emits the DRAFT token — the
+    one counted departure from spec-off bit-parity), and rolls back
+    rejected draft KV by COW refcount decrement.  0 (default) disables
+    — the serve path is bit-for-bit today's; ``spec_k`` > 0 requires
+    ``paged=True`` and ``weight_dtype="model"``
+    (:func:`validate_spec`).
+
     Unknown dtype strings and bad paged geometry fail HERE, at
     construction — never at trace time inside a jitted serving program.
     Paged knobs set on a ``paged=False`` config WARN loudly (the legacy
@@ -336,12 +392,14 @@ class ServeConfig:
     num_blocks: Optional[int] = None
     prefix_cache: bool = True
     prefill_chunk: Optional[int] = None
+    spec_k: int = 0
 
     def __post_init__(self) -> None:
         from trustworthy_dl_tpu.quant import validate_dtypes
         from trustworthy_dl_tpu.serve.kv_slots import validate_paged_geometry
 
         validate_dtypes(self.kv_dtype, self.weight_dtype)
+        validate_spec(self.spec_k, self.paged, self.weight_dtype)
         if self.max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
         if self.max_seq < 1:

@@ -279,6 +279,21 @@ def _final_logits(params: Params, x: jax.Array, cfg: gpt2.GPT2Config,
     return (normed.astype(cfg.dtype) @ wte_head.T).astype(jnp.float32)[:, 0, :]
 
 
+def _all_logits(params: Params, x: jax.Array,
+                cfg: gpt2.GPT2Config) -> jax.Array:
+    """Project EVERY fed position to logits [B, T, V] — the speculative
+    verify pass needs the target model's choice at each draft position,
+    not just the last one.  Per-position math is identical to
+    :func:`_final_logits` (same layernorm + head matmul, row-wise), so
+    position i of a T-wide projection is bit-identical to a 1-wide
+    projection of the same activations."""
+    wte_head = params.get("wte_head")
+    if wte_head is None:
+        return gpt2.unembed(params, x, cfg)
+    normed = L.layernorm(params["ln_f"], x)
+    return (normed.astype(cfg.dtype) @ wte_head.T).astype(jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # Paged-KV read/write path (serve/kv_slots.PagedKV pools).
 #
@@ -378,6 +393,7 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
                             table: jax.Array, start: jax.Array,
                             cfg: gpt2.GPT2Config,
                             last_pos: Optional[jax.Array] = None,
+                            all_logits: bool = False,
                             ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                        Optional[jax.Array],
                                        Optional[jax.Array]]:
@@ -385,7 +401,10 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
     ``tokens`` [R, T] against the block pool, gathering each layer's view
     inside the layer scan (only ONE layer's view is ever live) and
     scattering its writes back.  Returns (logits [R, V], updated pool
-    arrays) — pool updates are functional, the scheduler threads them."""
+    arrays) — pool updates are functional, the scheduler threads them.
+    ``all_logits`` (trace-time bool) returns [R, T, V] logits at every
+    fed position instead — the speculative-verify program's tail, where
+    the target's token choice is needed at each draft position."""
     t = tokens.shape[-1]
     if jnp.ndim(start) == 0:
         pos = start + jnp.arange(t)                        # [T]
@@ -403,6 +422,8 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
         scan_fn, x, (params["blocks"], pool_k, pool_v, pool_ks, pool_vs),
     )
+    if all_logits:
+        return _all_logits(params, x, cfg), new_k, new_v, new_ks, new_vs
     return _final_logits(params, x, cfg, last_pos), new_k, new_v, \
         new_ks, new_vs
 

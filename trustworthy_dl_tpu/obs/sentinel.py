@@ -39,6 +39,12 @@ SENTINEL_METRICS: Dict[str, str] = {
     "compile_total": "lower",
     "compile_seconds": "lower",
     "hbm_watermark_bytes": "lower",
+    # Speculative-decode draft quality: the fraction of drafted tokens
+    # the model-dtype verify accepted.  A draft-quality regression
+    # (quantization drift, a draft/verify numerics split) pages exactly
+    # like a throughput regression — tokens/s would eventually show it,
+    # but accepted_rate names the cause.
+    "accepted_rate": "higher",
 }
 
 
@@ -49,6 +55,7 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                 compile_total: Optional[int] = None,
                 compile_seconds: Optional[float] = None,
                 hbm_watermark_bytes: Optional[int] = None,
+                accepted_rate: Optional[float] = None,
                 run_metadata: Optional[Dict[str, Any]] = None,
                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One compact perf fingerprint.  ``key`` scopes comparability:
@@ -70,7 +77,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                         ("step_time_s", step_time_s),
                         ("compile_total", compile_total),
                         ("compile_seconds", compile_seconds),
-                        ("hbm_watermark_bytes", hbm_watermark_bytes)):
+                        ("hbm_watermark_bytes", hbm_watermark_bytes),
+                        ("accepted_rate", accepted_rate)):
         if value is not None:
             fp[name] = float(value)
     if phase_fractions:
@@ -281,6 +289,7 @@ def _flatten_perf(view: Dict[str, Any]) -> "List[Tuple[str, Any]]":
     add("hbm_watermark_bytes",
         hbm.get("watermark_bytes", fp.get("hbm_watermark_bytes")))
     add("tokens_per_s", fp.get("tokens_per_s"))
+    add("accepted_rate", fp.get("accepted_rate"))
     return rows
 
 

@@ -21,6 +21,7 @@ from trustworthy_dl_tpu.quant.int8 import (
     QMAX,
     WEIGHT_DTYPES,
     dequantize_int8,
+    draft_decode_view,
     is_quantized_dense,
     kv_parity_probe,
     qdense,
@@ -39,6 +40,7 @@ __all__ = [
     "QMAX",
     "WEIGHT_DTYPES",
     "dequantize_int8",
+    "draft_decode_view",
     "is_quantized_dense",
     "kv_parity_probe",
     "qdense",

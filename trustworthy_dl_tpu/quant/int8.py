@@ -190,6 +190,25 @@ def quantize_decode_view(params: Params, cfg: gpt2.GPT2Config,
     return out
 
 
+def draft_decode_view(params: Params, cfg: gpt2.GPT2Config,
+                      dense_view: Optional[Params] = None,
+                      qview: Optional[Params] = None) -> Params:
+    """The int8 self-draft weight view for speculative decoding
+    (serve/scheduler's draft program): the SAME weights the engine
+    serves, quantized to the weight-only int8 tier — a draft model that
+    costs nothing to train, nothing extra to store beyond the int8
+    copy, and half the decode weight bandwidth per drafted token.
+
+    Reuse contract (no second weight walk): pass ``qview`` when the
+    engine already built its weight-only int8 view (``weight_dtype=
+    "int8"`` — it IS the draft, returned as-is), else pass
+    ``dense_view`` (the engine's already-pre-cast dense decode view) so
+    quantization reuses it instead of re-walking the master weights."""
+    if qview is not None:
+        return qview
+    return quantize_decode_view(params, cfg, view=dense_view)
+
+
 def weight_roundtrip_errors(params: Params, cfg: gpt2.GPT2Config,
                             qview: Optional[Params] = None) -> List[float]:
     """Max relative quantization error per decode-path weight matrix

@@ -198,7 +198,8 @@ class ServingEngine:
                  retain_results: int = 1024,
                  replica_id: Optional[int] = None,
                  retire_hook: Optional[Callable[..., None]] = None,
-                 compilewatch: Any = None, hbm: Any = None):
+                 compilewatch: Any = None, hbm: Any = None,
+                 spec_k: int = 0):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -263,6 +264,14 @@ class ServingEngine:
         # fallback).  ``kv_parity_check=False`` skips the probe (bench
         # arms that construct many engines).
         q8.validate_dtypes(kv_dtype, weight_dtype)
+        # Speculative decoding (README §Serving/"Speculative decoding"):
+        # the same loud knob validation ServeConfig runs, so engines
+        # built without a config fail identically (paged pool required,
+        # weight_dtype must stay "model" — the int8 tier is the DRAFT).
+        from trustworthy_dl_tpu.core.config import validate_spec
+
+        validate_spec(spec_k, paged, weight_dtype)
+        self.spec_k = int(spec_k)
         self.kv_fallback_reason: Optional[str] = None
         # The decode view is built at most ONCE here and shared with the
         # parity probe, the scheduler (its ``view=`` kwarg) and the
@@ -274,6 +283,19 @@ class ServingEngine:
             base_view = gen._decode_view(params, cfg)
             view = (q8.quantize_decode_view(params, cfg, view=base_view)
                     if weight_dtype == "int8" else base_view)
+        # The int8 self-draft for speculative decoding: built ONCE here
+        # (validate_spec already pinned weight_dtype == "model", so the
+        # serve view is dense) reusing whatever dense view exists — one
+        # weight walk total.  The dense view doubles as the scheduler's
+        # serve/verify view so it is not rebuilt there either.
+        draft_view = None
+        if self.spec_k > 0:
+            if base_view is None:
+                base_view = gen._decode_view(params, cfg)
+            draft_view = q8.draft_decode_view(params, cfg,
+                                              dense_view=base_view)
+            if view is None:
+                view = base_view
         if kv_dtype == "int8" and kv_parity_check:
             if not q8.kv_parity_probe(view, cfg):
                 self.kv_fallback_reason = "kv_parity_probe_failed"
@@ -322,6 +344,7 @@ class ServingEngine:
                 kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
                 block_size=block_size, num_blocks=num_blocks,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+                spec_k=self.spec_k, draft_view=draft_view,
             )
         else:
             self.scheduler = ContinuousBatchingScheduler(
@@ -436,6 +459,22 @@ class ServingEngine:
             labels=self._rlabel_names,
         )
         self._prefix_hits_seen = 0
+        # Speculative-decode surface: drafted vs accepted tokens (their
+        # ratio is the accepted_rate the bench A/B and the perf sentinel
+        # track).  Registered on every engine — replica-labelled in
+        # fleet mode like the rest of the tddl_serve_* gauges — and
+        # incremented only when the spec tier runs.
+        self._spec_proposed_counter = _metric(
+            registry.counter, "tddl_serve_spec_proposed_total",
+            "Draft tokens proposed by the speculative int8 self-draft",
+            labels=self._rlabel_names,
+        )
+        self._spec_accepted_counter = _metric(
+            registry.counter, "tddl_serve_spec_accepted_total",
+            "Draft tokens accepted by the batched model-dtype verify",
+            labels=self._rlabel_names,
+        )
+        self._spec_seen = (0, 0)   # (proposed, accepted) already counted
         self.peak_tokens_in_flight = 0
         self.peak_active = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -530,6 +569,7 @@ class ServingEngine:
             num_blocks=serve_config.num_blocks,
             prefix_cache=serve_config.prefix_cache,
             prefill_chunk=serve_config.prefill_chunk,
+            spec_k=serve_config.spec_k,
             **kwargs,
         )
 
@@ -762,9 +802,18 @@ class ServingEngine:
             times = self._timing.setdefault(rid, [])
             if not times:
                 self._span_first_token(rid)
-            times.append(time.perf_counter())
-            self._stream(request, rid, task.emitted[-1])
-            emitted += 1
+            # A speculative tick can emit SEVERAL tokens at once
+            # (``tick_tokens``, in emission order); every single-token
+            # path leaves it None and streams emitted[-1] exactly as
+            # before.  The burst's intra-tick ITLs are honest
+            # near-zeros: the tokens really did land together.
+            new_tokens = (task.tick_tokens
+                          if task.tick_tokens is not None
+                          else [task.emitted[-1]])
+            for token in new_tokens:
+                times.append(time.perf_counter())
+                self._stream(request, rid, token)
+                emitted += 1
             deadline = request.deadline_s
             expired = (deadline is not None
                        and time.perf_counter() - self._submit_t[rid]
@@ -805,6 +854,17 @@ class ServingEngine:
                 self._prefix_counter.inc(hits - self._prefix_hits_seen,
                                          **self._rlabels)
                 self._prefix_hits_seen = hits
+            if self.spec_k:
+                proposed = self.scheduler.spec_proposed
+                accepted = self.scheduler.spec_accepted
+                seen_p, seen_a = self._spec_seen
+                if proposed > seen_p:
+                    self._spec_proposed_counter.inc(proposed - seen_p,
+                                                    **self._rlabels)
+                if accepted > seen_a:
+                    self._spec_accepted_counter.inc(accepted - seen_a,
+                                                    **self._rlabels)
+                self._spec_seen = (proposed, accepted)
         self.metrics.collect_batch_metrics({
             "step": self._iteration,
             "active_slots": self.scheduler.active_count,
@@ -1113,6 +1173,14 @@ class ServingEngine:
                 sched.prefix_hits / sched.prefix_lookups
                 if sched.prefix_lookups else 0.0
             )
+            if self.spec_k:
+                out["spec_k"] = self.spec_k
+                out["spec_proposed"] = sched.spec_proposed
+                out["spec_accepted"] = sched.spec_accepted
+                out["accepted_rate"] = round(sched.accepted_rate, 4)
+                out["spec_near_tie_flips"] = sched.spec_near_tie_flips
+                out["spec_ticks"] = sched.spec_ticks
+                out["spec_fallback_ticks"] = sched.spec_fallback_ticks
         for name, signal, est in (("itl", "itl_s", self._itl_est),
                                   ("ttft", "ttft_s", self._ttft_est)):
             if self.slo is not None:

@@ -345,6 +345,10 @@ class ServingFleet:
             num_blocks=serve_config.num_blocks,
             prefix_cache=serve_config.prefix_cache,
             prefill_chunk=serve_config.prefill_chunk,
+            # Speculative decoding inherits across replica RESTARTS too:
+            # spec_k rides engine_kwargs, so the cool-off probe's
+            # rebuilt engine drafts exactly like the one it replaces.
+            spec_k=serve_config.spec_k,
             **kwargs,
         )
 

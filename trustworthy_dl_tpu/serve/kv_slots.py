@@ -421,6 +421,31 @@ class BlockAllocator:
             self._free.append(block)
             self._journal_add("unquarantine", block)
 
+    # -- speculative claims (speculative decoding's COW discipline) -------
+
+    def claim_speculative(self, blocks: Sequence[int]) -> None:
+        """Pin the blocks a speculative draft window is about to write:
+        one extra reference each (journaled as ordinary increfs, so
+        ``verify_attribution``'s per-block ref/release balance covers
+        speculative traffic like any other sharing).  While claimed, no
+        host-side actor (prefix-cache LRU eviction, admission-pressure
+        eviction) can see the block as single-holder-free — un-verified
+        draft KV is visibly referenced for exactly the tick it exists."""
+        for b in blocks:
+            self.incref(b)
+
+    def release_speculative(self, blocks: Sequence[int]) -> None:
+        """Drop the speculative claims after the verify pass: THE
+        rollback.  Rejected draft tokens cost exactly this refcount
+        decrement — no device copy, no scrub; the rejected positions'
+        K/V are causally invisible (beyond the accepted length) and are
+        overwritten by the next tick's writes before they could ever be
+        attended.  Accepted tokens cost the same decrement (the claim
+        commits into the slot's own table reference, which already
+        holds the block)."""
+        for b in blocks:
+            self.release(b)
+
     @property
     def free_count(self) -> int:
         return len(self._free)
@@ -433,6 +458,21 @@ class BlockAllocator:
     @property
     def quarantined(self) -> Set[int]:
         return set(self._quarantined)
+
+
+def blocks_for_span(table: Sequence[int], block_size: int,
+                    start: int, end: int) -> List[int]:
+    """Distinct physical blocks backing logical positions ``[start,
+    end)`` of a slot's block table — the speculative draft window's
+    claim set.  Positions past the table's allocation are nobody's
+    storage (their static-shape writes land in the trash block) and
+    contribute nothing; the trash block itself is never claimable."""
+    out: List[int] = []
+    for lb in range(start // block_size, -(-end // block_size)):
+        if lb < len(table) and table[lb] != TRASH_BLOCK \
+                and table[lb] not in out:
+            out.append(table[lb])
+    return out
 
 
 class PrefixCache:

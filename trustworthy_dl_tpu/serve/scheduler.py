@@ -49,6 +49,7 @@ from trustworthy_dl_tpu.serve.kv_slots import (
     SlotAllocator,
     SlotKV,
     TRASH_BLOCK,
+    blocks_for_span,
     init_paged_pool,
     init_slots,
     resolve_prefill_chunk,
@@ -300,6 +301,57 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
             new_ks, new_vs)
 
 
+def _spec_draft_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
+                     pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
+                     view: Any, tokens: jax.Array, tables: jax.Array,
+                     lengths: jax.Array, keys: jax.Array,
+                     temps: jax.Array, greedy: jax.Array):
+    """ONE draft step of the speculative tick: the fused paged decode
+    body run with the int8 DRAFT view (quant.draft_decode_view).  Same
+    shapes and table/length discipline as ``_paged_decode_impl`` —
+    block churn never recompiles it — but it returns the next tokens as
+    a separate i32[R] array so the k-step draft chain feeds entirely
+    on-device (no host sync until the verify pull), and it skips the
+    entropy/margin reductions: draft logits never reach the trust
+    monitor, only the verify pass's target logits do."""
+    logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+        view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
+        tables, lengths, cfg,
+    )
+    next_tok = _sample_tokens(logits, keys, temps, greedy)
+    return next_tok.astype(jnp.int32), new_k, new_v, new_ks, new_vs
+
+
+def _spec_verify_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
+                      pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
+                      view: Any, tokens: jax.Array, tables: jax.Array,
+                      lengths: jax.Array, keys: jax.Array,
+                      temps: jax.Array, greedy: jax.Array):
+    """THE batched verify: one MODEL-dtype forward over every slot's
+    whole draft window ``tokens`` [R, k+1] = [last emitted, d_1 .. d_k],
+    attending through the same paged cache at the PRE-draft lengths and
+    OVERWRITING the draft positions with target-computed K/V (so every
+    accepted position's cache entry is exactly what sequential
+    single-token decode would have written — the int8 KV tier included,
+    quantization happens at this write).  Per-position sampling uses
+    the request's own key stream (``keys`` [R, k+1, 2], position i =
+    emission index emitted+i), so the target tokens ARE the spec-off
+    stream, greedy and sampled alike; per-position entropy/margin ride
+    the packed output for the trust monitor and the near-tie acceptance
+    rule.  Returns (packed f32[3, R, k+1], updated pool arrays)."""
+    r, t = tokens.shape
+    logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+        view, tokens, pool_k, pool_v, pool_ks, pool_vs,
+        tables, lengths, cfg, all_logits=True,
+    )
+    flat = logits.reshape(r * t, -1)
+    tok = _sample_tokens(flat, keys.reshape(r * t, 2),
+                         jnp.repeat(temps, t), jnp.repeat(greedy, t))
+    ent, margin = _logit_signals(flat)
+    packed = jnp.stack([tok.astype(jnp.float32), ent, margin])
+    return packed.reshape(3, r, t), new_k, new_v, new_ks, new_vs
+
+
 _PROGRAMS: Dict[str, Any] = {}
 
 
@@ -323,6 +375,18 @@ def _programs() -> Dict[str, Any]:
         )
         _PROGRAMS["paged_decode"] = jax.jit(
             _paged_decode_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        # Speculative tier: draft + verify get their OWN jit wrappers so
+        # the fused-decode compile-once pin (decode_cache_size == 1)
+        # stays meaningful — a spec engine runs exactly THREE
+        # decode-phase programs: spec_draft (int8 view, dispatched k
+        # times per tick), spec_verify (one batched model-dtype pass),
+        # and paged_decode as the single-token fallback.
+        _PROGRAMS["spec_draft"] = jax.jit(
+            _spec_draft_impl, static_argnums=(0,), donate_argnums=donate
+        )
+        _PROGRAMS["spec_verify"] = jax.jit(
+            _spec_verify_impl, static_argnums=(0,), donate_argnums=donate
         )
     return _PROGRAMS
 
@@ -355,6 +419,13 @@ class SlotTask:
     entropies: List[float] = dataclasses.field(default_factory=list)
     margins: List[float] = dataclasses.field(default_factory=list)
     done: bool = False
+    # Tokens this task gained in the CURRENT tick, in emission order —
+    # set only by the speculative tick (which can emit several per
+    # tick); None means "one token, read emitted[-1]" (the single-token
+    # paths never pay the list).  The engine streams from it and the
+    # normal decode path resets it so a fallback tick after a spec tick
+    # can never replay stale tokens.
+    tick_tokens: Optional[List[int]] = None
 
     @property
     def greedy(self) -> bool:
@@ -613,7 +684,8 @@ class PagedBatchingScheduler:
                  view: Any = None,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 spec_k: int = 0, draft_view: Any = None):
         q8.validate_dtypes(kv_dtype, weight_dtype)
         validate_paged_geometry(max_seq, block_size, num_blocks,
                                 prefill_chunk)
@@ -687,6 +759,31 @@ class PagedBatchingScheduler:
         self.prefix_lookups = 0
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
+        # -- speculative decoding (spec_k > 0; README §Serving) --------
+        # Per tick: draft spec_k tokens per active slot with the int8
+        # ``draft_view`` (k dispatches of ONE compiled draft program,
+        # fed on-device), verify the whole window in ONE batched
+        # model-dtype forward, accept the longest draft/target-matching
+        # prefix, and roll back rejected draft KV by releasing the
+        # speculative COW block claims (host refcount decrement).
+        self.spec_k = int(spec_k)
+        self.draft_view = draft_view
+        if self.spec_k > 0 and draft_view is None:
+            raise ValueError(
+                "spec_k > 0 needs a draft_view (the int8 weight tier; "
+                "quant.draft_decode_view — the engine builds it)"
+            )
+        self._spec_claims: Dict[int, List[int]] = {}
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_near_tie_flips = 0
+        self.spec_ticks = 0
+        self.spec_fallback_ticks = 0
+        # Host-observed wall time inside the two spec phases (the draft
+        # chain syncs at the token pull, the verify at the packed pull)
+        # — the bench A/B's draft/verify tick fractions.
+        self.spec_draft_s = 0.0
+        self.spec_verify_s = 0.0
 
     # -- admission ---------------------------------------------------------
 
@@ -885,6 +982,17 @@ class PagedBatchingScheduler:
                   and s not in finished_prefill}
         if not active:
             return ticked
+        if self.spec_k > 0 and any(
+                t.max_new_tokens - len(t.emitted) > 1
+                for t in active.values()):
+            ticked.extend(self._spec_tick(active))
+            return ticked
+        if self.spec_k > 0:
+            # Every live slot has exactly one token left: drafting would
+            # be pure waste — dispatch the single-token FALLBACK program
+            # (today's fused decode, the third compiled decode-phase
+            # program of a spec engine).
+            self.spec_fallback_ticks += 1
         ms = self.allocator.max_slots
         tokens = np.zeros(ms, np.int32)
         keys = np.zeros((ms, 2), np.uint32)
@@ -914,9 +1022,151 @@ class PagedBatchingScheduler:
         for slot in active:
             self.lengths[slot] += 1
         for slot, task in active.items():
+            task.tick_tokens = None   # single-token tick: emitted[-1]
             task._record(int(next_tok[slot]), float(ent[slot]),
                          float(margin[slot]))
             ticked.append(task)
+        return ticked
+
+    def _spec_tick(self, active: Dict[int, SlotTask]) -> List[SlotTask]:
+        """One speculative tick for every decode-phase slot: claim the
+        draft window's blocks, draft ``spec_k`` tokens with the int8
+        view (k dispatches of the compiled draft program, chained
+        on-device), verify the whole window in ONE batched model-dtype
+        forward (which also overwrites the draft KV with target-exact
+        values), accept per slot the longest prefix where the draft
+        matched the target (greedy near-ties under the parity-probe
+        margin tolerated as draft-token flips), then release the claims
+        — rejection is a refcount decrement plus NOT advancing the
+        host-side length past the accepted prefix."""
+        import time as _time
+
+        k = self.spec_k
+        ms = self.allocator.max_slots
+        tokens0 = np.zeros(ms, np.int32)
+        temps = np.ones(ms, np.float32)
+        greedy = np.ones(ms, bool)
+        tables = np.full((ms, self.nbps), TRASH_BLOCK, np.int32)
+        keys = np.zeros((ms, k + 1, 2), np.uint32)
+        # Per-slot PROPOSABLE draft count: a slot with r tokens of
+        # budget left can emit at most r this tick, of which at most
+        # r-1 can come from drafts (the verify bonus is always one of
+        # the emissions) — counting the full k for it would make
+        # accepted_rate conflate budget truncation with real draft/
+        # target disagreement, and the sentinel would page a workload
+        # shift toward short requests as a draft-quality regression.
+        proposable: Dict[int, int] = {}
+        for slot, task in active.items():
+            tokens0[slot] = task.next_token
+            temps[slot] = max(task.temperature, 1e-6)
+            greedy[slot] = task.greedy
+            tables[slot] = self._table_row(slot)
+            base = len(task.emitted)
+            proposable[slot] = min(k, task.max_new_tokens - base - 1)
+            for i in range(k + 1):
+                # Emission index base+i — the SAME key spec-off decode
+                # would consume there (over-draft past the request's
+                # budget clamps; those emissions are discarded anyway).
+                keys[slot, i] = task.keys[
+                    min(base + i, task.max_new_tokens - 1)]
+            claimed = blocks_for_span(
+                self.tables[slot], self.block_size,
+                int(self.lengths[slot]), int(self.lengths[slot]) + k + 1,
+            )
+            self.blocks.claim_speculative(claimed)
+            self._spec_claims[slot] = claimed
+        lengths0 = self.lengths.copy()
+        prog = _programs()
+        kv = self.kv
+        pool = (kv.k, kv.v, kv.k_scale, kv.v_scale)
+        tables_dev = jnp.asarray(tables)
+        temps_dev = jnp.asarray(temps)
+        greedy_dev = jnp.asarray(greedy)
+        t0 = _time.perf_counter()
+        cur = jnp.asarray(tokens0)
+        draft_dev = []
+        for j in range(k):
+            with guarded(self.compilewatch, "serve_spec_draft"):
+                cur, pk, pv, pks, pvs = prog["spec_draft"](
+                    self.cfg, *pool, self.draft_view, cur, tables_dev,
+                    jnp.asarray(lengths0 + j), jnp.asarray(keys[:, j]),
+                    temps_dev, greedy_dev,
+                )
+            pool = (pk, pv, pks, pvs)
+            draft_dev.append(cur)
+        # ONE host sync point for the whole draft chain: the k draft
+        # token rows land together and become the verify inputs.
+        drafts = np.stack([np.asarray(d) for d in draft_dev], axis=1)
+        t1 = _time.perf_counter()
+        self.spec_draft_s += t1 - t0
+        tokens_v = np.concatenate([tokens0[:, None], drafts], axis=1)
+        with guarded(self.compilewatch, "serve_spec_verify"):
+            packed, pk, pv, pks, pvs = prog["spec_verify"](
+                self.cfg, *pool, self.view, jnp.asarray(tokens_v),
+                tables_dev, jnp.asarray(lengths0), jnp.asarray(keys),
+                temps_dev, greedy_dev,
+            )
+        self.kv = PagedKV(k=pk, v=pv, k_scale=pks, v_scale=pvs)
+        host = np.asarray(packed)                     # [3, ms, k+1]
+        t2 = _time.perf_counter()
+        self.spec_verify_s += t2 - t1
+        self.spec_ticks += 1
+        ticked: List[SlotTask] = []
+        tick_proposed = tick_accepted = 0
+        for slot, task in active.items():
+            tgt = host[0, slot]
+            ent = host[1, slot]
+            margin = host[2, slot]
+            d = drafts[slot]
+            # Acceptance walk: position i emits the TARGET token v_{i+1}
+            # (bit-identical to spec-off by construction — same logits,
+            # same key); the walk continues past i only when the draft
+            # guessed the emitted token, so every later target token was
+            # conditioned on the true stream.  A greedy mismatch under a
+            # near-tie top-1 margin (< the int8 parity probe's
+            # tolerance) emits the DRAFT token instead and continues —
+            # the same numerics-equivalence class the kv parity probe
+            # accepts, counted in ``spec_near_tie_flips``.
+            window: List[Tuple[int, float, float]] = []
+            for i in range(k + 1):
+                tok = int(tgt[i])
+                cont = False
+                if i < k:
+                    if int(d[i]) == tok:
+                        cont = True
+                    elif task.greedy and \
+                            float(margin[i]) < q8.PARITY_MARGIN_TOL:
+                        tok = int(d[i])
+                        self.spec_near_tie_flips += 1
+                        cont = True
+                window.append((tok, float(ent[i]), float(margin[i])))
+                if not cont:
+                    break
+            task.tick_tokens = []
+            n_fed = 0
+            for tok, e_sig, m_sig in window:
+                task._record(tok, e_sig, m_sig)
+                task.tick_tokens.append(tok)
+                n_fed += 1
+                if task.done:
+                    break          # eos / budget: later wins discarded
+            # Commit exactly the accepted inputs' KV: positions
+            # [len, len + n_fed) hold target-exact K/V for the emitted
+            # stream; everything beyond is rejected-draft garbage,
+            # causally invisible and rewritten before it could be seen.
+            self.lengths[slot] += n_fed
+            tick_proposed += proposable[slot]
+            tick_accepted += max(n_fed - 1, 0)
+            self.blocks.release_speculative(
+                self._spec_claims.pop(slot, []))
+            ticked.append(task)
+        self.spec_proposed += tick_proposed
+        self.spec_accepted += tick_accepted
+        if self.spans is not None:
+            self.spans.add("serve.spec_verify", t1, _time.perf_counter(),
+                           kind="serve", slots=len(active),
+                           proposed=tick_proposed,
+                           accepted=tick_accepted)
         return ticked
 
     # -- retirement --------------------------------------------------------
@@ -936,6 +1186,14 @@ class PagedBatchingScheduler:
         del self.tasks[slot]
         self._prefill.pop(slot, None)
         self._attrib.pop(slot, None)
+        # Outstanding speculative claims MUST unwind before the table
+        # release: a leftover claim would make the quarantine release
+        # below see the block as "shared" and FREE it on the claim's
+        # decrement instead of impounding it — un-verified draft KV from
+        # a flagged request would re-enter the pool.  (A normal tick
+        # releases its claims inline; this is the abort path — e.g.
+        # quarantine-at-retire racing a failed tick.)
+        self.blocks.release_speculative(self._spec_claims.pop(slot, []))
         published = self._published.pop(slot, [])
         if quarantine and self.prefix is not None and published:
             # The flagged request's own PUBLISHED prompt blocks leave
@@ -976,6 +1234,30 @@ class PagedBatchingScheduler:
         pin: block-table churn must keep this at 1)."""
         prog = _PROGRAMS.get("paged_decode")
         return prog._cache_size() if prog is not None else 0
+
+    def spec_cache_sizes(self) -> Dict[str, int]:
+        """Compiled-program counts for the three decode-phase programs
+        of a speculative engine (the extended compile-once pin: draft,
+        verify and the single-token fallback each compile exactly once
+        for the engine's lifetime — accept/reject churn, block churn
+        and draft-window block crossings never recompile)."""
+        out: Dict[str, int] = {}
+        for name in ("spec_draft", "spec_verify", "paged_decode"):
+            prog = _PROGRAMS.get(name)
+            out[name] = prog._cache_size() if prog is not None else 0
+        return out
+
+    @property
+    def accepted_rate(self) -> float:
+        """Fraction of PROPOSABLE drafted tokens that became emitted
+        stream tokens — the draft-quality headline the bench A/B and
+        the perf sentinel fingerprint track.  The denominator is
+        budget-clamped per slot (min(k, remaining-1)), so the rate
+        measures int8-draft-vs-target agreement, not how short the
+        workload's requests were; eos truncation still counts against
+        it (an eos is a property of the stream both arms share)."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
 
     def analyze_costs(self, ledger: Any,
                       memory: Optional[bool] = None) -> None:
