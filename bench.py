@@ -39,7 +39,10 @@ pool at EQUAL HBM budget — slots, KV bytes and tokens/s per arm;
 TDDL_BENCH_QUANT_W8=1 adds weight-only int8 to the quantized arm),
 TDDL_BENCH_FLEET=1 (serving-fleet goodput-under-SLO vs offered load,
 chaos OFF vs ON over identical seeded workloads — "fleet" record key,
-TDDL_BENCH_FLEET_* knobs).
+TDDL_BENCH_FLEET_* knobs), TDDL_BENCH_ADVERSARY=1 (goodput under an
+adaptive sub-threshold poison attack, verdict voting OFF vs ON over
+identical seeded traffic — "adversary" record key,
+TDDL_BENCH_ADVERSARY_* knobs).
 Infra knobs: TDDL_BENCH_PROBE_TIMEOUT (backend liveness probe seconds,
 default 180; a successful probe is cached for the process AND persisted
 to disk — TDDL_BENCH_PROBE_CACHE sets the file, default
@@ -999,6 +1002,169 @@ def bench_fleet() -> "dict":
     }
 
 
+def bench_adversary() -> "dict":
+    """Goodput-under-attack leg (TDDL_BENCH_ADVERSARY=1): an adaptive
+    poisoned replica that corrupts served streams while holding its
+    public flag rate just below the quarantine threshold, measured with
+    cross-replica verdict voting OFF vs ON over IDENTICAL seeded
+    traffic.
+
+    The number that matters is ``corrupted_served``: with voting off
+    the sub-threshold attacker is never quarantined and keeps serving
+    corrupted streams for the whole run; with voting on it is outvoted
+    (``quarantines >= 1``) and the corruption stops at the verdict.
+    Both arms pay the same fleet overheads, so the goodput gap is the
+    audit cost of voting (replays on K clean replicas).
+
+    The driver is CLOSED-LOOP (a saturating in-flight target over the
+    seeded request list, tick-driven) rather than the open-loop
+    wall-clock replay the fleet leg uses: the suspicion/vote arc needs
+    the degraded suspect to keep receiving work, which only happens
+    when the healthy replicas' bounded queues backpressure — a
+    condition an open-loop rate only meets on a machine-specific
+    service-rate knife edge.
+
+    Env: TDDL_BENCH_ADVERSARY_MODEL (gpt2),
+    TDDL_BENCH_ADVERSARY_REPLICAS (3), TDDL_BENCH_ADVERSARY_SLOTS (4),
+    TDDL_BENCH_ADVERSARY_SEQ (256), TDDL_BENCH_ADVERSARY_REQUESTS (64),
+    TDDL_BENCH_ADVERSARY_SEED (0), TDDL_BENCH_ADVERSARY_K (2),
+    TDDL_BENCH_ADVERSARY_QUEUE (6 — kept BOUNDED so the backpressure
+    above exists), TDDL_BENCH_ADVERSARY_MONITOR (margin threshold,
+    14)."""
+    import jax
+
+    from trustworthy_dl_tpu.chaos import (
+        AdaptivePoisonAttacker,
+        AdversaryConfig,
+        FaultEvent,
+        FaultInjector,
+        FaultKind,
+        FaultPlan,
+        MarginSignatureMonitor,
+    )
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import (
+        FleetConfig,
+        ServeRequest,
+        ServingFleet,
+        WorkloadConfig,
+        generate_workload,
+    )
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_ADVERSARY_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    replicas = int(os.environ.get("TDDL_BENCH_ADVERSARY_REPLICAS", "3"))
+    max_slots = int(os.environ.get("TDDL_BENCH_ADVERSARY_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_ADVERSARY_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_ADVERSARY_REQUESTS", "64"))
+    seed = int(os.environ.get("TDDL_BENCH_ADVERSARY_SEED", "0"))
+    vote_k = int(os.environ.get("TDDL_BENCH_ADVERSARY_K", "2"))
+    queue_limit = int(os.environ.get("TDDL_BENCH_ADVERSARY_QUEUE", "6"))
+    monitor_th = float(os.environ.get("TDDL_BENCH_ADVERSARY_MONITOR",
+                                      "14"))
+    target = replicas - 1
+
+    workload = generate_workload(
+        WorkloadConfig(seed=seed, num_requests=n_requests),
+        cfg.vocab_size, max_seq,
+    )
+    inflight_target = replicas * (max_slots + queue_limit)
+    arms: "dict[str, dict]" = {}
+    for arm, k in (("voting_off", 0), ("voting_on", vote_k)):
+        adversary = AdaptivePoisonAttacker(AdversaryConfig(
+            target=target, seed=seed, signal_jitter=0.5,
+            vocab_size=cfg.vocab_size,
+            # Conservative walk: with ~max_slots requests in flight the
+            # flag-rate observation LAGS the corruption, so an
+            # aggressive climb overshoots into ladder territory before
+            # the backoff lands — this attacker climbs gently and bails
+            # early, which is exactly what keeps it sub-threshold.
+            step_up=0.05, safety_margin=0.08,
+        ))
+        injector = FaultInjector(FaultPlan.scripted([FaultEvent(
+            step=1, kind=FaultKind.REPLICA_ADAPTIVE_POISON,
+            target=target,
+        )], seed=seed), adversary=adversary)
+        fleet = ServingFleet(
+            params, cfg,
+            fleet_config=FleetConfig(
+                num_replicas=replicas, max_retries=6,
+                flag_window=16, flag_min_count=4,
+                vote_k=k, vote_outvote_limit=2,
+                # Cool-off pinned past the run (same reasoning as
+                # bench_fleet: measure the catch, not probe churn).
+                quarantine_cooloff_ticks=10 ** 6,
+            ),
+            chaos=injector, rng=jax.random.PRNGKey(1),
+            max_slots=max_slots, max_seq=max_seq,
+            queue_limit=queue_limit,
+            # Deterministic margin-threshold monitor: the attacker's
+            # flag probability is then a smooth function of strength
+            # (chaos/adversary.py) on both arms identically.
+            monitor=MarginSignatureMonitor(monitor_th),
+        )
+        t0 = time.perf_counter()
+        pending = list(workload)
+        ticks = 0
+        while pending or fleet.busy:
+            while pending and sum(
+                    1 for r in fleet.requests.values()
+                    if not r.done) < inflight_target:
+                item = pending[0]
+                fid = fleet.submit(ServeRequest(
+                    prompt=list(item.prompt),
+                    max_new_tokens=item.max_new_tokens,
+                    temperature=0.8, priority=item.priority,
+                    deadline_s=item.deadline_s,
+                ))
+                if fid is None:
+                    break           # fleet-wide backpressure: next tick
+                pending.pop(0)
+            fleet.step()
+            ticks += 1
+            if ticks > 200_000:
+                raise RuntimeError("adversary bench arm did not drain")
+        wall = time.perf_counter() - t0
+        summary = fleet.metrics_summary()
+        statuses = summary["statuses"]
+        corrupted_served = sum(
+            1 for r in fleet.results.values()
+            if r.status == "completed" and r.replica == target
+        )
+        row = {
+            "vote_k": k,
+            "inflight_target": inflight_target,
+            "goodput_tokens_per_s":
+                round(summary["completed_tokens"] / wall, 1)
+                if wall > 0 else 0.0,
+            "completed": statuses.get("completed", 0),
+            "corrupted_served": corrupted_served,
+            "final_attacker_strength": round(adversary.strength, 4),
+            "attacker_flag_rate":
+                round(fleet.replicas[target].flag_rate, 4),
+            "suspicions": summary["fleet_suspicions"],
+            "votes": summary["fleet_votes"],
+            "outvotes": summary["fleet_outvotes"],
+            "drains": summary["fleet_drains"],
+            "quarantines": summary["fleet_quarantines"],
+            "wall_s": round(wall, 2),
+        }
+        arms[arm] = row
+        log(f"adversary {arm:10s}: goodput "
+            f"{row['goodput_tokens_per_s']:8.1f} tok/s, corrupted "
+            f"served {corrupted_served}, votes {row['votes']}, "
+            f"quarantines {row['quarantines']}")
+    return {
+        "replicas": replicas,
+        "max_slots_per_replica": max_slots,
+        "requests_per_arm": n_requests,
+        "vote_k": vote_k,
+        "arms": arms,
+    }
+
+
 def bench_chaos() -> "list[dict]":
     """Survival sweep (TDDL_BENCH_CHAOS=1): seeded chaos fault plans
     driven through the self-healing supervisor on a tiny GPT-2, one row
@@ -1621,6 +1787,9 @@ def _inner_main() -> None:
     fleet_record = None
     if os.environ.get("TDDL_BENCH_FLEET") == "1":
         fleet_record = bench_fleet()
+    adversary_record = None
+    if os.environ.get("TDDL_BENCH_ADVERSARY") == "1":
+        adversary_record = bench_adversary()
     chaos_records = None
     if os.environ.get("TDDL_BENCH_CHAOS") == "1":
         chaos_records = bench_chaos()
@@ -1658,6 +1827,8 @@ def _inner_main() -> None:
         record["serve_paged"] = paged_record
     if fleet_record is not None:
         record["fleet"] = fleet_record
+    if adversary_record is not None:
+        record["adversary"] = adversary_record
     if chaos_records is not None:
         record["chaos"] = chaos_records
     if async_records is not None:

@@ -15,6 +15,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -385,3 +387,51 @@ def test_bench_fleet_records(monkeypatch, tmp_path):
     # The chaos arm really injected: recovery machinery engaged.
     assert chaos_row["restarts"] >= 1
     assert chaos_row["failovers"] + chaos_row["drains"] >= 1
+
+
+@pytest.mark.adversary
+def test_bench_adversary_records(monkeypatch, tmp_path):
+    """bench_adversary's goodput-under-attack A/B on a tiny model:
+    voting-off and voting-on arms over IDENTICAL seeded traffic.  The
+    contract the record publishes: with voting OFF the sub-threshold
+    attacker is never quarantined and serves corrupted streams for the
+    whole run; with voting ON it is outvoted into quarantine and serves
+    no more of them than the unprotected arm."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_PROBE_CACHE", str(tmp_path / "probe.json"))
+    monkeypatch.setenv("TDDL_BENCH_ADVERSARY_REPLICAS", "3")
+    # 6 slots: per-slot quarantine exhaustion needs 6 flags — the
+    # sub-threshold attacker never banks that many, so the off arm
+    # really is the measured blind spot (not a slow flag-tier catch).
+    monkeypatch.setenv("TDDL_BENCH_ADVERSARY_SLOTS", "6")
+    monkeypatch.setenv("TDDL_BENCH_ADVERSARY_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_ADVERSARY_REQUESTS", "60")
+    monkeypatch.setenv("TDDL_BENCH_ADVERSARY_MONITOR", "16")
+    record = bench.bench_adversary()
+    assert record["replicas"] == 3
+    assert set(record["arms"]) == {"voting_off", "voting_on"}
+    for arm, row in record["arms"].items():
+        for key in ("vote_k", "inflight_target", "goodput_tokens_per_s",
+                    "completed", "corrupted_served",
+                    "final_attacker_strength", "attacker_flag_rate",
+                    "suspicions", "votes", "outvotes", "drains",
+                    "quarantines", "wall_s"):
+            assert key in row, (arm, row)
+    off = record["arms"]["voting_off"]
+    on = record["arms"]["voting_on"]
+    # The blind spot, measured: sub-threshold -> ladder never fires.
+    assert off["quarantines"] == 0 and off["votes"] == 0
+    assert off["corrupted_served"] > 0
+    # Voting catches what the ladder cannot, on the SAME traffic.
+    assert on["votes"] >= on["outvotes"] >= 2
+    assert on["quarantines"] >= 1
+    assert on["corrupted_served"] <= off["corrupted_served"]

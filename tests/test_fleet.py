@@ -17,10 +17,14 @@ import jax
 import jax.numpy as jnp
 
 from trustworthy_dl_tpu.chaos import (
+    AdaptivePoisonAttacker,
+    AdversaryConfig,
     FaultEvent,
     FaultInjector,
     FaultKind,
     FaultPlan,
+    MarginSignatureMonitor,
+    predict_attacker_trajectory,
 )
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.models.generate import generate
@@ -377,7 +381,8 @@ def test_predict_fleet_counts_and_generate_targets():
     ])
     assert plan.predict_fleet() == {
         "crashes": 1, "restarts": 1, "stalls": 1, "poisons": 1,
-        "slowstarts": 1, "failover_episodes": 2, "drains": 2,
+        "adaptive_poisons": 0, "slowstarts": 1, "failover_episodes": 2,
+        "suspicions": 1, "votes": 0, "outvotes": 0, "drains": 2,
         "quarantines": 1,
     }
     # Seeded generation draws replica targets for fleet kinds...
@@ -614,6 +619,380 @@ def test_replay_workload_drives_any_serving_surface():
 
 
 # --------------------------------------------------------------------------
+# Adversarial tier: suspicion below the threshold + verdict voting
+# --------------------------------------------------------------------------
+
+
+class RecordingTrace:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, type, **data):
+        self.events.append({"type": getattr(type, "value", type), **data})
+
+    def of(self, type):
+        return [e for e in self.events if e["type"] == type]
+
+
+def _complete_ballots(fleet, fakes, vote_target, tokens):
+    """Finish every outstanding vote-replay ballot with ``tokens``
+    (per-voter dict or one tuple for all) and settle the tick."""
+    for (voter, local), vote in list(fleet._vote_ballots.items()):
+        if vote.target != vote_target:
+            continue
+        toks = tokens[voter] if isinstance(tokens, dict) else tokens
+        fakes[voter].complete(local, tokens=toks)
+    fleet.step()
+
+
+@pytest.mark.adversary
+def test_adversary_config_validation_and_pinned_controller():
+    with pytest.raises(ValueError, match="mode"):
+        AdversaryConfig(target=0, mode="nope")
+    with pytest.raises(ValueError, match="min_strength"):
+        AdversaryConfig(target=0, min_strength=0.9, max_strength=0.5)
+    with pytest.raises(ValueError, match="corrupt_fraction"):
+        AdversaryConfig(target=0, corrupt_fraction=0.0)
+    cfg = AdversaryConfig(target=1, initial_strength=0.3, step_up=0.1,
+                          backoff=0.5, min_strength=0.05,
+                          flag_rate_quarantine=0.25, safety_margin=0.05)
+    attacker = AdaptivePoisonAttacker(cfg)
+    attacker.activate()
+    # Live controller == predictor, observation for observation: the
+    # trajectory is pinned exactly (climb while comfortable, hold in
+    # the band, multiplicative backoff near the threshold).
+    flags = [False, False, True, False, False, False]
+    window = []
+    for f in flags:
+        window.append(1 if f else 0)
+        attacker.observe(f, sum(window[-8:]) / len(window[-8:]))
+    assert attacker.strength_history == \
+        predict_attacker_trajectory(cfg, flags, flag_window=8)
+    assert attacker.strength_history[:4] == [0.3, 0.4, 0.5, 0.25]
+
+
+@pytest.mark.adversary
+def test_adaptive_poison_requires_an_attached_adversary():
+    """Loud contract: an adaptive event with no (or a mis-targeted)
+    adversary must raise at fire time, not silently no-op the drill."""
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_ADAPTIVE_POISON,
+                   target=2),
+    ]))
+    with pytest.raises(ValueError, match="no adversary"):
+        inj.on_fleet_tick(1)
+    wrong = FaultInjector(
+        FaultPlan.scripted([FaultEvent(
+            step=1, kind=FaultKind.REPLICA_ADAPTIVE_POISON, target=2)]),
+        adversary=AdaptivePoisonAttacker(AdversaryConfig(target=0)),
+    )
+    with pytest.raises(ValueError, match="configured for replica"):
+        wrong.on_fleet_tick(1)
+
+
+@pytest.mark.adversary
+def test_predict_fleet_vote_extension_and_validity_bound():
+    plan = FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_ADAPTIVE_POISON,
+                   target=2),
+    ])
+    blind = plan.predict_fleet()            # voting off: the blind spot
+    assert blind["adaptive_poisons"] == 1
+    assert blind["suspicions"] == 1
+    assert blind["quarantines"] == blind["drains"] == blind["votes"] == 0
+    caught = plan.predict_fleet(vote_k=2, vote_outvote_limit=3)
+    assert caught["votes"] == caught["outvotes"] == 3
+    assert caught["drains"] == caught["quarantines"] == 1
+    # A lone voter can never outvote: vote counts are traffic-bound.
+    with pytest.raises(ValueError, match="vote_k=1"):
+        plan.predict_fleet(vote_k=1)
+    # Satellite: the cool-off validity bound is LOUD — a horizon that
+    # crosses a quarantined replica's cool-off expiry raises instead of
+    # silently predicting counts the readmission-probe churn falsifies.
+    with pytest.raises(ValueError, match="validity bound"):
+        plan.predict_fleet(vote_k=2, horizon=500, cooloff_ticks=100)
+    assert plan.predict_fleet(vote_k=2, horizon=500,
+                              cooloff_ticks=10_000)["quarantines"] == 1
+
+
+@pytest.mark.adversary
+def test_suspicion_tier_works_with_voting_disabled():
+    """Satellite: a sustained-but-sub-threshold flag rate emits
+    fleet_suspicion and the tddl_fleet_suspicion{replica=} gauge even
+    at vote_k=0 — the blind spot is at least VISIBLE without voting."""
+    from trustworthy_dl_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    trace = RecordingTrace()
+    fakes = {}
+
+    def factory(index, **kwargs):
+        fakes[index] = FakeEngine(index, **kwargs)
+        return fakes[index]
+
+    fleet = ServingFleet(
+        fleet_config=FleetConfig(
+            num_replicas=2, flag_window=16, flag_min_count=8,
+            suspicion_threshold=0.1, suspicion_min_flags=2),
+        engine_factory=factory, registry=reg, trace=trace,
+    )
+    # Two flagged retirements among clean ones: rate 2/5 but
+    # flag_min_count=8 keeps the ladder silent — suspicion still opens.
+    # (observe_retirement is the documented slot-side feed point.)
+    for flagged in (True, False, True, False, False):
+        fleet.observe_retirement(0, flagged)
+    fleet.step()
+    rep = fleet.replicas[0]
+    assert rep.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+    assert fleet.counters["suspicions"] == 1
+    assert fleet.counters["votes"] == 0          # K=0: no audits
+    episodes = trace.of("fleet_suspicion")
+    assert len(episodes) == 1 and episodes[0]["replica"] == 0
+    assert episodes[0]["reason"] == "flag_rate"
+    assert reg.get("tddl_fleet_suspicion").value(replica="0") \
+        == pytest.approx(rep.suspicion)
+    assert reg.get("tddl_fleet_suspicions_total").value() == 1.0
+    # Hysteresis: the episode closes only once the EWMA decays well
+    # under the threshold — and a fresh crossing is a NEW episode.
+    for _ in range(12):
+        fleet.observe_retirement(0, False)
+    assert not rep.suspicion_episode
+    # Verify-drive regression: an OUTVOTE on record pins the episode
+    # open through the decay — a replica a verdict already went
+    # against cannot wait out the EWMA and escape its deciding vote.
+    fleet.observe_retirement(0, True)
+    fleet.observe_retirement(0, True)
+    assert rep.suspicion_episode
+    rep.outvotes = 1
+    for _ in range(20):
+        fleet.observe_retirement(0, False)
+    assert rep.suspicion < 0.05 and rep.suspicion_episode
+
+
+@pytest.mark.adversary
+def test_suspicion_vote_outvote_walks_the_quarantine_ladder():
+    """The tentpole handoff: sub-threshold flags -> suspicion episode ->
+    verdict votes (replayed on K other replicas) -> outvoted twice ->
+    the SAME drain -> quarantine ladder the flag-rate trip uses; votes
+    and outvotes land in the drill counters and the outcome-labelled
+    tddl_fleet_votes_total."""
+    from trustworthy_dl_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    trace = RecordingTrace()
+    ledger = AttributionLedger(None)
+    fakes = {}
+
+    def factory(index, **kwargs):
+        fakes[index] = FakeEngine(index, **kwargs)
+        return fakes[index]
+
+    fleet = ServingFleet(
+        fleet_config=FleetConfig(
+            num_replicas=3, flag_window=16, flag_min_count=8,
+            suspicion_threshold=0.1, suspicion_min_flags=2,
+            vote_k=2, vote_outvote_limit=2, drain_grace_ticks=2),
+        engine_factory=factory, registry=reg, trace=trace, ledger=ledger,
+    )
+
+    # Submit 9 requests up front: least-loaded routing spreads them 3
+    # per replica — the suspect keeps serving from its admitted backlog
+    # even after its first flag degrades it (the router only steers NEW
+    # work away from a degraded replica).
+    fids = [fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2))
+            for _ in range(9)]
+    on_zero = [fid for fid in fids if 0 in fleet.requests[fid].live]
+    assert len(on_zero) == 3
+
+    def finish_on_zero(fid, tokens, flagged):
+        fakes[0].complete(fleet.requests[fid].live[0].local_id,
+                          tokens=tokens, flagged=flagged)
+        fleet.step()
+
+    finish_on_zero(on_zero[0], (1, 2), True)
+    assert not fleet._vote_ballots          # 1 flag: not yet suspected
+    fid2 = on_zero[1]
+    finish_on_zero(fid2, (3, 4), True)      # 2nd flag: suspected + vote
+    assert fleet.counters["suspicions"] == 1
+    assert fleet.counters["votes"] == 1
+    ballots = {k for k, v in fleet._vote_ballots.items()
+               if v.fid == fid2}
+    assert {k[0] for k in ballots} == {1, 2}
+    # The replays are audits: no user stream, no prefix publication.
+    for (voter, local) in ballots:
+        replay = (fakes[voter].queue.get(local)
+                  or fakes[voter].inflight.get(local))
+        assert replay.publish_prefix is False
+        assert replay.on_token is None
+    # Both replays agree with each other, against the original: OUTVOTED.
+    _complete_ballots(fleet, fakes, 0, (9, 9))
+    assert fleet.counters["outvotes"] == 1
+    assert fleet.replicas[0].state in (ReplicaState.HEALTHY,
+                                       ReplicaState.DEGRADED)
+    fid3 = on_zero[2]
+    finish_on_zero(fid3, (5, 6), False)     # still suspected: next vote
+    assert fleet.counters["votes"] == 2
+    _complete_ballots(fleet, fakes, 0, (8, 8))
+    assert fleet.counters["outvotes"] == 2  # limit hit -> trust drain
+    for _ in range(4):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.QUARANTINED
+    assert fleet.counters["drains"] == 1
+    assert fleet.counters["quarantines"] == 1
+    reasons = [(e["to_state"], e["reason"])
+               for e in trace.of("replica_transition")
+               if e["replica"] == 0]
+    assert ("draining", "verdict_outvoted") in reasons
+    votes = trace.of("verdict_vote")
+    assert [v["outcome"] for v in votes] == ["outvoted", "outvoted"]
+    assert votes[0]["request_id"] == fid2
+    assert votes[1]["request_id"] == fid3
+    assert reg.get("tddl_fleet_votes_total").value(outcome="outvoted") \
+        == 2.0
+    # Replay-path honesty: every ballot is an admitted:false
+    # vote_replay record; exactly ONE admitted record per fleet id.
+    records = ledger.records()
+    replays = [r for r in records if r.get("status") == "vote_replay"]
+    assert len(replays) == 4 and not any(r["admitted"] for r in replays)
+    assert all(r["vote_target"] == 0 for r in replays)
+    admitted = [r for r in records if r.get("admitted")]
+    assert sorted(r["request_id"] for r in admitted) == \
+        sorted({r["request_id"] for r in admitted})
+
+
+@pytest.mark.adversary
+def test_lone_faulty_voter_never_quarantines_a_clean_replica():
+    """Safety contract: outvoting needs TWO agreeing dissenting ballots
+    — a single lying voter cannot frame a clean replica (it only earns
+    ITSELF suspicion), and at vote_k=1 no outvote is possible at all."""
+    trace = RecordingTrace()
+    fleet, fakes = fake_fleet(num_replicas=3, vote_k=2,
+                              vote_outvote_limit=1, flag_min_count=8)
+    fleet.trace = trace
+    fleet.note_suspicion(0, "attribution")   # irregularity boost
+    assert fleet.counters["suspicions"] == 1
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=2))
+    fakes[0].complete(fleet.requests[fid].live[0].local_id,
+                      tokens=(1, 2))
+    fleet.step()
+    assert fleet.counters["votes"] == 1
+    # Voter 1 tells the truth (matches the original); voter 2 lies.
+    _complete_ballots(fleet, fakes, 0, {1: (1, 2), 2: (7, 7)})
+    for _ in range(3):
+        fleet.step()
+    assert fleet.counters["outvotes"] == 0
+    assert fleet.replicas[0].state is not ReplicaState.QUARANTINED
+    assert trace.of("verdict_vote")[0]["outcome"] == "confirmed"
+    # ...and the LIAR is now the suspect (vote_dissent suspicion).
+    assert fleet.replicas[2].suspicion > 0.0
+    assert any(e["replica"] == 2 and e["reason"] == "vote_dissent"
+               for e in trace.of("fleet_suspicion"))
+
+    # vote_k=1: a lone voter's dissent is never conclusive.
+    fleet2, fakes2 = fake_fleet(num_replicas=2, vote_k=1,
+                                vote_outvote_limit=1, flag_min_count=8)
+    fleet2.note_suspicion(0, "attribution")
+    fid = fleet2.submit(ServeRequest(prompt=[1], max_new_tokens=2))
+    fakes2[0].complete(fleet2.requests[fid].live[0].local_id,
+                       tokens=(1, 2))
+    fleet2.step()
+    assert fleet2.counters["votes"] == 1
+    _complete_ballots(fleet2, fakes2, 0, (9, 9))
+    for _ in range(3):
+        fleet2.step()
+    assert fleet2.counters["outvotes"] == 0
+    assert fleet2.replicas[0].state is not ReplicaState.QUARANTINED
+
+
+@pytest.mark.adversary
+def test_vote_dedup_with_hedged_retries():
+    """One vote per fleet request id even when hedging doubled the
+    attempts: only the WINNER's completion can trigger the audit, the
+    hedge loser is never mistaken for a ballot, and the
+    one-admitted-record invariant survives votes + hedges together."""
+    ledger = AttributionLedger(None)
+    fleet, fakes = fake_fleet(num_replicas=3, ledger=ledger,
+                              hedge_deadline_s=60.0, vote_k=2,
+                              vote_outvote_limit=5, flag_min_count=8)
+    fleet.note_suspicion(1, "attribution")   # replica 1 is the suspect
+    fid = fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                    deadline_s=30.0))
+    fleet.step()                             # hedge fires -> {0, 1}
+    rec = fleet.requests[fid]
+    assert set(rec.live) == {0, 1}
+    # The hedge on the SUSPECTED replica completes first and wins.
+    fakes[1].complete(rec.live[1].local_id, tokens=(5, 6))
+    fleet.step()
+    assert fleet.results[fid].replica == 1
+    assert fleet.counters["votes"] == 1      # exactly one audit
+    assert fleet.counters["hedge_lost"] == 1
+    ballots = {k for k, v in fleet._vote_ballots.items() if v.fid == fid}
+    assert {k[0] for k in ballots} == {0, 2}  # loser replica CAN vote
+    _complete_ballots(fleet, fakes, 1, (5, 6))
+    for _ in range(2):
+        fleet.step()
+    assert fleet.counters["votes"] == 1
+    assert fleet.counters["outvotes"] == 0   # replays agreed: confirmed
+    records = ledger.records()
+    admitted = [r for r in records if r.get("admitted")]
+    assert len(admitted) == 1 and admitted[0]["request_id"] == fid
+    assert sorted(r["status"] for r in records if not r.get("admitted")) \
+        == ["hedge_lost", "vote_replay", "vote_replay"]
+    assert not fleet.busy
+
+
+@pytest.mark.adversary
+def test_crash_of_vote_target_abandons_the_stale_vote():
+    """Review regression: a vote whose TARGET generation dies (crash →
+    rebuild, which resets ``vote_open``) is abandoned — ballots
+    cancelled, no outcome, no counters — so a stale verdict can never
+    convict the successor generation, and the rebuilt replica cannot
+    end up with two concurrent votes."""
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=2, kind=FaultKind.REPLICA_CRASH, target=0),
+    ]))
+    fleet, fakes = fake_fleet(num_replicas=3, chaos=inj, vote_k=2,
+                              flag_min_count=8, restart_ticks=1,
+                              backoff_base_ticks=0)
+    fleet.note_suspicion(0, "attribution")
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    fakes[0].complete(fleet.requests[fid].live[0].local_id)
+    fleet.step()                    # tick 1: vote launches on {1, 2}
+    assert fleet.counters["votes"] == 1 and fleet._vote_ballots
+    fleet.step()                    # tick 2: the TARGET crashes
+    assert not fleet._vote_ballots  # stale vote abandoned outright
+    assert fleet.counters["outvotes"] == 0
+    assert not fleet.busy
+    # The voters' replay slots were reclaimed, not left serving a
+    # stream nobody will ever score.
+    assert fakes[1].load == 0 and fakes[2].load == 0
+
+
+@pytest.mark.adversary
+def test_voter_crash_mid_vote_abstains_instead_of_wedging():
+    """A ballot on a crashed replica abstains; the vote still resolves
+    (inconclusively here) and ``busy`` clears — outstanding votes keep
+    the loop live but never wedge it."""
+    inj = FaultInjector(FaultPlan.scripted([
+        FaultEvent(step=3, kind=FaultKind.REPLICA_CRASH, target=1),
+        FaultEvent(step=3, kind=FaultKind.REPLICA_CRASH, target=2),
+    ]))
+    fleet, fakes = fake_fleet(num_replicas=3, chaos=inj, vote_k=2,
+                              flag_min_count=8, restart_ticks=2)
+    fleet.note_suspicion(0, "attribution")
+    fid = fleet.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+    fakes[0].complete(fleet.requests[fid].live[0].local_id)
+    fleet.step()                             # tick 1: vote launches
+    assert fleet.counters["votes"] == 1 and fleet.busy
+    fleet.step()                             # tick 2
+    fleet.step()                             # tick 3: both voters crash
+    assert not fleet._vote_ballots
+    assert not fleet.busy
+    assert fleet.counters["outvotes"] == 0
+    assert fleet.replicas[0].state is not ReplicaState.QUARANTINED
+
+
+# --------------------------------------------------------------------------
 # Slow tier: THE seeded drill over real engines
 # --------------------------------------------------------------------------
 
@@ -706,3 +1085,181 @@ def test_fleet_chaos_drill_matches_predict_and_reference_streams():
     assert spanning, "no record spans two replicas' journals"
     # The crash retained its generation's journal alongside the new one.
     assert "0:0" in fleet.journals and "0:1" in fleet.journals
+
+
+@pytest.mark.slow
+@pytest.mark.adversary
+def test_adaptive_subthreshold_attacker_caught_by_verdict_voting():
+    """THE adversarial acceptance drill: a seeded adaptive attacker
+    corrupts replica 2's served streams while its controller holds the
+    replica's public flag rate BELOW ``flag_rate_quarantine`` — the
+    PR 8 ladder never trips (no flag-rate drain, no slot exhaustion) —
+    yet verdict voting outvotes the corrupted streams twice and sends
+    the replica down the same drain -> quarantine ladder.  Pinned:
+    recovery counters == ``predict_fleet(vote_k=2)`` exactly (under its
+    validity bound), the attacker's full strength trajectory ==
+    ``predict_attacker_trajectory`` over the recorded flags, zero
+    clean-replica quarantines, unaffected streams bit-identical to
+    ``generate()``, corrupted streams provably corrupted, attribution
+    reconciliation clean across the vote replays, and zero compile
+    storms under the CompileWatcher."""
+    from collections import deque
+
+    from trustworthy_dl_tpu.obs.compilewatch import (
+        CompileRegistry,
+        CompileWatcher,
+    )
+
+    params = gpt2.init_params(jax.random.PRNGKey(0), CFG)
+    adv_cfg = AdversaryConfig(
+        target=2, seed=5,
+        flag_rate_quarantine=0.25, safety_margin=0.08,
+        initial_strength=0.3, step_up=0.1, backoff=0.5,
+        min_strength=0.05, max_strength=1.0,
+        signal_scale=40.0, signal_jitter=0.0,
+        vocab_size=CFG.vocab_size,
+    )
+    attacker = AdaptivePoisonAttacker(adv_cfg)
+    plan = FaultPlan.scripted([FaultEvent(
+        step=1, kind=FaultKind.REPLICA_ADAPTIVE_POISON, target=2,
+    )])
+    inj = FaultInjector(plan, adversary=attacker)
+    ledger = AttributionLedger(None)
+    trace = RecordingTrace()
+    compiles = CompileRegistry().install()
+    try:
+        watcher = CompileWatcher(compiles)
+        fleet = ServingFleet(
+            params, CFG,
+            fleet_config=FleetConfig(
+                num_replicas=3, max_retries=6,
+                flag_window=16, flag_min_count=4,
+                flag_rate_quarantine=0.25,
+                suspicion_threshold=0.1, suspicion_min_flags=2,
+                vote_k=2, vote_outvote_limit=2,
+                quarantine_cooloff_ticks=10_000,  # past the horizon
+            ),
+            chaos=inj, ledger=ledger,
+            # 6 slots/replica: per-slot quarantine exhaustion would need
+            # 6 flags — the attacker's controller never banks that many
+            # in-window, so the ONLY way it falls is the vote verdict.
+            # queue_limit 4 keeps per-engine queues BOUNDED: once the
+            # healthy replicas backpressure, the router walks to the
+            # degraded suspect — which therefore keeps serving (and
+            # keeps being auditable) exactly like a loaded production
+            # fleet, instead of starving behind the healthy-first sort.
+            max_slots=6, max_seq=48, queue_limit=4,
+            # Margin-signature monitor: flags are a deterministic
+            # function of attacker strength (jitter 0), so the recorded
+            # flag sequence replays the controller exactly.
+            monitor=MarginSignatureMonitor(20.0),
+            compilewatch=watcher,
+        )
+        fleet.trace = trace
+        rng = np.random.default_rng(1)
+        prepared = deque()
+        for _ in range(150):
+            plen = int(rng.integers(3, 10))
+            new = int(rng.integers(4, 10))
+            prepared.append(
+                (rng.integers(0, CFG.vocab_size, plen).tolist(),
+                 int(new)))
+        reqs = {}
+        # Closed-loop seeded traffic: hold ~30 requests in flight —
+        # above the two healthy replicas' bounded capacity, so the
+        # suspect keeps receiving work — until the verdict lands (or
+        # the prepared stream runs out, failing the quarantine
+        # assertions below loudly).  Backpressured submissions retry
+        # on later ticks.
+        for _ in range(4000):
+            if fleet.replicas[2].state is ReplicaState.QUARANTINED:
+                break
+            while prepared and sum(
+                    1 for r in fleet.requests.values()
+                    if not r.done) < 30:
+                prompt, new = prepared.popleft()
+                fid = fleet.submit(ServeRequest(prompt=prompt,
+                                                max_new_tokens=new))
+                if fid is None:
+                    prepared.appendleft((prompt, new))
+                    break
+                reqs[fid] = (prompt, new)
+            fleet.step()
+        results = fleet.run_until_idle(max_ticks=4000)
+
+        # THE headline: the ladder alone never saw it...
+        ladder_reasons = {e["reason"]
+                          for e in trace.of("replica_transition")
+                          if e["to_state"] == "draining"}
+        assert "monitor_flag_rate" not in ladder_reasons
+        assert "slot_quarantine_exhausted" not in ladder_reasons
+        assert fleet.replicas[2].flag_rate < 0.25  # sub-threshold, held
+        # ...voting caught it.
+        assert ladder_reasons == {"verdict_outvoted"}
+        assert fleet.states() == {0: "healthy", 1: "healthy",
+                                  2: "quarantined"}
+
+        # Counters == the extended predict_fleet, under its (enforced)
+        # cool-off validity bound.
+        predicted = plan.predict_fleet(vote_k=2, vote_outvote_limit=2,
+                                       horizon=fleet.tick,
+                                       cooloff_ticks=10_000)
+        observed = {k: fleet.counters[k] for k in predicted}
+        assert observed == predicted, (observed, predicted)
+
+        # The attacker's trajectory is pinned: live controller ==
+        # predictor replayed over the recorded flag observations, and
+        # the final strength matches.
+        flags = [f for f, _ in attacker.flag_observations]
+        assert sum(flags) >= 2          # it DID flag — just sustained
+        predicted_traj = predict_attacker_trajectory(adv_cfg, flags,
+                                                     flag_window=16)
+        assert attacker.strength_history == predicted_traj
+        assert attacker.strength == predicted_traj[-1]
+
+        # Every accepted request retired explicitly and completed.
+        assert sorted(results) == sorted(reqs)
+        assert all(r.status == "completed" for r in results.values())
+        # Streams of UNAFFECTED requests are bit-identical to
+        # generate(); every stream served by the compromised replica is
+        # provably corrupted (the attack has a payload, not just
+        # signals).
+        corrupted = clean = 0
+        for fid, (prompt, new) in reqs.items():
+            ref = np.asarray(generate(
+                params, CFG, jnp.asarray([prompt], jnp.int32), new,
+                temperature=0.0,
+            ))[0, len(prompt):].tolist()
+            if results[fid].replica == 2:
+                assert results[fid].tokens != ref, f"request {fid}"
+                corrupted += 1
+            else:
+                assert results[fid].tokens == ref, f"request {fid}"
+                clean += 1
+        assert corrupted >= 2 and clean >= 2
+
+        # Replay-path honesty: ballots are admitted:false vote_replay
+        # records (2 per vote), exactly one admitted record per id, and
+        # the ledger reconciles against every replica's block journal.
+        records = ledger.records()
+        replays = [r for r in records if r.get("status") == "vote_replay"]
+        assert len(replays) == 2 * fleet.counters["votes"]
+        assert not any(r["admitted"] for r in replays)
+        admitted = [r for r in records if r.get("admitted")]
+        assert sorted(r["request_id"] for r in admitted) == sorted(reqs)
+        ok, problems = fleet.verify_attribution()
+        assert ok, problems
+
+        # Suspicion surfaced as a typed episode, and the verdict votes
+        # as outcome-labelled events.
+        assert [e["replica"] for e in trace.of("fleet_suspicion")
+                if e["reason"] == "flag_rate"] == [2]
+        outvoted = [e for e in trace.of("verdict_vote")
+                    if e["outcome"] == "outvoted"]
+        assert len(outvoted) == 2
+
+        # Zero storms: block churn, vote replays and the quarantine
+        # never recompiled a decode program.
+        assert watcher.storm_total == 0
+    finally:
+        compiles.uninstall()

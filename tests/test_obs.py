@@ -852,6 +852,27 @@ def test_fleet_events_and_gauges_are_inside_the_lint_perimeter():
         assert f'"{name}"' in src, name
 
 
+def test_adversary_surface_inside_the_lint_perimeter():
+    """PR 12 extension: the adversarial-serving event types (suspicion
+    episodes + verdict votes) carry full schemas — the emit lint +
+    validate_event cover them like every other type — and the new
+    fleet metric surface keeps the ``tddl_`` naming contract via
+    literal names the metric-name lint scans."""
+    assert EVENT_SCHEMAS[EventType.FLEET_SUSPICION]["fields"] == \
+        ("replica", "score", "reason")
+    assert EVENT_SCHEMAS[EventType.VERDICT_VOTE]["requires"] == \
+        ("request_id",)
+    assert EVENT_SCHEMAS[EventType.VERDICT_VOTE]["fields"] == \
+        ("replica", "outcome", "agree", "dissent")
+    src = (REPO / "trustworthy_dl_tpu" / "serve" / "fleet.py").read_text()
+    for name in ("tddl_fleet_suspicion", "tddl_fleet_suspicions_total",
+                 "tddl_fleet_votes_total"):
+        assert f'"{name}"' in src, name
+    # The votes counter is outcome-labelled (confirmed / outvoted /
+    # inconclusive) so dashboards can separate audits from verdicts.
+    assert 'labels=("outcome",)' in src
+
+
 def test_perf_tier_events_and_metrics_inside_the_lint_perimeter():
     """PR 10 extension: the performance-tier event types carry full
     schemas (so the emit lint + validate_event cover them like every

@@ -605,3 +605,39 @@ def test_paged_smoke_bit_identical_to_stripe_and_generate(params):
     sched = engines["paged"].scheduler
     assert sched.blocks.in_use == len(sched.prefix)
     assert summary["peak_tokens_in_flight"] > 0
+
+
+@pytest.mark.adversary
+def test_vote_replay_publish_prefix_false_leaves_cache_untouched(params):
+    """Adversarial-serving satellite (replay-path honesty): a verdict-
+    vote REPLAY (``ServeRequest.publish_prefix=False``) may READ the
+    prefix cache but never publishes its own prompt blocks — the cache
+    and its block references are exactly as the replay found them, so
+    audit traffic can never pin pool blocks or seed later requests from
+    a replay's prefill."""
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+
+    eng = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                        block_size=4)
+    sched = eng.scheduler
+    prompt = list(range(2, 14))                 # 3 full blocks
+    eng.submit(ServeRequest(prompt=prompt, max_new_tokens=3,
+                            publish_prefix=False))
+    eng.run_until_idle()
+    assert len(sched.prefix) == 0               # nothing cached
+    assert sched.blocks.free_count == sched.blocks.num_blocks
+    # A second audit replay of the same prompt: still a cache miss.
+    eng.submit(ServeRequest(prompt=prompt, max_new_tokens=3,
+                            publish_prefix=False))
+    eng.run_until_idle()
+    assert sched.prefix_hits == 0
+    # A NORMAL request publishes as always...
+    eng.submit(ServeRequest(prompt=prompt, max_new_tokens=3))
+    eng.run_until_idle()
+    assert len(sched.prefix) == 3
+    # ...and a replay may read it without perturbing it.
+    eng.submit(ServeRequest(prompt=prompt, max_new_tokens=3,
+                            publish_prefix=False))
+    eng.run_until_idle()
+    assert sched.prefix_hits == 1
+    assert len(sched.prefix) == 3

@@ -1,0 +1,93 @@
+"""Serve-side detection envelope (experiments/serve_envelope.py).
+
+Fast tier: artifact-shape contracts on a synthetic results dict (table
+rendering, detection grouping) — no engines.  Slow tier: a reduced
+(strength × threshold × K) grid over REAL fleets, asserting the
+detectability boundary the study exists to measure: the sub-threshold
+cell is the ladder's blind spot at K=0 and a vote catch at K=2, with
+zero clean-replica quarantines and run-metadata-stamped artifacts.
+"""
+
+import json
+
+import pytest
+
+from trustworthy_dl_tpu.experiments.serve_envelope import (
+    render_table,
+    run_serve_envelope,
+)
+
+pytestmark = pytest.mark.adversary
+
+
+def _cell(vote_k, strength, threshold, detected_by, corrupted=3,
+          clean=0):
+    return {
+        "strength": strength, "threshold": threshold, "vote_k": vote_k,
+        "detected_by": detected_by, "clean_replica_quarantines": clean,
+        "corrupted_served": corrupted, "completed": 20, "requests": 20,
+        "target_flag_rate": 0.1, "target_suspicion": 0.2,
+        "suspicions": 1, "votes": 0, "outvotes": 0, "drains": 0,
+        "quarantines": 0, "ticks": 40, "wall_time_s": 1.0,
+    }
+
+
+def test_render_table_groups_by_vote_k_and_marks_tiers():
+    results = {
+        "config": {"strengths": [0.2, 0.8], "thresholds": [10.0],
+                   "vote_ks": [0, 2]},
+        "cells": [
+            _cell(0, 0.2, 10.0, "none"),
+            _cell(0, 0.8, 10.0, "ladder"),
+            _cell(2, 0.2, 10.0, "vote"),
+            _cell(2, 0.8, 10.0, "ladder"),
+        ],
+    }
+    table = render_table(results)
+    assert "**vote K = 0** (voting off)" in table
+    assert "**vote K = 2**" in table
+    assert "LADDER" in table and "VOTE" in table and "—" in table
+    assert "corrupted served" in table
+    assert "Clean-replica quarantines across all cells: 0" in table
+
+
+@pytest.mark.slow
+def test_serve_envelope_measures_the_boundary(tmp_path):
+    """The reduced matrix demonstrates all three regimes on real
+    fleets — too weak to flag (undetected floor, documented), the
+    sub-threshold blind spot (ladder misses at K=0, voting catches at
+    K=2), full strength (ladder) — and the artifact set matches the
+    training envelope's shape: run-metadata-stamped JSON + md table."""
+    results = run_serve_envelope(
+        output_dir=str(tmp_path), strengths=(0.15, 0.45, 0.9),
+        thresholds=(20.0,), vote_ks=(0, 2), num_requests=28,
+        make_figure=False,
+    )
+    by_key = {(c["vote_k"], c["strength"]): c for c in results["cells"]}
+    # Floor: too weak to flag -> no suspicion -> nothing to audit.
+    assert by_key[(0, 0.15)]["detected_by"] == "none"
+    assert by_key[(2, 0.15)]["detected_by"] == "none"
+    # THE blind spot: sub-threshold flags evade the ladder at K=0...
+    blind = by_key[(0, 0.45)]
+    assert blind["detected_by"] == "none"
+    assert blind["suspicions"] >= 1          # ...but suspicion SAW it
+    assert 0.0 < blind["target_flag_rate"] < 0.5
+    # ...and verdict voting catches it at K=2 on identical traffic.
+    caught = by_key[(2, 0.45)]
+    assert caught["detected_by"] == "vote"
+    assert caught["outvotes"] >= 2 and caught["quarantines"] == 1
+    # Full strength: the PR 8 ladder tier still owns the easy case.
+    assert by_key[(0, 0.9)]["detected_by"] == "ladder"
+    assert by_key[(2, 0.9)]["detected_by"] == "ladder"
+    # Nobody clean was ever convicted, in any cell.
+    assert all(c["clean_replica_quarantines"] == 0
+               for c in results["cells"])
+
+    # Artifact shape: the same stamped-JSON + md contract as the
+    # training envelope (test_obs pins the stamp keys globally).
+    blob = json.loads((tmp_path / "serve_envelope.json").read_text())
+    assert blob["run_metadata"]["jax_version"]
+    assert blob["config"]["vote_ks"] == [0, 2]
+    assert len(blob["cells"]) == 6
+    table = (tmp_path / "serve_envelope.md").read_text()
+    assert "VOTE" in table and "LADDER" in table

@@ -7,6 +7,12 @@ reproducible, scriptable schedule that drills the self-healing loop in
 ``engine/supervisor.py`` end to end.  See ``examples/chaos_drill.py``.
 """
 
+from trustworthy_dl_tpu.chaos.adversary import (
+    AdaptivePoisonAttacker,
+    AdversaryConfig,
+    MarginSignatureMonitor,
+    predict_attacker_trajectory,
+)
 from trustworthy_dl_tpu.chaos.injector import (
     FaultInjector,
     SimulatedPreemption,
@@ -21,10 +27,14 @@ from trustworthy_dl_tpu.chaos.plan import (
 
 __all__ = [
     "FLEET_KINDS",
+    "AdaptivePoisonAttacker",
+    "AdversaryConfig",
     "FaultEvent",
     "FaultInjector",
     "FaultKind",
     "FaultPlan",
+    "MarginSignatureMonitor",
     "SimulatedPreemption",
     "corrupt_file",
+    "predict_attacker_trajectory",
 ]

@@ -50,7 +50,7 @@ class FaultInjector:
 
     def __init__(self, plan: FaultPlan,
                  sleep_fn: Callable[[float], None] = time.sleep,
-                 max_stall_s: float = 30.0):
+                 max_stall_s: float = 30.0, adversary: Any = None):
         self.plan = plan
         self._sleep = sleep_fn
         self._max_stall_s = max_stall_s
@@ -65,6 +65,13 @@ class FaultInjector:
         # :meth:`heal_replica` (so a readmission probe of a
         # still-compromised replica fails again, as it must).
         self._poisoned_replicas: Dict[int, float] = {}
+        # The adaptive counterpart: a chaos.adversary.AdaptivePoisonAttacker
+        # activated by a REPLICA_ADAPTIVE_POISON event.  It owns the
+        # corruption (tokens + strength-scaled signals) and the
+        # strength controller; the fleet feeds the replica's public
+        # flag-rate window back through :meth:`on_flag_observed`.
+        self.adversary = adversary
+        self._adaptive_replicas: Dict[int, Any] = {}
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -186,7 +193,14 @@ class FaultInjector:
         target matches — a poison aimed at replica 1's request 3 must
         never fire on replica 0's request 3.  An active REPLICA_POISON
         on this replica poisons EVERY retirement (the fired-once event
-        is the onset; the compromise persists until healed)."""
+        is the onset; the compromise persists until healed); an active
+        REPLICA_ADAPTIVE_POISON delegates every retirement to the
+        attached adversary (seeded token corruption + strength-scaled
+        signal shaping)."""
+        adv = self._adaptive_replicas.get(-1 if replica is None else replica)
+        if adv is not None:
+            adv.corrupt(task)
+            return
         rep = self._poisoned_replicas.get(-1 if replica is None else replica)
         if rep is not None:
             self._poison_signals(task, rep)
@@ -222,16 +236,54 @@ class FaultInjector:
                     if kind is FaultKind.REPLICA_POISON:
                         self._poisoned_replicas[event.target] = \
                             float(event.severity)
+                    elif kind is FaultKind.REPLICA_ADAPTIVE_POISON:
+                        # Loud: an adaptive event with no (or a
+                        # mis-targeted) attacker attached would silently
+                        # degrade into "no fault at all" — the opposite
+                        # of a drill.
+                        if self.adversary is None:
+                            raise ValueError(
+                                "REPLICA_ADAPTIVE_POISON fired but no "
+                                "adversary is attached — build the "
+                                "injector with FaultInjector(plan, "
+                                "adversary=AdaptivePoisonAttacker(...))"
+                            )
+                        if self.adversary.config.target != event.target:
+                            raise ValueError(
+                                f"REPLICA_ADAPTIVE_POISON targets replica "
+                                f"{event.target} but the attached "
+                                f"adversary is configured for replica "
+                                f"{self.adversary.config.target}"
+                            )
+                        self._adaptive_replicas[event.target] = \
+                            self.adversary
+                        self.adversary.activate()
                     out.append(event)
         return out
 
+    def on_flag_observed(self, replica: int, flagged: bool,
+                         flag_rate: float) -> None:
+        """Fleet feedback hook: the target replica's PUBLIC flag-rate
+        window after a monitor-scored retirement (the number the
+        ``tddl_fleet_suspicion``/flag gauges export — adversary-visible
+        by construction).  Drives the adaptive attacker's strength
+        controller; a no-op without an active adaptive compromise."""
+        adv = self._adaptive_replicas.get(replica)
+        if adv is not None:
+            adv.observe(flagged, flag_rate)
+
     def heal_replica(self, replica: int) -> None:
-        """Operator action: clear an active REPLICA_POISON (until then a
-        readmitted replica is immediately re-flagged)."""
+        """Operator action: clear an active REPLICA_POISON or
+        REPLICA_ADAPTIVE_POISON (until then a readmitted replica is
+        immediately re-flagged/re-outvoted)."""
         self._poisoned_replicas.pop(replica, None)
+        adv = self._adaptive_replicas.pop(replica, None)
+        if adv is not None:
+            adv.deactivate()
 
     def replica_poisoned(self, replica: int) -> bool:
-        return replica in self._poisoned_replicas
+        return (replica in self._poisoned_replicas
+                or replica in self._adaptive_replicas)
 
 
 def _corrupt_largest_leaf(params: Any) -> Any:

@@ -79,12 +79,23 @@ class FaultKind(str, enum.Enum):
     #: ``severity`` extra ticks of warmup during which it accepts no new
     #: admissions (goodput dip, no failover/drain).
     REPLICA_SLOWSTART = "replica_slowstart"
+    #: Compromise replica ``target`` ADAPTIVELY from tick ``step`` on:
+    #: corruption is driven by a ``chaos.adversary.AdaptivePoisonAttacker``
+    #: (``FaultInjector(adversary=...)``) that corrupts the served token
+    #: stream and tunes its signal shaping to hold the replica's public
+    #: flag rate just below ``FleetConfig.flag_rate_quarantine`` — the
+    #: PR 8 ladder never trips.  Caught by the fleet's cross-replica
+    #: verdict voting (``FleetConfig.vote_k``): corrupted streams
+    #: disagree with their bit-identical replays.  Persists until
+    #: :meth:`FaultInjector.heal_replica`.
+    REPLICA_ADAPTIVE_POISON = "replica_adaptive_poison"
 
 
 #: The serving-fleet kinds (consumed by ``FaultInjector.on_fleet_tick``
 #: / ``on_serve_retire`` rather than the trainer hooks).
 FLEET_KINDS = (FaultKind.REPLICA_CRASH, FaultKind.REPLICA_STALL,
-               FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART)
+               FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART,
+               FaultKind.REPLICA_ADAPTIVE_POISON)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,9 +200,14 @@ class FaultPlan:
             "stalls": self.count(FaultKind.STALL),
         }
 
-    def predict_fleet(self) -> Dict[str, int]:
+    def predict_fleet(self, vote_k: int = 0, vote_outvote_limit: int = 2,
+                      horizon: Optional[int] = None,
+                      cooloff_ticks: Optional[int] = None
+                      ) -> Dict[str, int]:
         """Expected ``ServingFleet`` recovery counts for this plan's
         REPLICA_* events (the serving mirror of :meth:`predict`).
+        ``vote_k``/``vote_outvote_limit`` mirror the drill's
+        ``FleetConfig`` verdict-voting knobs (0 = voting off).
 
         Valid when events are *isolated* — at most one fleet fault per
         replica, each given room to complete its recovery arc: a STALL's
@@ -202,27 +218,78 @@ class FaultPlan:
         cool-off expires (or the poison is healed first): an unhealed
         replica re-trips on every readmission probe by design, adding a
         drain + quarantine per probe beyond the first.  Drills pin
-        ``quarantine_cooloff_ticks`` past their horizon.  Under those
-        conditions each event's recovery arc is exact:
+        ``quarantine_cooloff_ticks`` past their horizon — pass
+        ``horizon`` (the drill's tick budget) and ``cooloff_ticks``
+        (the config's first cool-off) and this method ENFORCES the
+        bound, raising instead of silently producing counts the probe
+        churn would falsify.  Under those conditions each event's
+        recovery arc is exact:
 
         * CRASH  → 1 failover episode (everything the replica held
           migrates at once) + 1 restart;
         * STALL  → 1 drain (heartbeat trips) + 1 failover episode;
-        * POISON → 1 drain (monitor flag-rate crosses the quarantine
-          threshold) + 1 quarantine;
+        * POISON → 1 suspicion episode + 1 drain (monitor flag-rate
+          crosses the quarantine threshold) + 1 quarantine (the
+          suspicion EWMA crosses on the way to the trip — valid at the
+          fleet defaults, where ``suspicion_threshold`` <= the EWMA of
+          ``flag_min_count`` consecutive flags);
         * SLOWSTART → 1 slow-start warmup (goodput only — no failover,
-          drain or quarantine).
+          drain or quarantine);
+        * ADAPTIVE_POISON → 1 suspicion episode always; with
+          ``vote_k >= 2``: exactly ``vote_outvote_limit`` verdict votes
+          (sequential per suspect, every one outvoted — the attacker
+          corrupts every stream while active) then 1 drain +
+          1 quarantine; with ``vote_k == 0`` NOTHING else — the
+          sub-threshold attacker is the ladder's documented blind spot.
+          Additional validity: >= ``vote_k`` other replicas stay
+          admitting and the suspect keeps retiring requests until the
+          outvote limit lands.  ``vote_k == 1`` is rejected: a lone
+          voter can never outvote anyone (majority needs two agreeing
+          dissenters), so vote counts are traffic-bound, not pinnable.
         """
+        if vote_k == 1:
+            raise ValueError(
+                "vote_k=1 is not predictable (a lone voter can never "
+                "outvote — votes recur per suspect retirement); use "
+                "vote_k >= 2 for verdict quarantines or 0 for off"
+            )
         crashes = self.count(FaultKind.REPLICA_CRASH)
         stalls = self.count(FaultKind.REPLICA_STALL)
         poisons = self.count(FaultKind.REPLICA_POISON)
+        adaptive = self.count(FaultKind.REPLICA_ADAPTIVE_POISON)
+        if horizon is not None and cooloff_ticks is not None:
+            for event in self.events:
+                if event.kind not in (FaultKind.REPLICA_POISON,
+                                      FaultKind.REPLICA_ADAPTIVE_POISON):
+                    continue
+                # Conservative earliest quarantine = the event's own
+                # tick; if even that cool-off expires inside the
+                # horizon, the readmission probe of a still-poisoned
+                # replica re-trips and every pinned count below is
+                # wrong.  Loud, not silently off-by-a-probe.
+                if event.step + cooloff_ticks < horizon:
+                    raise ValueError(
+                        f"predict_fleet validity bound: {event.kind.value}"
+                        f" at tick {event.step} with cooloff_ticks="
+                        f"{cooloff_ticks} expires at tick "
+                        f"{event.step + cooloff_ticks}, inside the "
+                        f"horizon {horizon} — the readmission probe "
+                        "re-trips and adds a drain + quarantine per "
+                        "probe; pin quarantine_cooloff_ticks past the "
+                        "drill or heal the replica first"
+                    )
+        caught = adaptive if vote_k >= 2 else 0
         return {
             "crashes": crashes,
             "restarts": crashes,
             "stalls": stalls,
             "poisons": poisons,
+            "adaptive_poisons": adaptive,
             "slowstarts": self.count(FaultKind.REPLICA_SLOWSTART),
             "failover_episodes": crashes + stalls,
-            "drains": stalls + poisons,
-            "quarantines": poisons,
+            "suspicions": poisons + adaptive,
+            "votes": caught * vote_outvote_limit,
+            "outvotes": caught * vote_outvote_limit,
+            "drains": stalls + poisons + caught,
+            "quarantines": poisons + caught,
         }

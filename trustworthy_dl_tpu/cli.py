@@ -482,6 +482,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "remaining deadline drops below this "
                              "(first completed attempt wins; the loser "
                              "is cancelled and recorded hedge_lost)")
+    parser.add_argument("--vote-k", type=int, default=0,
+                        help="fleet only: cross-replica verdict voting "
+                             "— replay a SUSPECTED replica's completed "
+                             "requests on this many other replicas and "
+                             "majority-vote the streams token-for-token "
+                             "(README §Fleet/'Adversarial scenarios'); "
+                             "0 disables (default), >= 2 needed for "
+                             "outvote quarantines")
+    parser.add_argument("--vote-outvote-limit", type=int, default=2,
+                        help="fleet only: outvoted verdicts before the "
+                             "suspected replica enters the drain -> "
+                             "quarantine ladder")
     parser.add_argument("--trace-max-bytes", type=int, default=0,
                         help="rotate trace.jsonl once it exceeds this "
                              "many bytes (trace.1.jsonl, ...; 0 = no "
@@ -712,6 +724,8 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
             num_replicas=args.fleet_replicas,
             hedge_deadline_s=(args.hedge_deadline_ms / 1e3
                               if args.hedge_deadline_ms else None),
+            vote_k=args.vote_k,
+            vote_outvote_limit=args.vote_outvote_limit,
         ),
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
@@ -746,7 +760,8 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
     for key in ("statuses", "completed_tokens", "replica_states", "ticks",
                 "fleet_failovers", "fleet_hedges", "fleet_drains",
                 "fleet_quarantines", "fleet_restarts",
-                "replica_slo_active"):
+                "fleet_suspicions", "fleet_votes", "fleet_outvotes",
+                "replica_suspicion", "replica_slo_active"):
         if key in summary:
             print(f"  {key}: {summary[key]}")
     if obs_session is not None:

@@ -802,7 +802,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="measure the detection envelope (attack type "
                         "x intensity matrix) instead of a single "
                         "experiment")
+    parser.add_argument("--serve-envelope", action="store_true",
+                        help="measure the SERVE-side detection envelope "
+                        "(adaptive attacker strength x monitor threshold "
+                        "x vote K against a ServingFleet) instead of a "
+                        "single experiment")
     args = parser.parse_args(argv)
+
+    if args.serve_envelope:
+        from trustworthy_dl_tpu.experiments.serve_envelope import (
+            run_serve_envelope,
+        )
+
+        kwargs: Dict[str, Any] = {}
+        if args.output_dir:
+            kwargs["output_dir"] = args.output_dir
+        results = run_serve_envelope(**kwargs)
+        caught = sum(1 for c in results["cells"]
+                     if c["detected_by"] != "none")
+        print(f"Serve envelope: {len(results['cells'])} cells "
+              f"({caught} detected) in {results['wall_time_s']:.1f}s")
+        return 0
 
     if args.envelope:
         from trustworthy_dl_tpu.experiments.envelope import (

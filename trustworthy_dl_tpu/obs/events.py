@@ -79,6 +79,12 @@ class EventType(str, enum.Enum):
     REPLICA_TRANSITION = "replica_transition"
     FLEET_FAILOVER = "fleet_failover"
     FLEET_HEDGE = "fleet_hedge"
+    # Adversarial serving tier: the suspicion episode below the
+    # quarantine threshold (sustained sub-threshold flag rate, anomaly
+    # episode, or attribution irregularity) and each cross-replica
+    # verdict vote's resolution.
+    FLEET_SUSPICION = "fleet_suspicion"
+    VERDICT_VOTE = "verdict_vote"
     # Performance tier (obs/compilewatch.py, hbm.py, sentinel.py):
     # every XLA compilation, compile-once contract violations, live-HBM
     # sweeps/pressure denials, and perf-ledger regressions.
@@ -158,6 +164,18 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     },
     EventType.FLEET_HEDGE: {"requires": ("request_id",),
                             "fields": ("replica",)},
+    # Suspicion is replica-keyed (an episode, not a request); a verdict
+    # vote correlates on the FLEET request id it replayed and names the
+    # suspected replica, the outcome (confirmed/outvoted/inconclusive)
+    # and the ballot split.
+    EventType.FLEET_SUSPICION: {
+        "requires": (),
+        "fields": ("replica", "score", "reason"),
+    },
+    EventType.VERDICT_VOTE: {
+        "requires": ("request_id",),
+        "fields": ("replica", "outcome", "agree", "dissent"),
+    },
     # Performance tier.  ``compile`` rows are per-XLA-compilation (key =
     # the jax.monitoring stage, seconds = backend compile wall time);
     # ``compile_storm`` marks a post-warmup recompile inside a guarded

@@ -96,7 +96,10 @@ class ServeRequest:
     newest — id and is shed again first under sustained pressure;
     fleet fail-over depends on this).  ``span_parent`` re-parents the
     request's ``serve.request`` span under an outer span (the fleet's
-    per-attempt span, so one request's timeline survives fail-over)."""
+    per-attempt span, so one request's timeline survives fail-over).
+    ``publish_prefix=False`` keeps the request's prompt blocks OUT of
+    the shared PrefixCache — the fleet's verdict-vote replays are
+    transient audits that must not perturb cache state."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -108,6 +111,7 @@ class ServeRequest:
     priority: int = 0
     first_submit_id: Optional[int] = None
     span_parent: Optional[int] = None
+    publish_prefix: bool = True
 
 
 @dataclasses.dataclass
@@ -615,6 +619,7 @@ class ServingEngine:
             temperature=float(request.temperature),
             keys=request_key_stream(rng, int(request.max_new_tokens)),
             eos_id=request.eos_id,
+            publish_prefix=bool(request.publish_prefix),
         )
         self._queue.append((task, request))
         self._submit_t[request_id] = time.perf_counter()

@@ -53,6 +53,30 @@ Streaming caveat: ``on_token`` fires for the PRIMARY attempt's tokens
 as they are produced — after a fail-over the new attempt re-streams
 from token 0, and a winning hedge's tokens may never have streamed
 (at-least-once streaming; exactly-once is the retired result/record).
+
+Adversarial tier (README §Fleet/"Adversarial scenarios"): below the
+flag-rate quarantine threshold sits a **suspicion** tier — an
+EWMA-smoothed score over monitor verdicts (plus anomaly-watcher
+episodes and explicit :meth:`ServingFleet.note_suspicion` boosts for
+attribution irregularities) that emits ``fleet_suspicion`` events and
+the ``tddl_fleet_suspicion{replica=}`` gauge even with voting disabled.
+With ``FleetConfig.vote_k >= 1``, a completed request retiring on a
+suspected (but sub-threshold) replica triggers a **cross-replica
+verdict vote**: the request is replayed on K other replicas (replay is
+bit-identical by construction — every attempt reuses the request's own
+rng key) and the streams are majority-voted token-for-token via the
+attribution ``token_hash``, without retaining full streams.  A replica
+whose stream is outvoted (a >= 2-strong majority of replays agree with
+each other AND against it) ``vote_outvote_limit`` times enters the
+existing drain → quarantine ladder — an adaptive attacker holding its
+flag rate just under ``flag_rate_quarantine`` is caught by
+*disagreement* instead of flag rate.  A lone faulty voter can never
+quarantine a clean replica: outvoting requires two agreeing dissenting
+ballots, so a single lying replay only earns ITSELF suspicion.  Vote
+replays never stream to the user, never publish their prompt blocks to
+the replica's PrefixCache (``publish_prefix=False``), and are ledgered
+``admitted: false, status: "vote_replay"`` — exactly one admitted
+record per fleet id still holds.
 """
 
 from __future__ import annotations
@@ -123,6 +147,28 @@ class FleetConfig:
     # -- per-replica watcher attachment (SLO/anomaly watchers as extra
     # degraded-signals; host-only, no registry gauges per replica)
     attach_watchers: bool = False
+    # -- suspicion tier BELOW the quarantine threshold: an EWMA over
+    # monitor verdicts (1 = flagged) per slot-side retirement.  A
+    # replica is SUSPECTED once the score crosses suspicion_threshold
+    # and it has accumulated suspicion_min_flags lifetime flags this
+    # generation (or an explicit note_suspicion boost) — sustained
+    # sub-threshold flagging, not one unlucky request.  Suspicion emits
+    # fleet_suspicion + the tddl_fleet_suspicion{replica=} gauge even
+    # with voting off.
+    suspicion_ewma_alpha: float = 0.2
+    suspicion_threshold: float = 0.1
+    suspicion_min_flags: int = 2
+    # -- cross-replica verdict voting (0 = off): replay a suspected
+    # replica's completed requests on vote_k other replicas and
+    # majority-vote the streams token-for-token by token_hash.  One
+    # vote in flight per suspect, launched quorum-or-nothing;
+    # vote_outvote_limit outvotes send the replica down the drain ->
+    # quarantine ladder.  vote_k >= 2 is needed for any verdict (a
+    # lone ballot can never form a majority, so clean replicas are
+    # safe from a single faulty voter by construction; vote_k == 1
+    # votes resolve "inconclusive").
+    vote_k: int = 0
+    vote_outvote_limit: int = 2
 
     def __post_init__(self) -> None:
         if self.num_replicas < 1:
@@ -138,6 +184,15 @@ class FleetConfig:
             raise ValueError("max_retries/backoff_base_ticks must be >= 0")
         if self.backoff_mult < 1.0:
             raise ValueError("backoff_mult must be >= 1")
+        if not 0.0 < self.suspicion_ewma_alpha <= 1.0:
+            raise ValueError("suspicion_ewma_alpha must be in (0, 1]")
+        if not 0.0 < self.suspicion_threshold < 1.0:
+            raise ValueError("suspicion_threshold must be in (0, 1)")
+        if self.suspicion_min_flags < 1:
+            raise ValueError("suspicion_min_flags must be >= 1")
+        if self.vote_k < 0 or self.vote_outvote_limit < 1:
+            raise ValueError("vote_k must be >= 0 and "
+                             "vote_outvote_limit >= 1")
 
 
 def backoff_ticks(cfg: FleetConfig, attempt: int) -> int:
@@ -170,6 +225,21 @@ class _Attempt:
     submit_t: float
     span: Optional[int] = None     # fleet.attempt span id
     loser: bool = False            # cancelled as hedge/dedup loser
+
+
+@dataclasses.dataclass
+class _Vote:
+    """One in-flight cross-replica verdict vote (one per suspect at a
+    time).  ``ballots`` maps voter replica -> replay token_hash
+    (None = abstained: the replay failed, was cancelled, or its replica
+    crashed); the vote resolves once ``pending`` empties."""
+
+    fid: int
+    target: int                    # the suspected replica under audit
+    original_hash: str             # the canonical stream's token_hash
+    ballots: Dict[int, Optional[str]] = dataclasses.field(
+        default_factory=dict)
+    pending: Set[int] = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -211,6 +281,27 @@ class _Replica:
         self.quarantine_pending = False
         self.reason = ""
         self.flags: Deque[int] = deque(maxlen=flag_window)
+        # -- suspicion tier (EWMA over verdicts + explicit boosts) --
+        self.suspicion = 0.0
+        self.total_flags = 0        # lifetime flags this generation
+        self.suspicion_noted = False  # note_suspicion() boost received
+        self.suspicion_episode = False  # currently suspected (hysteresis)
+        # -- verdict voting --
+        self.outvotes = 0
+        self.vote_open = False      # one vote in flight per suspect
+
+    def reset_trust_window(self) -> None:
+        """Fresh trust evidence for a fresh generation (rebuild /
+        readmission probe): the window, the suspicion score and the
+        outvote tally all start over — re-conviction must come from new
+        behaviour, not stale history."""
+        self.flags.clear()
+        self.suspicion = 0.0
+        self.total_flags = 0
+        self.suspicion_noted = False
+        self.suspicion_episode = False
+        self.outvotes = 0
+        self.vote_open = False
 
     @property
     def journal_key(self) -> str:
@@ -223,6 +314,12 @@ class _Replica:
     @property
     def flag_rate(self) -> float:
         return self.flag_count / len(self.flags) if self.flags else 0.0
+
+    def ladder_tripped(self, cfg: "FleetConfig") -> bool:
+        """ONE spelling of the flag-rate trip predicate (shared by the
+        supervision pass and the vote tier's ladder-ownership guard)."""
+        return (self.flag_count >= cfg.flag_min_count
+                and self.flag_rate >= cfg.flag_rate_quarantine)
 
 
 class ServingFleet:
@@ -284,6 +381,24 @@ class ServingFleet:
             "Replica lifecycle transitions, by destination state",
             labels=("to_state",),
         )
+        # Adversarial tier: the sub-threshold suspicion score per
+        # replica (an adversary holding its flag rate under the
+        # quarantine threshold still moves THIS gauge), suspicion
+        # episodes, and verdict votes by outcome.
+        self._suspicion_gauge = registry.gauge(
+            "tddl_fleet_suspicion",
+            "EWMA suspicion score per replica (sub-threshold tier)",
+            labels=("replica",),
+        )
+        self._suspicion_counter = registry.counter(
+            "tddl_fleet_suspicions_total",
+            "Suspicion episodes opened (score crossed the threshold)",
+        )
+        self._vote_counter = registry.counter(
+            "tddl_fleet_votes_total",
+            "Cross-replica verdict votes resolved, by outcome",
+            labels=("outcome",),
+        )
         # Fleet-wide occupancy aggregates, refreshed every tick.  The
         # ENGINE serve gauges (tddl_serve_blocks_in_use, ...) carry a
         # ``replica=`` label in fleet mode (the fleet threads
@@ -316,10 +431,20 @@ class ServingFleet:
         # Drill-facing recovery counters (diffed against predict_fleet).
         self.counters: Dict[str, int] = {
             "crashes": 0, "restarts": 0, "stalls": 0, "poisons": 0,
-            "slowstarts": 0, "failover_episodes": 0, "drains": 0,
+            "adaptive_poisons": 0, "slowstarts": 0,
+            "failover_episodes": 0, "drains": 0,
             "quarantines": 0, "readmissions": 0, "failovers": 0,
             "hedges": 0, "hedge_lost": 0,
+            "suspicions": 0, "votes": 0, "outvotes": 0,
         }
+        # Verdict-vote working state: (voter replica, engine-local id)
+        # -> the vote its replay ballots into.  Vote replays never enter
+        # _local2fleet — they are audits, not fleet requests.
+        self._vote_ballots: Dict[Tuple[int, int], _Vote] = {}
+        # Deferred drain resubmissions; normally armed inside
+        # _supervise, but a vote-triggered drain can queue moves from
+        # terminal processing too, so the list outlives one pass.
+        self._drain_moves: List[Tuple[int, int, str]] = []
         self.replicas: List[_Replica] = []
         for i in range(self.config.num_replicas):
             self.replicas.append(self._build_replica(i))
@@ -387,7 +512,7 @@ class ServingFleet:
         rep = prev if prev is not None else _Replica(
             index, engine, self.config.flag_window)
         rep.engine = engine
-        rep.flags.clear()
+        rep.reset_trust_window()
         self.journals[rep.journal_key] = self._engine_journal(engine)
         # Geometry limits for submit-time validation, captured ONCE so
         # impossible requests fail in submit() even when every engine is
@@ -593,9 +718,10 @@ class ServingFleet:
     def run_until_idle(self, max_ticks: int = 100_000
                        ) -> Dict[int, FleetResult]:
         """Drive ``step()`` until every submitted request is terminal
-        (or ``max_ticks`` trips — the liveness backstop)."""
+        AND every verdict-vote ballot has resolved (or ``max_ticks``
+        trips — the liveness backstop)."""
         ticks = 0
-        while any(not r.done for r in self.requests.values()):
+        while self.busy:
             self.step()
             ticks += 1
             if ticks >= max_ticks:
@@ -628,6 +754,12 @@ class ServingFleet:
                 # The injector keeps the persistent signal overwrite;
                 # the monitor flag-rate ladder does the rest.
                 self.counters["poisons"] += 1
+            elif event.kind is FaultKind.REPLICA_ADAPTIVE_POISON:
+                # The injector's attached adversary owns the corruption
+                # and its strength controller; the suspicion tier +
+                # verdict voting do the catching (the flag-rate ladder
+                # never trips by the attacker's design).
+                self.counters["adaptive_poisons"] += 1
             elif event.kind is FaultKind.REPLICA_SLOWSTART:
                 # Warm-up only makes sense for a replica IN service: a
                 # quarantined/draining replica must keep its ladder
@@ -678,6 +810,19 @@ class ServingFleet:
                 })
             self._schedule_failover(rec, from_replica=rep.index,
                                     reason="crash")
+        # Vote ballots the dead engine held abstain (the vote must not
+        # wait forever on a replica that no longer exists)...
+        for key in [k for k in self._vote_ballots if k[0] == rep.index]:
+            vote = self._vote_ballots.pop(key)
+            vote.pending.discard(rep.index)
+            vote.ballots[rep.index] = None
+            if not vote.pending:
+                self._resolve_vote(vote)
+        # ...and votes TARGETING the dead replica are abandoned: the
+        # generation (and the stream under audit) is gone, so a stale
+        # verdict must never convict the successor — nor leak a second
+        # concurrent vote once the rebuild resets ``vote_open``.
+        self._abandon_votes_targeting(rep.index)
         rep.engine = None
         if rep.quarantine_pending:
             # The suspect replica died mid-drain: impound it — the
@@ -712,6 +857,15 @@ class ServingFleet:
 
     def _on_terminal(self, replica: int, result: ServeResult,
                      placement: Optional[dict]) -> None:
+        vote = self._vote_ballots.pop((replica, result.request_id), None)
+        if vote is not None:
+            # A verdict-vote replay, not a fleet request: record the
+            # ballot (abstain unless it completed) and resolve once the
+            # last voter reports.  Replays never feed the voter's flag
+            # window — they are audit traffic, and a poisoned VOTER is
+            # caught by its dissent, not by double-scoring.
+            self._on_vote_ballot(vote, replica, result)
+            return
         fid = self._local2fleet.pop((replica, result.request_id), None)
         if fid is None:
             return  # already accounted (crash bookkeeping ran first)
@@ -814,6 +968,7 @@ class ServingFleet:
             self.spans.end(rec.span_root, status=result.status,
                            replica=att.replica, attempts=rec.submissions,
                            tokens=len(result.tokens))
+        self._maybe_vote(rec, result, att)
 
     def _finalize_unserved(self, rec: _FleetRequest, status: str) -> None:
         """Terminal without a serving attempt left: deadline ran out
@@ -926,9 +1081,19 @@ class ServingFleet:
                 # resubmission carries the drain reason.
                 self._drain_moves.append((fid, rep.index, reason))
 
+    def _start_trust_drain(self, rep: _Replica, reason: str) -> None:
+        """ONE spelling of the trust-driven drain entry (flag-rate trip
+        AND verdict outvote): transition, arm the quarantine, migrate
+        the queue now — in-flight gets the grace window."""
+        self._transition(rep, ReplicaState.DRAINING, reason)
+        rep.quarantine_pending = True
+        self._migrate(rep, rep.engine.queued_ids,
+                      status="migrated", reason="drain")
+
     def _supervise(self) -> None:
         cfg = self.config
-        self._drain_moves: List[Tuple[int, int, str]] = []
+        # NOTE: _drain_moves is NOT reset here — a vote-triggered drain
+        # queues moves from terminal processing before this pass runs.
         for rep in self.replicas:
             if rep.state is ReplicaState.RESTARTING:
                 if self.tick >= rep.warm_until:
@@ -949,7 +1114,11 @@ class ServingFleet:
                     # a still-poisoned replica re-flags and goes back
                     # with a doubled cool-off.
                     self.counters["readmissions"] += 1
-                    rep.flags.clear()
+                    # Any vote straggler from the PRE-quarantine
+                    # generation dies with the evidence window: the
+                    # probe must be judged on fresh behaviour only.
+                    self._abandon_votes_targeting(rep.index)
+                    rep.reset_trust_window()
                     rep.warm_until = self.tick + cfg.restart_ticks
                     self._transition(rep, ReplicaState.RESTARTING,
                                      "readmission_probe")
@@ -957,20 +1126,33 @@ class ServingFleet:
             if rep.engine is None:
                 continue
             missed = self.tick - rep.last_progress_tick
-            trip = (rep.flag_count >= cfg.flag_min_count
-                    and rep.flag_rate >= cfg.flag_rate_quarantine)
+            trip = rep.ladder_tripped(cfg)
             watcher_bad = (
                 (rep.engine.slo is not None and rep.engine.slo.breached)
                 or (rep.engine.anomaly is not None
                     and rep.engine.anomaly.any_active))
+            if watcher_bad and rep.state in (ReplicaState.HEALTHY,
+                                             ReplicaState.DEGRADED):
+                # Anomaly/SLO-watcher episodes feed the suspicion tier
+                # too: a replica can be suspected (and vote-audited)
+                # without a single monitor flag.
+                self.note_suspicion(rep.index, "watcher")
             if rep.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
                 if trip:
-                    self._transition(rep, ReplicaState.DRAINING,
-                                     "monitor_flag_rate")
-                    rep.quarantine_pending = True
-                    # Queue moves now; in-flight gets the grace window.
-                    self._migrate(rep, rep.engine.queued_ids,
-                                  status="migrated", reason="drain")
+                    self._start_trust_drain(rep, "monitor_flag_rate")
+                elif (getattr(rep.engine, "in_service_capacity", None)
+                        == 0 and rep.engine.load):
+                    # Every slot impounded by per-request monitor
+                    # quarantines: the replica cannot serve its queue
+                    # and the flag evidence is already decisive at
+                    # engine granularity.  Without this a SUB-threshold
+                    # attacker (window rate below the ladder trip, but
+                    # flags trickling in) starves its replica's queue
+                    # forever — the fleet drives engine.step() directly
+                    # and never hits the engine's own run_until_idle
+                    # starvation shed.
+                    self._start_trust_drain(rep,
+                                            "slot_quarantine_exhausted")
                 elif missed >= cfg.heartbeat_miss_limit:
                     self._transition(rep, ReplicaState.DRAINING,
                                      "heartbeat")
@@ -1026,9 +1208,219 @@ class ServingFleet:
 
     def observe_retirement(self, replica: int, flagged: bool) -> None:
         """Feed one retirement's monitor verdict into the replica's
-        flag-rate window (called from the terminal processing path)."""
-        if 0 <= replica < len(self.replicas):
-            self.replicas[replica].flags.append(1 if flagged else 0)
+        flag-rate window AND the EWMA suspicion score (called from the
+        terminal processing path).  The post-observation flag rate is
+        public (gauges) — it is also what an adaptive adversary steers
+        by, so the chaos feedback hook gets exactly the same number."""
+        if not 0 <= replica < len(self.replicas):
+            return
+        rep = self.replicas[replica]
+        rep.flags.append(1 if flagged else 0)
+        if flagged:
+            rep.total_flags += 1
+        a = self.config.suspicion_ewma_alpha
+        rep.suspicion = (1.0 - a) * rep.suspicion + a * (
+            1.0 if flagged else 0.0)
+        self._suspicion_gauge.set(rep.suspicion, replica=str(rep.index))
+        self._update_suspicion_episode(rep, reason="flag_rate")
+        if self.chaos is not None and hasattr(self.chaos,
+                                              "on_flag_observed"):
+            self.chaos.on_flag_observed(replica, flagged, rep.flag_rate)
+
+    def note_suspicion(self, replica: int, reason: str,
+                       weight: float = 1.0) -> None:
+        """Raise a replica's suspicion from a NON-flag signal — an
+        anomaly-watcher episode (wired in ``_supervise``) or an
+        attribution irregularity a reconciliation job attributes to the
+        replica.  Folded into the same EWMA the flag verdicts feed, and
+        marks the replica eligible for suspicion without
+        ``suspicion_min_flags`` flag evidence."""
+        if not 0 <= replica < len(self.replicas):
+            return
+        rep = self.replicas[replica]
+        a = self.config.suspicion_ewma_alpha
+        rep.suspicion = min(1.0,
+                            (1.0 - a) * rep.suspicion + a * float(weight))
+        rep.suspicion_noted = True
+        self._suspicion_gauge.set(rep.suspicion, replica=str(rep.index))
+        self._update_suspicion_episode(rep, reason=reason)
+
+    def _update_suspicion_episode(self, rep: _Replica,
+                                  reason: str) -> None:
+        cfg = self.config
+        suspected = (rep.suspicion >= cfg.suspicion_threshold
+                     and (rep.total_flags >= cfg.suspicion_min_flags
+                          or rep.suspicion_noted))
+        if suspected and not rep.suspicion_episode:
+            rep.suspicion_episode = True
+            self.counters["suspicions"] += 1
+            self._suspicion_counter.inc()
+            logger.warning("fleet: replica %d SUSPECTED (score %.3f, "
+                           "flag rate %.3f, %s)", rep.index,
+                           rep.suspicion, rep.flag_rate, reason)
+            if self.trace is not None:
+                self.trace.emit(EventType.FLEET_SUSPICION,
+                                replica=rep.index,
+                                score=round(rep.suspicion, 4),
+                                reason=reason,
+                                flag_rate=round(rep.flag_rate, 4),
+                                tick=self.tick)
+        elif (rep.suspicion_episode
+              and rep.suspicion < cfg.suspicion_threshold / 2.0
+              and rep.outvotes == 0):
+            # Hysteresis: the episode closes only once the score decays
+            # well below the threshold, so a borderline replica doesn't
+            # open a fresh episode (and counter tick) per retirement.
+            # An outvote on record PINS the episode open: a replica a
+            # verdict has already gone against must stay under audit
+            # until the ladder resolves (or a fresh generation resets
+            # it) — otherwise an attacker could take one outvote, go
+            # signal-quiet while still corrupting tokens, wait out the
+            # EWMA decay, and never face the deciding vote.
+            rep.suspicion_episode = False
+
+    # -- cross-replica verdict voting --------------------------------------
+
+    def _maybe_vote(self, rec: _FleetRequest, result: ServeResult,
+                    att: _Attempt) -> None:
+        """Launch a verdict vote for a completed request that retired on
+        a SUSPECTED (but still admitting — i.e. sub-threshold) replica:
+        replay it on up to ``vote_k`` other admitting replicas with the
+        request's own rng key.  One vote in flight per suspect keeps
+        audit cost bounded and drill counts exact."""
+        cfg = self.config
+        if cfg.vote_k < 1 or result.status != "completed":
+            return
+        rep = self.replicas[att.replica]
+        if (not rep.suspicion_episode or rep.vote_open
+                or rep.state not in ADMITTING or rep.engine is None):
+            return
+        if rep.ladder_tripped(cfg):
+            return  # the flag-rate ladder owns it this tick
+        voters = sorted(
+            (r for r in self.replicas
+             if r.index != rep.index and r.state in ADMITTING
+             and r.engine is not None),
+            key=lambda r: (r.engine.load, r.index),
+        )[:cfg.vote_k]
+        if not voters:
+            return
+        accepted: List[Tuple[_Replica, int]] = []
+        for voter in voters:
+            local = voter.engine.submit(ServeRequest(
+                prompt=rec.prompt, max_new_tokens=rec.max_new_tokens,
+                temperature=rec.temperature, eos_id=rec.eos_id,
+                rng=rec.rng, priority=rec.priority,
+                # Audit semantics: no user stream, no deadline, and the
+                # replay's prompt blocks never enter the PrefixCache.
+                publish_prefix=False,
+            ))
+            if local is not None:
+                accepted.append((voter, local))
+        if len(accepted) < min(cfg.vote_k, 2):
+            # Quorum-or-nothing launch: a vote that cannot seat at
+            # least two ballots (one at vote_k=1) could never convict
+            # and would punish whoever dissented alone — abandon the
+            # partial launch (backpressure) and retry at the suspect's
+            # next retirement.
+            for voter, local in accepted:
+                voter.engine.cancel(local, status="vote_abandoned")
+            return
+        vote = _Vote(fid=rec.fid, target=rep.index,
+                     original_hash=attribution.token_hash(result.tokens))
+        for voter, local in accepted:
+            vote.pending.add(voter.index)
+            self._vote_ballots[(voter.index, local)] = vote
+        rep.vote_open = True
+        self.counters["votes"] += 1
+
+    def _abandon_votes_targeting(self, index: int) -> None:
+        """Drop every outstanding verdict vote whose TARGET generation
+        is being torn down (crash rebuild, readmission probe): cancel
+        the replay ballots and forget the vote — no counters, no
+        outcome.  Without this, ``reset_trust_window`` clearing
+        ``vote_open`` would let a fresh generation open a SECOND
+        concurrent vote while the stale one still resolves against
+        evidence from a pool that no longer exists."""
+        stale = [(key, vote) for key, vote in self._vote_ballots.items()
+                 if vote.target == index]
+        for (voter, local), _vote in stale:
+            self._vote_ballots.pop((voter, local), None)
+            rep = self.replicas[voter]
+            if rep.engine is not None:
+                rep.engine.cancel(local, status="vote_abandoned")
+
+    def _on_vote_ballot(self, vote: _Vote, replica: int,
+                        result: ServeResult) -> None:
+        vote.pending.discard(replica)
+        completed = result.status == "completed"
+        replay_hash = attribution.token_hash(result.tokens)
+        vote.ballots[replica] = replay_hash if completed else None
+        if self.ledger is not None:
+            # The replay is evidence, not service: admitted False keeps
+            # the one-admitted-record-per-fleet-id invariant, and the
+            # hash is all the vote retains of the stream.
+            self.ledger.append({
+                "request_id": vote.fid, "status": "vote_replay",
+                "admitted": False, "replica": replica,
+                "vote_target": vote.target,
+                "tokens": len(result.tokens),
+                "token_hash": replay_hash,
+            })
+        if not vote.pending:
+            self._resolve_vote(vote)
+
+    def _resolve_vote(self, vote: _Vote) -> None:
+        """Majority-vote the streams token-for-token (by token_hash —
+        exact equality, no retained streams).  Outvoted = a dissenting
+        hash shared by >= 2 replays that also outnumbers the agreeing
+        ballots: a clean original beats any LONE faulty voter by
+        construction, and split dissent convicts nobody."""
+        cfg = self.config
+        rep = self.replicas[vote.target]
+        rep.vote_open = False
+        counted = {r: h for r, h in vote.ballots.items() if h is not None}
+        agree = [r for r, h in counted.items()
+                 if h == vote.original_hash]
+        dissent_by_hash: Dict[str, List[int]] = {}
+        for r, h in counted.items():
+            if h != vote.original_hash:
+                dissent_by_hash.setdefault(h, []).append(r)
+        top_dissent: List[int] = max(dissent_by_hash.values(),
+                                     key=len, default=[])
+        if len(counted) < 2:
+            # Below quorum (abstentions shrank the ballot set): nobody
+            # is convicted and nobody is suspected — one surviving
+            # voter's word alone is evidence of nothing.
+            outcome = "inconclusive"
+        elif len(top_dissent) >= 2 and len(top_dissent) > len(agree):
+            outcome = "outvoted"
+            self.counters["outvotes"] += 1
+            rep.outvotes += 1
+            self.note_suspicion(vote.target, "outvoted")
+            if (rep.outvotes >= cfg.vote_outvote_limit
+                    and rep.state in ADMITTING and rep.engine is not None):
+                # The suspect lost its Mth vote: same drain → quarantine
+                # ladder the flag-rate trip takes — disagreement is the
+                # verdict the sub-threshold attacker cannot tune away.
+                self._start_trust_drain(rep, "verdict_outvoted")
+        else:
+            outcome = "confirmed"
+            for h, voters in dissent_by_hash.items():
+                for voter in voters:
+                    # A minority dissenter disagreed with a confirmed
+                    # stream: that VOTER is now suspect (symmetric
+                    # catch for a lying replay replica).
+                    self.note_suspicion(voter, "vote_dissent")
+        self._vote_counter.inc(outcome=outcome)
+        logger.warning("fleet: verdict vote on request %d (replica %d): "
+                       "%s (agree %d, dissent %d)", vote.fid, vote.target,
+                       outcome, len(agree), len(top_dissent))
+        if self.trace is not None:
+            self.trace.emit(EventType.VERDICT_VOTE, request_id=vote.fid,
+                            replica=vote.target, outcome=outcome,
+                            agree=len(agree), dissent=len(top_dissent),
+                            outvotes=rep.outvotes, tick=self.tick)
 
     # -- retries + hedges --------------------------------------------------
 
@@ -1084,6 +1476,8 @@ class ServingFleet:
         load = 0
         for rep in self.replicas:
             by_state[rep.state] += 1
+            self._suspicion_gauge.set(rep.suspicion,
+                                      replica=str(rep.index))
             if rep.engine is not None:
                 load += rep.engine.load
                 sched = getattr(rep.engine, "scheduler", None)
@@ -1096,7 +1490,11 @@ class ServingFleet:
 
     @property
     def busy(self) -> bool:
-        return any(not r.done for r in self.requests.values())
+        # Outstanding vote ballots keep the loop live: a vote's replays
+        # must resolve (and their quarantine verdict land) even after
+        # the last user request retired.
+        return (any(not r.done for r in self.requests.values())
+                or bool(self._vote_ballots))
 
     def drain_results(self) -> Dict[int, FleetResult]:
         """Return finished results and clear them — the bounded-memory
@@ -1130,6 +1528,8 @@ class ServingFleet:
             "statuses": statuses,
             "completed_tokens": tokens,
             "replica_states": self.states(),
+            "replica_suspicion": {r.index: round(r.suspicion, 4)
+                                  for r in self.replicas},
             "ticks": self.tick,
             **{f"fleet_{k}": v for k, v in self.counters.items()},
         }

@@ -426,6 +426,11 @@ class SlotTask:
     # normal decode path resets it so a fallback tick after a spec tick
     # can never replay stale tokens.
     tick_tokens: Optional[List[int]] = None
+    # False = this task's completed prompt blocks are NEVER published to
+    # the shared PrefixCache (the fleet's verdict-vote replays are
+    # transient audits: they may READ cached prefixes, but must leave
+    # the cache exactly as they found it).
+    publish_prefix: bool = True
 
     @property
     def greedy(self) -> bool:
@@ -952,12 +957,14 @@ class PagedBatchingScheduler:
         task._record(int(token), float(ent), float(margin))
         self.lengths[slot] = st.plen
         del self._prefill[slot]
-        if self.prefix is not None:
+        if self.prefix is not None and task.publish_prefix:
             # The prompt's FULL blocks are now authoritative in the pool
             # — publish them so later same-prefix requests skip their
-            # prefill.  (Generated tokens are never cached.)  The newly
-            # cached ids are remembered: if THIS request is later
-            # flagged, its publications must leave the cache with it.
+            # prefill.  (Generated tokens are never cached; a
+            # publish_prefix=False audit replay caches nothing at all.)
+            # The newly cached ids are remembered: if THIS request is
+            # later flagged, its publications must leave the cache with
+            # it.
             self._published[slot] = self.prefix.insert(
                 task.prompt.tolist(),
                 self.tables[slot][:st.plen // self.block_size],
