@@ -42,7 +42,12 @@ chaos OFF vs ON over identical seeded workloads — "fleet" record key,
 TDDL_BENCH_FLEET_* knobs), TDDL_BENCH_ADVERSARY=1 (goodput under an
 adaptive sub-threshold poison attack, verdict voting OFF vs ON over
 identical seeded traffic — "adversary" record key,
-TDDL_BENCH_ADVERSARY_* knobs).
+TDDL_BENCH_ADVERSARY_* knobs), TDDL_BENCH_AUTOSCALE=1 (fleet control
+plane A/B: static fleet at max replicas vs autoscaled min→max over
+identical seeded bursty traffic — replica-count trace, scale event
+counts and per-class goodput per arm, "autoscale" record key,
+TDDL_BENCH_AUTOSCALE_* knobs; the fleet leg's rows also carry
+per-class goodput now).
 Infra knobs: TDDL_BENCH_PROBE_TIMEOUT (backend liveness probe seconds,
 default 180; a successful probe is cached for the process AND persisted
 to disk — TDDL_BENCH_PROBE_CACHE sets the file, default
@@ -893,7 +898,12 @@ def bench_fleet() -> "dict":
     requests that COMPLETED inside their deadline — the number the
     robustness layer is supposed to defend; the gap between the arms at
     each rate is the price of the injected failures after fail-over,
-    drain and quarantine have done their work.
+    drain and quarantine have done their work.  Each row also carries a
+    ``per_class`` breakdown (the fleet runs the default SLO-class
+    ladder, so the workload's tenant priorities map onto batch/
+    standard/premium): goodput-per-class curves show WHO paid for the
+    chaos — the control-plane contract is that the bottom class pays
+    first.
 
     Env: TDDL_BENCH_FLEET_MODEL (gpt2), TDDL_BENCH_FLEET_REPLICAS (3),
     TDDL_BENCH_FLEET_SLOTS (4, per replica), TDDL_BENCH_FLEET_SEQ (256),
@@ -905,6 +915,7 @@ def bench_fleet() -> "dict":
         FaultKind, FaultPlan
     from trustworthy_dl_tpu.models import gpt2
     from trustworthy_dl_tpu.serve import (
+        DEFAULT_SLO_CLASSES,
         FleetConfig,
         ServeRequest,
         ServingFleet,
@@ -956,7 +967,8 @@ def bench_fleet() -> "dict":
                 # quarantine-probe-quarantine churn tail.
                 fleet_config=FleetConfig(num_replicas=replicas,
                                          max_retries=6,
-                                         quarantine_cooloff_ticks=10 ** 6),
+                                         quarantine_cooloff_ticks=10 ** 6,
+                                         slo_classes=DEFAULT_SLO_CLASSES),
                 chaos=chaos, rng=jax.random.PRNGKey(1),
                 max_slots=max_slots, max_seq=max_seq,
                 queue_limit=n_requests,
@@ -967,6 +979,7 @@ def bench_fleet() -> "dict":
                 max_new_tokens=item.max_new_tokens,
                 temperature=0.8, priority=item.priority,
                 deadline_s=item.deadline_s,
+                tenant=item.tenant,
             ))
             wall = time.perf_counter() - t0
             summary = fleet.metrics_summary()
@@ -987,6 +1000,19 @@ def bench_fleet() -> "dict":
                 "quarantines": summary["fleet_quarantines"],
                 "restarts": summary["fleet_restarts"],
                 "wall_s": round(wall, 2),
+                # Goodput-per-class: completed requests/tokens (and the
+                # per-class goodput rate) for each SLO class this arm.
+                "per_class": {
+                    name: {
+                        "completed": cls["completed"],
+                        "tokens": cls["tokens"],
+                        "shed": cls["shed"],
+                        "goodput_tokens_per_s":
+                            round(cls["tokens"] / wall, 1)
+                            if wall > 0 else 0.0,
+                    }
+                    for name, cls in summary["per_class"].items()
+                },
             }
             arms[arm].append(row)
             log(f"fleet {arm:8s} offered={rate:6.1f} req/s: "
@@ -1048,6 +1074,7 @@ def bench_adversary() -> "dict":
         ServeRequest,
         ServingFleet,
         WorkloadConfig,
+        drive_closed_loop,
         generate_workload,
     )
 
@@ -1106,26 +1133,19 @@ def bench_adversary() -> "dict":
             monitor=MarginSignatureMonitor(monitor_th),
         )
         t0 = time.perf_counter()
-        pending = list(workload)
-        ticks = 0
-        while pending or fleet.busy:
-            while pending and sum(
-                    1 for r in fleet.requests.values()
-                    if not r.done) < inflight_target:
-                item = pending[0]
-                fid = fleet.submit(ServeRequest(
-                    prompt=list(item.prompt),
-                    max_new_tokens=item.max_new_tokens,
-                    temperature=0.8, priority=item.priority,
-                    deadline_s=item.deadline_s,
-                ))
-                if fid is None:
-                    break           # fleet-wide backpressure: next tick
-                pending.pop(0)
-            fleet.step()
-            ticks += 1
-            if ticks > 200_000:
-                raise RuntimeError("adversary bench arm did not drain")
+        # ONE spelling of the closed-loop bounded-queue driver, shared
+        # with the drills and the autoscale leg (serve/workload.py).
+        drive_closed_loop(
+            fleet, workload,
+            lambda item: ServeRequest(
+                prompt=list(item.prompt),
+                max_new_tokens=item.max_new_tokens,
+                temperature=0.8, priority=item.priority,
+                deadline_s=item.deadline_s,
+                tenant=item.tenant,
+            ),
+            inflight_target,
+        )
         wall = time.perf_counter() - t0
         summary = fleet.metrics_summary()
         statuses = summary["statuses"]
@@ -1161,6 +1181,140 @@ def bench_adversary() -> "dict":
         "max_slots_per_replica": max_slots,
         "requests_per_arm": n_requests,
         "vote_k": vote_k,
+        "arms": arms,
+    }
+
+
+def bench_autoscale() -> "dict":
+    """Autoscale A/B (TDDL_BENCH_AUTOSCALE=1): a STATIC fleet pinned at
+    ``max`` replicas vs an AUTOSCALED fleet breathing between ``min``
+    and ``max``, over IDENTICAL seeded bursty traffic (the closed-loop
+    bounded-queue driver — backpressure keeps the scaling decisions
+    engaged deterministically).
+
+    Reading it: the autoscaled arm's ``replica_trace`` is the replica
+    count over fleet ticks (scale-ups chase the bursts, scale-downs
+    drain the troughs); ``scale_ups``/``scale_downs`` count the control
+    actions; both arms report goodput and the per-class breakdown, so
+    the cost of breathing — goodput given up while warming — is read
+    directly against the static fleet's always-on capacity.
+
+    Env: TDDL_BENCH_AUTOSCALE_MODEL (gpt2),
+    TDDL_BENCH_AUTOSCALE_MIN (1), TDDL_BENCH_AUTOSCALE_MAX (3),
+    TDDL_BENCH_AUTOSCALE_SLOTS (4), TDDL_BENCH_AUTOSCALE_SEQ (256),
+    TDDL_BENCH_AUTOSCALE_REQUESTS (48), TDDL_BENCH_AUTOSCALE_SEED (0),
+    TDDL_BENCH_AUTOSCALE_INFLIGHT (default 3x slots)."""
+    import jax
+
+    from trustworthy_dl_tpu.serve import (
+        DEFAULT_SLO_CLASSES,
+        AutoscalerConfig,
+        FleetConfig,
+        ServeRequest,
+        ServingFleet,
+        WorkloadConfig,
+        drive_closed_loop,
+        generate_workload,
+    )
+    from trustworthy_dl_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_AUTOSCALE_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    n_min = int(os.environ.get("TDDL_BENCH_AUTOSCALE_MIN", "1"))
+    n_max = int(os.environ.get("TDDL_BENCH_AUTOSCALE_MAX", "3"))
+    max_slots = int(os.environ.get("TDDL_BENCH_AUTOSCALE_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_AUTOSCALE_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_AUTOSCALE_REQUESTS",
+                                    "48"))
+    seed = int(os.environ.get("TDDL_BENCH_AUTOSCALE_SEED", "0"))
+    inflight = int(os.environ.get("TDDL_BENCH_AUTOSCALE_INFLIGHT",
+                                  str(3 * max_slots)))
+
+    workload = generate_workload(
+        WorkloadConfig(seed=seed, num_requests=n_requests,
+                       burstiness=0.8),
+        cfg.vocab_size, max_seq,
+    )
+    arms: "dict[str, dict]" = {}
+    for arm in ("static", "autoscaled"):
+        autoscale = None
+        if arm == "autoscaled":
+            autoscale = AutoscalerConfig(
+                min_replicas=n_min, max_replicas=n_max,
+                scale_up_queue_per_replica=float(max_slots),
+                scale_down_queue_per_replica=max(max_slots / 8.0, 0.5),
+                scale_up_cooldown_ticks=8,
+                scale_down_cooldown_ticks=16,
+                scale_down_idle_ticks=8,
+            )
+        fleet = ServingFleet(
+            params, cfg,
+            fleet_config=FleetConfig(
+                num_replicas=(n_max if arm == "static" else n_min),
+                max_retries=6,
+                quarantine_cooloff_ticks=10 ** 6,
+                slo_classes=DEFAULT_SLO_CLASSES,
+                autoscale=autoscale,
+            ),
+            rng=jax.random.PRNGKey(1),
+            max_slots=max_slots, max_seq=max_seq,
+            queue_limit=n_requests,
+        )
+        t0 = time.perf_counter()
+        accepted = drive_closed_loop(
+            fleet, workload,
+            lambda item: ServeRequest(
+                prompt=list(item.prompt),
+                max_new_tokens=item.max_new_tokens,
+                temperature=0.8, priority=item.priority,
+                deadline_s=item.deadline_s, tenant=item.tenant,
+            ),
+            inflight,
+        )
+        # Let a trailing scale-down land before reading the trace: the
+        # drive exits at drain, the controller breathes a beat later.
+        for _ in range(64):
+            fleet.step()
+        wall = time.perf_counter() - t0
+        summary = fleet.metrics_summary()
+        statuses = summary["statuses"]
+        row = {
+            "accepted": accepted,
+            "completed": statuses.get("completed", 0),
+            "goodput_tokens_per_s":
+                round(summary["completed_tokens"] / wall, 1)
+                if wall > 0 else 0.0,
+            "scale_ups": summary["fleet_scale_ups"],
+            "scale_downs": summary["fleet_scale_downs"],
+            "replica_trace": summary.get(
+                "replica_trace",
+                [(0, n_max if arm == "static" else n_min)]),
+            "per_class": {
+                name: {
+                    "completed": cls["completed"],
+                    "tokens": cls["tokens"],
+                    "shed": cls["shed"],
+                    "goodput_tokens_per_s":
+                        round(cls["tokens"] / wall, 1)
+                        if wall > 0 else 0.0,
+                }
+                for name, cls in summary["per_class"].items()
+            },
+            "wall_s": round(wall, 2),
+        }
+        arms[arm] = row
+        log(f"autoscale {arm:10s}: goodput "
+            f"{row['goodput_tokens_per_s']:8.1f} tok/s, completed "
+            f"{row['completed']}/{n_requests}, scale_ups "
+            f"{row['scale_ups']}, scale_downs {row['scale_downs']}")
+    return {
+        "replicas_min": n_min,
+        "replicas_max": n_max,
+        "max_slots_per_replica": max_slots,
+        "requests_per_arm": n_requests,
+        "inflight_target": inflight,
         "arms": arms,
     }
 
@@ -1790,6 +1944,9 @@ def _inner_main() -> None:
     adversary_record = None
     if os.environ.get("TDDL_BENCH_ADVERSARY") == "1":
         adversary_record = bench_adversary()
+    autoscale_record = None
+    if os.environ.get("TDDL_BENCH_AUTOSCALE") == "1":
+        autoscale_record = bench_autoscale()
     chaos_records = None
     if os.environ.get("TDDL_BENCH_CHAOS") == "1":
         chaos_records = bench_chaos()
@@ -1829,6 +1986,8 @@ def _inner_main() -> None:
         record["fleet"] = fleet_record
     if adversary_record is not None:
         record["adversary"] = adversary_record
+    if autoscale_record is not None:
+        record["autoscale"] = autoscale_record
     if chaos_records is not None:
         record["chaos"] = chaos_records
     if async_records is not None:

@@ -377,16 +377,74 @@ def test_bench_fleet_records(monkeypatch, tmp_path):
         row = rows[0]
         for key in ("offered_rps", "goodput_tokens_per_s", "completed",
                     "deadline_exceeded", "shed", "failovers", "drains",
-                    "quarantines", "restarts", "wall_s"):
+                    "quarantines", "restarts", "wall_s", "per_class"):
             assert key in row, (arm, row)
         # Zero lost accepted requests in EITHER arm: every request is
         # accounted as completed, deadline-shed or explicitly shed.
         assert row["completed"] + row["deadline_exceeded"] \
             + row["shed"] == 6, (arm, row)
+        # Goodput-per-class curves (PR 13): the default ladder rides
+        # every row, and the per-class completions sum to the row's.
+        per_class = row["per_class"]
+        assert set(per_class) == {"batch", "standard", "premium"}
+        for cls in per_class.values():
+            for key in ("completed", "tokens", "shed",
+                        "goodput_tokens_per_s"):
+                assert key in cls, (arm, cls)
+        assert sum(c["completed"] for c in per_class.values()) \
+            == row["completed"], (arm, per_class)
     chaos_row = record["arms"]["chaos"][0]
     # The chaos arm really injected: recovery machinery engaged.
     assert chaos_row["restarts"] >= 1
     assert chaos_row["failovers"] + chaos_row["drains"] >= 1
+
+
+@pytest.mark.fleetctl
+def test_bench_autoscale_records(monkeypatch, tmp_path):
+    """bench_autoscale's static-vs-autoscaled A/B on a tiny model:
+    IDENTICAL seeded bursty traffic, the static arm pinned at max
+    replicas, the autoscaled arm breathing min->max.  The record
+    carries the replica-count trace, the scale-event counts and the
+    per-class goodput the contract publishes — and the autoscaled arm
+    really scaled (trace leaves the floor) while serving every
+    accepted request."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_PROBE_CACHE",
+                       str(tmp_path / "probe.json"))
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_MIN", "1")
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_MAX", "2")
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_REQUESTS", "10")
+    monkeypatch.setenv("TDDL_BENCH_AUTOSCALE_INFLIGHT", "8")
+    record = bench.bench_autoscale()
+    assert record["replicas_min"] == 1 and record["replicas_max"] == 2
+    assert set(record["arms"]) == {"static", "autoscaled"}
+    for arm, row in record["arms"].items():
+        for key in ("accepted", "completed", "goodput_tokens_per_s",
+                    "scale_ups", "scale_downs", "replica_trace",
+                    "per_class", "wall_s"):
+            assert key in row, (arm, row)
+        assert row["completed"] == row["accepted"] == 10
+        assert sum(c["completed"] for c in row["per_class"].values()) \
+            == row["completed"]
+    static, auto = record["arms"]["static"], record["arms"]["autoscaled"]
+    # The static arm never scales; the autoscaled arm's trace shows the
+    # breath (up under the closed-loop pressure, back down at drain).
+    assert static["scale_ups"] == static["scale_downs"] == 0
+    assert auto["scale_ups"] >= 1
+    counts = [n for _, n in auto["replica_trace"]]
+    assert counts[0] == 1 and max(counts) == 2
+    assert auto["scale_downs"] >= 1 and counts[-1] == 1
 
 
 @pytest.mark.adversary

@@ -873,6 +873,27 @@ def test_adversary_surface_inside_the_lint_perimeter():
     assert 'labels=("outcome",)' in src
 
 
+def test_control_plane_surface_inside_the_lint_perimeter():
+    """PR 13 extension: the fleet control-plane event types (autoscaler
+    actions + tenant throttles) carry full schemas — the emit lint +
+    validate_event cover them like every other type — and the new
+    metric surface keeps the ``tddl_`` naming contract via literal
+    names the metric-name lint scans, with the labels dashboards key
+    on (tenant / direction / slo_class)."""
+    assert EVENT_SCHEMAS[EventType.FLEET_SCALE]["fields"] == \
+        ("direction", "from_replicas", "to_replicas", "reason")
+    assert EVENT_SCHEMAS[EventType.TENANT_THROTTLE]["fields"] == \
+        ("tenant", "tokens", "bucket_level")
+    src = (REPO / "trustworthy_dl_tpu" / "serve" / "fleet.py").read_text()
+    for name in ("tddl_fleet_tenant_throttled_total",
+                 "tddl_fleet_scale_events_total",
+                 "tddl_fleet_class_queue_depth"):
+        assert f'"{name}"' in src, name
+    assert 'labels=("tenant",)' in src
+    assert 'labels=("direction",)' in src
+    assert 'labels=("slo_class",)' in src
+
+
 def test_perf_tier_events_and_metrics_inside_the_lint_perimeter():
     """PR 10 extension: the performance-tier event types carry full
     schemas (so the emit lint + validate_event cover them like every

@@ -85,7 +85,9 @@ class FaultInjector:
                             scheduled_step=event.step,
                             severity=event.severity,
                             target=(event.target if event.target >= 0
-                                    else None))
+                                    else None),
+                            **({"tenant": event.tenant}
+                               if event.tenant else {}))
         return event
 
     def _take_at(self, step: int, kind: FaultKind) -> Optional[FaultEvent]:
@@ -231,6 +233,17 @@ class FaultInjector:
             for event in self.plan.of_kind(kind):
                 if event.step <= tick and event not in self.fired:
                     self._fire(event, tick)
+                    if kind is FaultKind.TENANT_FLOOD:
+                        # Overload fault: the FLEET runs the burst
+                        # through its admission path (token buckets
+                        # throttle, classes schedule, the autoscaler
+                        # reacts) — the injector only schedules it.
+                        logger.warning(
+                            "chaos: tenant flood (%d requests from %r) "
+                            "at tick %d", max(int(event.severity), 1),
+                            event.tenant or "flood", tick)
+                        out.append(event)
+                        continue
                     logger.warning("chaos: %s on replica %d at tick %d",
                                    kind.value, event.target, tick)
                     if kind is FaultKind.REPLICA_POISON:

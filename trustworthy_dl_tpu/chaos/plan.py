@@ -89,13 +89,25 @@ class FaultKind(str, enum.Enum):
     #: disagree with their bit-identical replays.  Persists until
     #: :meth:`FaultInjector.heal_replica`.
     REPLICA_ADAPTIVE_POISON = "replica_adaptive_poison"
+    #: Overload-as-a-fault: at fleet tick ``step``, tenant ``tenant``
+    #: (default "flood") bursts ``severity`` requests through the
+    #: fleet's admission path in one tick.  With a per-tenant token
+    #: bucket configured (``FleetConfig.tenant_quota``) the bucket
+    #: admits what it can pay for and THROTTLES the rest — loudly
+    #: (``tenant_throttle`` events +
+    #: ``tddl_fleet_tenant_throttled_total{tenant=}``) — so the flood
+    #: backpressures itself, not the fleet; admitted flood requests are
+    #: real accepted work and drive the autoscaler like any burst.  The
+    #: replica ``target`` is meaningless for this kind (-1).
+    TENANT_FLOOD = "tenant_flood"
 
 
 #: The serving-fleet kinds (consumed by ``FaultInjector.on_fleet_tick``
 #: / ``on_serve_retire`` rather than the trainer hooks).
 FLEET_KINDS = (FaultKind.REPLICA_CRASH, FaultKind.REPLICA_STALL,
                FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART,
-               FaultKind.REPLICA_ADAPTIVE_POISON)
+               FaultKind.REPLICA_ADAPTIVE_POISON,
+               FaultKind.TENANT_FLOOD)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,12 +118,15 @@ class FaultEvent:
     ``REPLICA_*`` kinds.  ``severity`` is kind-specific (stall
     seconds/ticks, poison magnitude, slow-start warmup ticks); unused
     kinds ignore it.  ``target`` addresses a replica (fleet kinds and
-    replica-gated serve poison); ``-1`` = unaddressed (any replica)."""
+    replica-gated serve poison); ``-1`` = unaddressed (any replica).
+    ``tenant`` names the flooding tenant for ``TENANT_FLOOD`` (None =
+    the fleet's default flood tenant); other kinds ignore it."""
 
     step: int
     kind: FaultKind
     severity: float = 1.0
     target: int = -1
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,7 +164,11 @@ class FaultPlan:
         # Fixed kind order (enum declaration order) keeps the draw stream
         # stable across python versions / dict orderings.
         kinds = [k for k in FaultKind if rates.get(k, 0.0) > 0.0]
-        if num_replicas is None and any(k in FLEET_KINDS for k in kinds):
+        # TENANT_FLOOD is fleet-granularity but tenant-addressed, not
+        # replica-addressed — it needs no target draw.
+        addressed = [k for k in kinds
+                     if k in FLEET_KINDS and k is not FaultKind.TENANT_FLOOD]
+        if num_replicas is None and addressed:
             raise ValueError(
                 "fleet fault rates need num_replicas to draw targets"
             )
@@ -157,7 +176,7 @@ class FaultPlan:
             for kind in kinds:
                 if rng.random() < rates[kind]:
                     target = (int(rng.integers(num_replicas))
-                              if kind in FLEET_KINDS else -1)
+                              if kind in addressed else -1)
                     events.append(FaultEvent(
                         step=step, kind=kind,
                         severity=float(severity * (0.5 + rng.random())),
@@ -202,7 +221,10 @@ class FaultPlan:
 
     def predict_fleet(self, vote_k: int = 0, vote_outvote_limit: int = 2,
                       horizon: Optional[int] = None,
-                      cooloff_ticks: Optional[int] = None
+                      cooloff_ticks: Optional[int] = None,
+                      autoscale: bool = False,
+                      quota_tokens: Optional[float] = None,
+                      flood_request_tokens: Optional[int] = None
                       ) -> Dict[str, int]:
         """Expected ``ServingFleet`` recovery counts for this plan's
         REPLICA_* events (the serving mirror of :meth:`predict`).
@@ -246,6 +268,23 @@ class FaultPlan:
           outvote limit lands.  ``vote_k == 1`` is rejected: a lone
           voter can never outvote anyone (majority needs two agreeing
           dissenters), so vote counts are traffic-bound, not pinnable.
+        * TENANT_FLOOD → 1 tenant_flood; with a token bucket
+          (``quota_tokens`` = the flooding tenant's bucket capacity,
+          ``flood_request_tokens`` = the fleet's per-flood-request cost
+          ``flood_prompt_len + flood_new_tokens``) each event throttles
+          exactly ``severity - quota_tokens // flood_request_tokens``
+          submissions (floored at 0).  Valid when flood events are
+          *isolated*: the bucket sits at capacity when each fires
+          (events spaced >= capacity / refill ticks apart) and no other
+          traffic spends the flooding tenant's bucket.  With
+          ``autoscale=True`` each flood additionally trips exactly ONE
+          scale-up and ONE scale-down — valid when the admitted burst
+          crosses the scale-up predicate (and the background traffic
+          never does), ``max_replicas - min_replicas`` equals the flood
+          count (the bound absorbs repeat pressure), and the run idles
+          past the drain + ``scale_down_idle_ticks`` + cool-down so
+          every extra replica retires back to the floor.  Scale-downs
+          drain, so they are COUNTED in ``drains`` too.
         """
         if vote_k == 1:
             raise ValueError(
@@ -279,6 +318,22 @@ class FaultPlan:
                         "drill or heal the replica first"
                     )
         caught = adaptive if vote_k >= 2 else 0
+        floods = self.of_kind(FaultKind.TENANT_FLOOD)
+        throttles = 0
+        if quota_tokens is not None:
+            if not flood_request_tokens or flood_request_tokens < 1:
+                raise ValueError(
+                    "quota_tokens needs flood_request_tokens (the "
+                    "fleet's flood_prompt_len + flood_new_tokens) to "
+                    "pin throttle counts"
+                )
+            per_event = int(quota_tokens) // int(flood_request_tokens)
+            for event in floods:
+                # Same floor as the fleet's _run_flood: a sub-1
+                # severity still bursts one request.
+                n = max(int(event.severity), 1)
+                throttles += max(0, n - per_event)
+        scale_events = len(floods) if autoscale else 0
         return {
             "crashes": crashes,
             "restarts": crashes,
@@ -290,6 +345,10 @@ class FaultPlan:
             "suspicions": poisons + adaptive,
             "votes": caught * vote_outvote_limit,
             "outvotes": caught * vote_outvote_limit,
-            "drains": stalls + poisons + caught,
+            "drains": stalls + poisons + caught + scale_events,
             "quarantines": poisons + caught,
+            "tenant_floods": len(floods),
+            "throttles": throttles,
+            "scale_ups": scale_events,
+            "scale_downs": scale_events,
         }

@@ -494,6 +494,47 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         help="fleet only: outvoted verdicts before the "
                              "suspected replica enters the drain -> "
                              "quarantine ladder")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="fleet only: autoscaler floor — enables "
+                             "the closed-loop control plane (with "
+                             "--autoscale-max): replica count breathes "
+                             "between min and max from queue depth, "
+                             "occupancy, ITL-p99 and SLO burn with "
+                             "hysteresis; scale-up builds replicas "
+                             "through the HBM headroom gate, "
+                             "scale-down drains (in-flight runs out, "
+                             "never killed).  --fleet-replicas is the "
+                             "starting count and must sit inside "
+                             "[min, max] (default min: --fleet-"
+                             "replicas)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="fleet only: autoscaler ceiling (enables "
+                             "autoscaling when > --fleet-replicas or "
+                             "with --autoscale-min)")
+    parser.add_argument("--tenant-quota", type=int, default=None,
+                        help="fleet only: per-tenant token-bucket "
+                             "capacity (a submission costs prompt + "
+                             "max_new tokens against its tenant's "
+                             "bucket; over-budget submissions are "
+                             "throttled loudly — tenant_throttle "
+                             "events + tddl_fleet_tenant_throttled_"
+                             "total{tenant=} — so a flooding tenant "
+                             "backpressures itself, not the fleet)")
+    parser.add_argument("--tenant-quota-refill", type=float,
+                        default=None,
+                        help="fleet only: bucket refill in tokens per "
+                             "fleet tick (default: capacity / 64)")
+    parser.add_argument("--slo-class", action="append", default=None,
+                        metavar="NAME:PRIO:TTFT_MS:ITL_MS:WEIGHT",
+                        help="fleet only, repeatable: define an SLO "
+                             "class (priority orders shedding — "
+                             "higher sheds last; weight scales the "
+                             "deficit-round-robin share; TTFT_MS/"
+                             "ITL_MS are per-class targets, '-' = "
+                             "untracked).  Workload tenant priorities "
+                             "map onto the class ladder.  The single "
+                             "value 'default' installs the built-in "
+                             "batch/standard/premium ladder")
     parser.add_argument("--trace-max-bytes", type=int, default=0,
                         help="rotate trace.jsonl once it exceeds this "
                              "many bytes (trace.1.jsonl, ...; 0 = no "
@@ -599,10 +640,17 @@ def serve_main(argv: Optional[List[str]] = None,
         # pool headroom gate, cost ledger + perf fingerprint at exit.
         obs_session.enable_compile_watch()
         obs_session.enable_hbm()
-    if args.fleet_replicas > 1:
+    control_knobs = (args.autoscale_min is not None
+                     or args.autoscale_max is not None
+                     or args.tenant_quota is not None
+                     or bool(args.slo_class))
+    if args.fleet_replicas > 1 or control_knobs:
         # Fleet mode builds PER-REPLICA watchers from the SLO flags (a
         # breach is a replica-local signal) — the session-level watcher
         # pair stays uninstalled rather than sitting attached-but-unfed.
+        # ANY control-plane knob routes here too (quotas, classes and
+        # autoscaling live in the fleet's tick loop — a 1-replica fleet
+        # enforces them fine, silently ignoring them would not).
         return _serve_fleet(args, trainer, cfg, serve_config, obs_session)
     if obs_session is not None:
         from trustworthy_dl_tpu.obs.slo import default_serve_rules
@@ -688,6 +736,66 @@ def serve_main(argv: Optional[List[str]] = None,
     return 0
 
 
+def _parse_slo_classes(specs):
+    """``--slo-class NAME:PRIO:TTFT_MS:ITL_MS:WEIGHT`` (repeatable;
+    '-' leaves a latency target untracked; the single spec 'default'
+    installs the built-in ladder).  Raises ValueError with the exact
+    offending spec — an operator typo must fail before any model
+    work."""
+    if not specs:
+        return None
+    from trustworthy_dl_tpu.serve import DEFAULT_SLO_CLASSES, SLOClass
+
+    if len(specs) == 1 and specs[0].strip().lower() == "default":
+        return DEFAULT_SLO_CLASSES
+    classes = []
+    for spec in specs:
+        parts = spec.split(":")
+        if len(parts) != 5:
+            raise ValueError(
+                f"--slo-class {spec!r}: expected "
+                "NAME:PRIO:TTFT_MS:ITL_MS:WEIGHT (use '-' for an "
+                "untracked target), or the single value 'default'")
+        name, prio, ttft, itl, weight = (p.strip() for p in parts)
+        try:
+            classes.append(SLOClass(
+                name=name, priority=int(prio),
+                ttft_target_s=(None if ttft in ("-", "")
+                               else float(ttft) / 1e3),
+                itl_target_s=(None if itl in ("-", "")
+                              else float(itl) / 1e3),
+                weight=float(weight),
+            ))
+        except ValueError as exc:
+            raise ValueError(f"--slo-class {spec!r}: {exc}")
+    return tuple(classes)
+
+
+def _parse_autoscale(args):
+    """--autoscale-min/--autoscale-max -> AutoscalerConfig (None when
+    neither is given).  --fleet-replicas is the STARTING count and must
+    sit inside the bounds."""
+    if args.autoscale_min is None and args.autoscale_max is None:
+        return None
+    from trustworthy_dl_tpu.serve import AutoscalerConfig
+
+    lo = (args.autoscale_min if args.autoscale_min is not None
+          else args.fleet_replicas)
+    hi = (args.autoscale_max if args.autoscale_max is not None
+          else max(args.fleet_replicas, lo))
+    if not lo <= args.fleet_replicas <= hi:
+        raise ValueError(
+            f"--fleet-replicas {args.fleet_replicas} must start inside "
+            f"the autoscale bounds [{lo}, {hi}]")
+    return AutoscalerConfig(
+        min_replicas=lo, max_replicas=hi,
+        scale_up_queue_per_replica=float(args.max_slots),
+        scale_down_queue_per_replica=max(args.max_slots / 8.0, 0.5),
+        itl_p99_target_s=(args.slo_itl_ms / 1e3
+                          if args.obs_dir else None),
+    )
+
+
 def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
     """The ``--fleet-replicas N`` serve path: a ServingFleet over the
     seeded workload generator (bursty arrivals, heavy-tailed lengths,
@@ -716,6 +824,23 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
             ttft_target_s=args.slo_ttft_ms / 1e3,
             itl_target_s=args.slo_itl_ms / 1e3,
         )
+    # Control plane knobs (serve/control.py), all opt-in.
+    try:
+        slo_classes = _parse_slo_classes(args.slo_class)
+        autoscale = _parse_autoscale(args)
+        tenant_quota = None
+        if args.tenant_quota is not None:
+            from trustworthy_dl_tpu.serve import TenantQuotaConfig
+
+            refill = (args.tenant_quota_refill
+                      if args.tenant_quota_refill is not None
+                      else args.tenant_quota / 64.0)
+            tenant_quota = TenantQuotaConfig(
+                capacity_tokens=args.tenant_quota,
+                refill_per_tick=refill)
+    except ValueError as exc:
+        print(f"control plane: {exc}")
+        return 2
     # One source of truth for the serving knobs: the SAME validated
     # ServeConfig the single-engine path uses, via from_config.
     fleet = ServingFleet.from_config(
@@ -726,6 +851,9 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
                               if args.hedge_deadline_ms else None),
             vote_k=args.vote_k,
             vote_outvote_limit=args.vote_outvote_limit,
+            slo_classes=slo_classes,
+            tenant_quota=tenant_quota,
+            autoscale=autoscale,
         ),
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
@@ -753,7 +881,13 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
         temperature=args.temperature, priority=item.priority,
         deadline_s=(deadline if deadline is not None
                     else item.deadline_s),
+        tenant=item.tenant,
     ))
+    if fleet.autoscaler is not None:
+        # Give a trailing scale-down room to land: the replay exits at
+        # drain, the controller breathes a beat later.
+        for _ in range(64):
+            fleet.step()
     summary = fleet.metrics_summary()
     print(f"fleet served {submitted} request(s) on "
           f"{args.fleet_replicas} replica(s) x {args.max_slots} slot(s)")
@@ -761,6 +895,10 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
                 "fleet_failovers", "fleet_hedges", "fleet_drains",
                 "fleet_quarantines", "fleet_restarts",
                 "fleet_suspicions", "fleet_votes", "fleet_outvotes",
+                "fleet_tenant_floods", "fleet_throttles",
+                "fleet_scale_ups", "fleet_scale_downs",
+                "replicas_in_service", "replica_trace",
+                "per_class", "class_queue_depth",
                 "replica_suspicion", "replica_slo_active"):
         if key in summary:
             print(f"  {key}: {summary[key]}")

@@ -85,6 +85,12 @@ class EventType(str, enum.Enum):
     # verdict vote's resolution.
     FLEET_SUSPICION = "fleet_suspicion"
     VERDICT_VOTE = "verdict_vote"
+    # Fleet control plane (serve/control.py wired into serve/fleet.py):
+    # each autoscaler action (replica count change, either direction)
+    # and each per-tenant token-bucket throttle (a submission the
+    # flooding tenant's own bucket refused).
+    FLEET_SCALE = "fleet_scale"
+    TENANT_THROTTLE = "tenant_throttle"
     # Performance tier (obs/compilewatch.py, hbm.py, sentinel.py):
     # every XLA compilation, compile-once contract violations, live-HBM
     # sweeps/pressure denials, and perf-ledger regressions.
@@ -175,6 +181,18 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     EventType.VERDICT_VOTE: {
         "requires": ("request_id",),
         "fields": ("replica", "outcome", "agree", "dissent"),
+    },
+    # Control plane: a scale event names the direction, both replica
+    # counts and the signal that drove it; a throttle names the tenant,
+    # the token cost the bucket refused and the bucket's level.
+    EventType.FLEET_SCALE: {
+        "requires": (),
+        "fields": ("direction", "from_replicas", "to_replicas",
+                   "reason"),
+    },
+    EventType.TENANT_THROTTLE: {
+        "requires": (),
+        "fields": ("tenant", "tokens", "bucket_level"),
     },
     # Performance tier.  ``compile`` rows are per-XLA-compilation (key =
     # the jax.monitoring stage, seconds = backend compile wall time);

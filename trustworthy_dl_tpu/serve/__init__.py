@@ -43,6 +43,13 @@ construction with automatic fallback to the model-dtype pool (README
 """
 
 from trustworthy_dl_tpu.core.config import ServeConfig
+from trustworthy_dl_tpu.serve.control import (
+    DEFAULT_SLO_CLASSES,
+    AutoscalerConfig,
+    PredictiveArmConfig,
+    SLOClass,
+    TenantQuotaConfig,
+)
 from trustworthy_dl_tpu.serve.engine import (
     OutputMonitor,
     ServeRequest,
@@ -60,6 +67,7 @@ from trustworthy_dl_tpu.serve.workload import (
     Tenant,
     WorkloadConfig,
     WorkloadItem,
+    drive_closed_loop,
     generate_workload,
     replay_workload,
 )
@@ -83,15 +91,19 @@ from trustworthy_dl_tpu.serve.scheduler import (
 )
 
 __all__ = [
+    "AutoscalerConfig",
     "BlockAllocator",
     "ContinuousBatchingScheduler",
+    "DEFAULT_SLO_CLASSES",
     "FleetConfig",
     "FleetResult",
     "OutputMonitor",
     "PagedBatchingScheduler",
     "PagedKV",
+    "PredictiveArmConfig",
     "PrefixCache",
     "ReplicaState",
+    "SLOClass",
     "ServeConfig",
     "ServeRequest",
     "ServeResult",
@@ -100,11 +112,13 @@ __all__ = [
     "SlotAllocator",
     "SlotKV",
     "Tenant",
+    "TenantQuotaConfig",
     "WorkloadConfig",
     "WorkloadItem",
     "backoff_ticks",
     "choose_bucket",
     "default_buckets",
+    "drive_closed_loop",
     "generate_workload",
     "init_paged_pool",
     "init_slots",

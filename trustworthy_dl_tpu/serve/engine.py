@@ -99,7 +99,10 @@ class ServeRequest:
     per-attempt span, so one request's timeline survives fail-over).
     ``publish_prefix=False`` keeps the request's prompt blocks OUT of
     the shared PrefixCache — the fleet's verdict-vote replays are
-    transient audits that must not perturb cache state."""
+    transient audits that must not perturb cache state.  ``tenant``
+    is the end-to-end tenant identity: it rides the attribution-ledger
+    record and the ``serve.request`` span, and the FLEET's per-tenant
+    token buckets meter admission by it (None = untagged)."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -112,6 +115,7 @@ class ServeRequest:
     first_submit_id: Optional[int] = None
     span_parent: Optional[int] = None
     publish_prefix: bool = True
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -632,6 +636,7 @@ class ServingEngine:
                                     parent_id=request.span_parent,
                                     request_id=request_id,
                                     replica=self.replica_id,
+                                    tenant=request.tenant,
                                     prompt_len=int(prompt.size),
                                     max_new_tokens=int(
                                         request.max_new_tokens))
@@ -686,7 +691,8 @@ class ServingEngine:
             request_id=rid,
         )
 
-    def _ledger_unadmitted(self, rid: int, status: str) -> None:
+    def _ledger_unadmitted(self, rid: int, status: str,
+                           tenant: Optional[str] = None) -> None:
         if self.ledger is None:
             return
         self.ledger.append({
@@ -698,6 +704,7 @@ class ServingEngine:
             "kv_fallback_reason": self.kv_fallback_reason,
             "flagged": False, "monitor_z": 0.0,
             "tokens": 0, "token_hash": attribution.token_hash([]),
+            "tenant": tenant,
         })
 
     def _request_age_id(self, task: SlotTask, request: ServeRequest) -> int:
@@ -743,7 +750,7 @@ class ServingEngine:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=rid,
                             status="shed_slo", tokens=0, admitted=False, **self._trace_tags)
         self._close_request_spans(rid, "shed_slo")
-        self._ledger_unadmitted(rid, "shed_slo")
+        self._ledger_unadmitted(rid, "shed_slo", tenant=_request.tenant)
 
     # -- iteration loop ----------------------------------------------------
 
@@ -902,7 +909,7 @@ class ServingEngine:
             if (idle_before and not self._inflight
                     and self._queue and len(self._queue) == qlen):
                 while self._queue:
-                    task, _ = self._queue.popleft()
+                    task, request = self._queue.popleft()
                     rid = task.request_id
                     self._submit_t.pop(rid, None)
                     self._record_result(ServeResult(
@@ -915,7 +922,8 @@ class ServingEngine:
                                         status="no_capacity", tokens=0,
                                         admitted=False, **self._trace_tags)
                     self._close_request_spans(rid, "no_capacity")
-                    self._ledger_unadmitted(rid, "no_capacity")
+                    self._ledger_unadmitted(rid, "no_capacity",
+                                            tenant=request.tenant)
                 break
             if it >= max_iterations:
                 raise RuntimeError(
@@ -949,7 +957,8 @@ class ServingEngine:
                                     status="deadline_exceeded", tokens=0,
                                     admitted=False, **self._trace_tags)
                 self._close_request_spans(rid, "deadline_exceeded")
-                self._ledger_unadmitted(rid, "deadline_exceeded")
+                self._ledger_unadmitted(rid, "deadline_exceeded",
+                                        tenant=request.tenant)
             else:
                 keep.append((task, request))
         self._queue = keep
@@ -976,7 +985,8 @@ class ServingEngine:
                                 request_id=request_id, status=status,
                                 tokens=0, admitted=False, **self._trace_tags)
             self._close_request_spans(request_id, status)
-            self._ledger_unadmitted(request_id, status)
+            self._ledger_unadmitted(request_id, status,
+                                    tenant=_request.tenant)
             return True
         pair = self._inflight.get(request_id)
         if pair is None:
@@ -1008,6 +1018,7 @@ class ServingEngine:
                 "tokens": len(task.emitted),
                 "token_hash": attribution.token_hash(task.emitted),
                 "ttft_s": ttft,
+                "tenant": _request.tenant,
             })
         self._close_request_spans(request_id, status,
                                   tokens=len(task.emitted))
@@ -1081,6 +1092,7 @@ class ServingEngine:
                 "flagged": bool(flagged), "monitor_z": float(z),
                 "tokens": len(task.emitted), "token_hash": thash,
                 "ttft_s": ttft,
+                "tenant": request.tenant,
             }
             self.ledger.append(record)
             if self.trace is not None:
@@ -1120,6 +1132,12 @@ class ServingEngine:
     def load(self) -> int:
         """Queued + in-flight — the fleet router's least-loaded key."""
         return len(self._queue) + len(self._inflight)
+
+    @property
+    def open_requests(self) -> int:
+        """Accepted-but-unfinished requests — the closed-loop driver's
+        in-flight count (engine spelling of the fleet property)."""
+        return self.load
 
     @property
     def in_service_capacity(self) -> int:

@@ -77,6 +77,34 @@ replays never stream to the user, never publish their prompt blocks to
 the replica's PrefixCache (``publish_prefix=False``), and are ledgered
 ``admitted: false, status: "vote_replay"`` — exactly one admitted
 record per fleet id still holds.
+
+Control plane (README §Fleet/"Control plane", serve/control.py): the
+closed loop ROADMAP item 4 calls for, every piece opt-in via
+``FleetConfig`` so the PR 8 fleet is unchanged by default.  (1) An
+**autoscaler** drives the replica count between ``min_replicas`` and
+``max_replicas`` from queue depth per replica, pool occupancy, the
+fleet-wide ITL p99 and SLO burn — the FLEET aggregates, not the
+last-writer per-engine gauges — plus a predictive arm that anticipates
+the workload generator's seeded diurnal envelope.  Hysteresis is a
+threshold band + per-direction cool-downs + a sustained-idle streak;
+scale-up builds a replica through the existing HBM headroom gate and
+warms through RESTARTING; scale-down always DRAINS (queue migrates,
+in-flight runs out — never force-migrated, never killed) into the new
+RETIRED state, whose journal is retained and whose index the next
+scale-up revives as a fresh generation.  (2) **Per-tenant token-bucket
+admission**: a submission costs prompt + max_new tokens against its
+tenant's bucket (refilled per TICK — deterministic drills); a flooding
+tenant throttles ITSELF, loudly (``tenant_throttle`` events +
+``tddl_fleet_tenant_throttled_total{tenant=}``), while untagged
+traffic is exempt.  (3) **SLO-class weighted-fair scheduling**:
+submissions queue at the fleet in per-class deficit-round-robin queues
+(token-cost fairness) and dispatch to engines each tick; under a
+per-class TTFT/ITL breach the LOWEST class sheds first — replacing the
+raw lowest-priority shed.  Overload is drillable like crash or poison:
+``FaultKind.TENANT_FLOOD`` bursts a tenant through the real admission
+path, and ``FaultPlan.predict_fleet(autoscale=, quota_tokens=,
+flood_request_tokens=)`` pins the exact throttle and scale-up/-down
+counts.
 """
 
 from __future__ import annotations
@@ -108,6 +136,8 @@ class ReplicaState(str, enum.Enum):
     DRAINING = "draining"        # no admissions; slots run out or migrate
     QUARANTINED = "quarantined"  # out of service, cool-off running
     RESTARTING = "restarting"    # warming up (restart/probe/slow-start)
+    RETIRED = "retired"          # scaled in (autoscaler); pool released,
+    #                              journal retained, index reusable
 
 
 #: States the router may place new work on.
@@ -169,6 +199,36 @@ class FleetConfig:
     # votes resolve "inconclusive").
     vote_k: int = 0
     vote_outvote_limit: int = 2
+    # -- control plane (serve/control.py; ALL opt-in — the defaults
+    # leave the PR 8 fleet byte-for-byte unchanged) --
+    #: SLO classes (tuple of control.SLOClass): submissions queue at the
+    #: FLEET in per-class deficit-round-robin queues and dispatch to
+    #: engines by token-weighted fairness; under a per-class latency
+    #: breach the LOWEST class sheds first.  None = legacy direct
+    #: submit (requests go straight to a replica).
+    slo_classes: Optional[Tuple[Any, ...]] = None
+    class_queue_limit: int = 256       # per-class fleet queue bound
+    drr_quantum_tokens: int = 32       # DRR quantum (tokens per round)
+    class_latency_min_count: int = 8   # observations before a breach
+    #: Per-tenant token-bucket admission (control.TenantQuotaConfig):
+    #: a submission costs prompt + max_new tokens against its tenant's
+    #: bucket; over-budget submissions are throttled loudly.  None =
+    #: no quotas.  Requests with tenant=None bypass quota (untagged
+    #: traffic is the operator's own).
+    tenant_quota: Optional[Any] = None
+    #: Autoscaler (control.AutoscalerConfig): drives the replica count
+    #: between min/max from queue depth, occupancy, ITL-p99, SLO burn
+    #: and the predictive arm, with hysteresis + cool-downs.  Scale-up
+    #: builds a replica through the existing HBM headroom gate;
+    #: scale-down always DRAINS (queue migrates, in-flight runs out).
+    #: None = static fleet.
+    autoscale: Optional[Any] = None
+    #: TENANT_FLOOD request shape: each flood submission is
+    #: prompt [0] * flood_prompt_len, max_new = flood_new_tokens, so a
+    #: flood request costs flood_prompt_len + flood_new_tokens bucket
+    #: tokens (predict_fleet's flood_request_tokens).
+    flood_prompt_len: int = 4
+    flood_new_tokens: int = 4
 
     def __post_init__(self) -> None:
         if self.num_replicas < 1:
@@ -193,6 +253,20 @@ class FleetConfig:
         if self.vote_k < 0 or self.vote_outvote_limit < 1:
             raise ValueError("vote_k must be >= 0 and "
                              "vote_outvote_limit >= 1")
+        if self.class_queue_limit < 1 or self.drr_quantum_tokens < 1 \
+                or self.class_latency_min_count < 1:
+            raise ValueError("class_queue_limit, drr_quantum_tokens and "
+                             "class_latency_min_count must be >= 1")
+        if self.flood_prompt_len < 1 or self.flood_new_tokens < 1:
+            raise ValueError("flood_prompt_len and flood_new_tokens "
+                             "must be >= 1")
+        if self.autoscale is not None and not (
+                self.autoscale.min_replicas <= self.num_replicas
+                <= self.autoscale.max_replicas):
+            raise ValueError(
+                f"num_replicas={self.num_replicas} must start inside "
+                f"the autoscale bounds [{self.autoscale.min_replicas}, "
+                f"{self.autoscale.max_replicas}]")
 
 
 def backoff_ticks(cfg: FleetConfig, attempt: int) -> int:
@@ -215,6 +289,8 @@ class FleetResult:
     ttft_s: Optional[float]        # FIRST fleet submit -> first token
     flagged: bool = False
     monitor_z: float = 0.0
+    tenant: Optional[str] = None   # end-to-end tenant identity
+    slo_class: Optional[str] = None  # class it was scheduled under
 
 
 @dataclasses.dataclass
@@ -262,6 +338,9 @@ class _FleetRequest:
     hedged: bool = False
     done: bool = False
     span_root: Optional[int] = None
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
+    cost: int = 0                  # prompt + max_new (bucket/DRR tokens)
 
 
 class _Replica:
@@ -279,6 +358,7 @@ class _Replica:
         self.cooloff_ticks = 0      # current cool-off length (doubles)
         self.drain_deadline = -1
         self.quarantine_pending = False
+        self.retire_pending = False  # scale-down drain: retire at empty
         self.reason = ""
         self.flags: Deque[int] = deque(maxlen=flag_window)
         # -- suspicion tier (EWMA over verdicts + explicit boosts) --
@@ -414,6 +494,23 @@ class ServingFleet:
             "tddl_fleet_queue_depth",
             "Queued + in-flight requests, summed over live replicas",
         )
+        # Control plane (serve/control.py): throttles by tenant, scale
+        # events by direction, per-class fleet-queue depth.
+        self._throttle_counter = registry.counter(
+            "tddl_fleet_tenant_throttled_total",
+            "Submissions throttled by the per-tenant token bucket",
+            labels=("tenant",),
+        )
+        self._scale_counter = registry.counter(
+            "tddl_fleet_scale_events_total",
+            "Autoscaler replica-count changes, by direction",
+            labels=("direction",),
+        )
+        self._classq_gauge = registry.gauge(
+            "tddl_fleet_class_queue_depth",
+            "Fleet admission-queue depth, by SLO class",
+            labels=("slo_class",),
+        )
         self.tick = 0
         self._next_fid = 0
         self.rejected = 0
@@ -427,6 +524,7 @@ class ServingFleet:
         #: journal key ("replica:gen") -> BlockAllocator — RETAINED
         #: across restarts so records naming a dead generation's blocks
         #: still reconcile (the post-mortem journal, not the live pool).
+        #: RETIRED (scaled-in) generations keep theirs the same way.
         self.journals: Dict[str, Any] = {}
         # Drill-facing recovery counters (diffed against predict_fleet).
         self.counters: Dict[str, int] = {
@@ -436,6 +534,8 @@ class ServingFleet:
             "quarantines": 0, "readmissions": 0, "failovers": 0,
             "hedges": 0, "hedge_lost": 0,
             "suspicions": 0, "votes": 0, "outvotes": 0,
+            "tenant_floods": 0, "throttles": 0,
+            "scale_ups": 0, "scale_downs": 0,
         }
         # Verdict-vote working state: (voter replica, engine-local id)
         # -> the vote its replay ballots into.  Vote replays never enter
@@ -445,9 +545,47 @@ class ServingFleet:
         # _supervise, but a vote-triggered drain can queue moves from
         # terminal processing too, so the list outlives one pass.
         self._drain_moves: List[Tuple[int, int, str]] = []
+        # -- control plane (serve/control.py; every piece opt-in) --
+        from trustworthy_dl_tpu.serve.control import (
+            Autoscaler,
+            ClassLatencyTracker,
+            ClassQueues,
+            TenantBuckets,
+            class_for_priority,
+        )
+
+        self._class_for_priority = class_for_priority
+        cfg = self.config
+        self._classes = tuple(cfg.slo_classes) if cfg.slo_classes else None
+        self._classq = None
+        self._class_latency = None
+        self._class_stats: Dict[str, Dict[str, int]] = {}
+        if self._classes:
+            self._classq = ClassQueues(
+                self._classes, quantum_tokens=cfg.drr_quantum_tokens,
+                per_class_limit=cfg.class_queue_limit)
+            self._class_latency = ClassLatencyTracker(
+                self._classes, min_count=cfg.class_latency_min_count)
+            self._class_stats = {
+                c.name: {"completed": 0, "tokens": 0, "shed": 0}
+                for c in self._classes}
+        self._buckets = (TenantBuckets(cfg.tenant_quota)
+                         if cfg.tenant_quota is not None else None)
+        self.autoscaler = (Autoscaler(cfg.autoscale)
+                           if cfg.autoscale is not None else None)
+        # Fleet-wide completed-request ITL sketch: the autoscaler's
+        # latency signal (per-class sketches serve the shed predicate).
+        from trustworthy_dl_tpu.obs.slo import StreamingPercentiles
+
+        self._itl_est = StreamingPercentiles()
+        #: (tick, in-service replicas) on every change — the bench's
+        #: replica-count trace.  Bounded: a pathological flap cannot
+        #: grow host memory without bound.
+        self.replica_trace: List[Tuple[int, int]] = []
         self.replicas: List[_Replica] = []
         for i in range(self.config.num_replicas):
             self.replicas.append(self._build_replica(i))
+        self._note_replica_trace()
         self._set_state_gauge()
 
     @classmethod
@@ -560,6 +698,26 @@ class ServingFleet:
                 raise ValueError(
                     f"prompt of {prompt_len} tokens exceeds the largest "
                     f"prefill bucket {self._max_bucket}")
+        cost = prompt_len + int(request.max_new_tokens)
+        tenant = request.tenant
+        # Per-tenant token-bucket admission: the flooding tenant's own
+        # bucket refuses the submission — loudly — before any fleet
+        # state is touched.  Untagged traffic (tenant None) bypasses
+        # quota: it is the operator's own.
+        if self._buckets is not None and tenant is not None:
+            if not self._buckets.try_spend(tenant, cost, self.tick):
+                self.counters["throttles"] += 1
+                self._throttle_counter.inc(tenant=tenant)
+                level = self._buckets.level(tenant, self.tick)
+                logger.warning(
+                    "fleet: tenant %r throttled (%d tokens, bucket at "
+                    "%.1f)", tenant, cost, level)
+                if self.trace is not None:
+                    self.trace.emit(EventType.TENANT_THROTTLE,
+                                    tenant=tenant, tokens=cost,
+                                    bucket_level=round(level, 2),
+                                    tick=self.tick)
+                return None
         fid = self._next_fid
         self._next_fid += 1
         rng = request.rng
@@ -576,19 +734,37 @@ class ServingFleet:
             deadline_at=(now + request.deadline_s
                          if request.deadline_s is not None else None),
             submit_t=now,
+            tenant=tenant, cost=cost,
         )
+        if self._classes:
+            rec.slo_class = self._class_for_priority(
+                self._classes, rec.priority).name
         if self.spans is not None:
             rec.span_root = self.spans.start(
                 "fleet.request", kind="serve", request_id=fid,
                 prompt_len=len(rec.prompt),
-                max_new_tokens=rec.max_new_tokens)
+                max_new_tokens=rec.max_new_tokens,
+                tenant=tenant, slo_class=rec.slo_class)
         self.requests[fid] = rec
+        if self._classq is not None:
+            # Class-scheduled admission: the request queues at the
+            # FLEET and the deficit-round-robin dispatcher places it —
+            # token-weighted fairness across classes, not arrival order.
+            if not self._classq.push(rec.slo_class, fid, cost):
+                del self.requests[fid]
+                self.rejected += 1
+                self._refund_bucket(rec)
+                if self.spans is not None and rec.span_root is not None:
+                    self.spans.end(rec.span_root, status="rejected")
+                return None
+            return fid
         try:
             outcome = self._try_submit(rec)
         except Exception:
             # Never leave an orphaned record behind an engine-side
             # raise: unwind so ``busy`` reflects only servable work.
             del self.requests[fid]
+            self._refund_bucket(rec)
             if self.spans is not None and rec.span_root is not None:
                 self.spans.end(rec.span_root, status="error")
             raise
@@ -596,6 +772,7 @@ class ServingFleet:
             # Real backpressure: admitting replicas exist and ALL shed.
             del self.requests[fid]
             self.rejected += 1
+            self._refund_bucket(rec)
             if self.spans is not None and rec.span_root is not None:
                 self.spans.end(rec.span_root, status="rejected")
             return None
@@ -603,6 +780,13 @@ class ServingFleet:
             # Transient chaos hole: park; the tick loop resubmits.
             rec.retry_due = self.tick
         return fid
+
+    def _refund_bucket(self, rec: _FleetRequest) -> None:
+        """Return a bucket spend for a submission the fleet REJECTED
+        after the quota check passed — a rejection does no work, so it
+        must not drain the tenant's budget."""
+        if self._buckets is not None and rec.tenant is not None:
+            self._buckets.refund(rec.tenant, rec.cost, self.tick)
 
     def _pick_replicas(self, rec: _FleetRequest,
                        exclude: Set[int] = frozenset()) -> List[_Replica]:
@@ -653,7 +837,7 @@ class ServingFleet:
             deadline_s=deadline_s, rng=rec.rng,
             on_token=self._token_forwarder(rec, rep.index),
             priority=rec.priority, first_submit_id=rec.fid,
-            span_parent=span,
+            span_parent=span, tenant=rec.tenant,
         ))
         if local is None:
             if span is not None:
@@ -695,6 +879,7 @@ class ServingFleet:
         Returns tokens emitted across the fleet this tick."""
         self.tick += 1
         self._apply_chaos()
+        self._dispatch_classes()
         emitted = 0
         for rep in self.replicas:
             if rep.engine is None or rep.state is ReplicaState.QUARANTINED:
@@ -705,6 +890,7 @@ class ServingFleet:
             rep.last_progress_tick = self.tick
         self._process_terminals()
         self._supervise()
+        self._autoscale()
         self._run_retries_and_hedges()
         self._set_state_gauge()
         # Done records with every attempt settled leave the working set
@@ -739,6 +925,10 @@ class ServingFleet:
         from trustworthy_dl_tpu.chaos.plan import FaultKind
 
         for event in self.chaos.on_fleet_tick(self.tick):
+            if event.kind is FaultKind.TENANT_FLOOD:
+                self.counters["tenant_floods"] += 1
+                self._run_flood(event)
+                continue
             target = event.target
             if not 0 <= target < len(self.replicas):
                 logger.warning("chaos: fleet event %s targets unknown "
@@ -789,6 +979,12 @@ class ServingFleet:
         stays quarantined (the cool-off probe path rebuilds the engine
         when it fires), and a trust-drain in progress completes as a
         quarantine — dying mid-drain is not an exit from the ladder."""
+        if rep.state is ReplicaState.RETIRED:
+            # Scaled-in replica: no engine exists to crash — the event
+            # is a no-op (and must not resurrect retired capacity).
+            logger.warning("chaos: crash on retired replica %d ignored",
+                           rep.index)
+            return
         self.counters["crashes"] += 1
         if rep.state is ReplicaState.QUARANTINED:
             rep.engine = None   # probe exit rebuilds; cool-off intact
@@ -824,6 +1020,10 @@ class ServingFleet:
         # concurrent vote once the rebuild resets ``vote_open``.
         self._abandon_votes_targeting(rep.index)
         rep.engine = None
+        # A crash voids a pending scale-in: the capacity decision is
+        # re-made by the autoscaler against post-crash reality, not
+        # carried as a stale flag into an unrelated future drain.
+        rep.retire_pending = False
         if rep.quarantine_pending:
             # The suspect replica died mid-drain: impound it — the
             # quarantine the flag-rate earned still happens, cool-off
@@ -836,6 +1036,209 @@ class ServingFleet:
         else:
             rep.warm_until = self.tick + self.config.restart_ticks
             self._transition(rep, ReplicaState.RESTARTING, "crash")
+
+    # -- control plane: floods, class dispatch, autoscaling ----------------
+
+    def _run_flood(self, event: Any) -> None:
+        """Execute a TENANT_FLOOD: burst ``severity`` requests from the
+        flooding tenant through the NORMAL admission path in one tick —
+        the token bucket throttles what the tenant cannot pay for, the
+        class queues schedule the rest, and the admitted burst drives
+        the autoscaler like any real overload.  Admitted flood requests
+        are accepted work: they serve to completion like any other."""
+        n = max(int(event.severity), 1)
+        tenant = event.tenant or "flood"
+        cfgc = self.config
+        admitted = 0
+        for _ in range(n):
+            fid = self.submit(ServeRequest(
+                prompt=[0] * cfgc.flood_prompt_len,
+                max_new_tokens=cfgc.flood_new_tokens,
+                temperature=0.0, tenant=tenant, priority=0,
+            ))
+            if fid is not None:
+                admitted += 1
+        logger.warning("fleet: tenant flood from %r — %d/%d admitted at "
+                       "tick %d", tenant, admitted, n, self.tick)
+
+    def _classq_alive(self, fid: int) -> bool:
+        rec = self.requests.get(fid)
+        return rec is not None and not rec.done and not rec.live \
+            and rec.retry_due is None
+
+    def _free_engine_queue_slots(self) -> int:
+        free = 0
+        for rep in self.replicas:
+            if rep.state in ADMITTING and rep.engine is not None:
+                free += max(int(rep.engine.queue_limit)
+                            - len(rep.engine.queued_ids), 0)
+        return free
+
+    def _dispatch_classes(self) -> None:
+        """One dispatch pass per tick (no-op without SLO classes): shed
+        the lowest class first while any class's latency target is
+        breached and the backlog exceeds free capacity — replacing the
+        raw lowest-priority shed — then release queued requests to the
+        engines by token-cost deficit round robin."""
+        if self._classq is None:
+            return
+        free = self._free_engine_queue_slots()
+        if (self._class_latency.any_breached()
+                and self._classq.depth() > free):
+            # At most one shed per tick (pressure is re-evaluated every
+            # tick), from the NEWEST entry of the LOWEST class.
+            cand = self._classq.shed_candidate(self._classq_alive)
+            if cand is not None:
+                name, fid = cand
+                rec = self.requests.get(fid)
+                if rec is not None and not rec.done:
+                    self._class_stats[name]["shed"] += 1
+                    self._finalize_unserved(rec, "shed_slo")
+        batch = self._classq.take(free, self._classq_alive)
+        for i, (name, fid, cost) in enumerate(batch):
+            rec = self.requests.get(fid)
+            if rec is None or rec.done:
+                continue
+            if self._try_submit(rec) != "submitted":
+                # Engine backpressure mid-batch: EVERY not-yet-placed
+                # entry goes back (reversed push_front restores order)
+                # — dropping the tail would orphan requests with no
+                # live attempt, no retry and no queue entry, wedging
+                # ``busy`` forever.
+                for name2, fid2, cost2 in reversed(batch[i:]):
+                    self._classq.push_front(name2, fid2, cost2)
+                break
+
+    def _in_service(self) -> List[_Replica]:
+        """Replicas that exist as capacity (everything but RETIRED) —
+        the count the autoscaler's [min, max] bounds govern."""
+        return [r for r in self.replicas
+                if r.state is not ReplicaState.RETIRED]
+
+    def _note_replica_trace(self) -> None:
+        n = len(self._in_service())
+        if len(self.replica_trace) < 4096 and (
+                not self.replica_trace
+                or self.replica_trace[-1][1] != n):
+            self.replica_trace.append((self.tick, n))
+
+    def _autoscale(self) -> None:
+        """One control decision per tick (no-op without an autoscaler):
+        gather the tick's signals, run the shared pure predicate
+        through the hysteresis state, and execute at most one scale
+        action."""
+        if self.autoscaler is None:
+            return
+        from trustworthy_dl_tpu.serve.control import ScaleSignals, \
+            predicted_replicas
+
+        # Capacity-planning view: a replica already draining toward
+        # RETIRED is LEAVING — counting it against the [min, max]
+        # bounds would let repeated scale-downs (one per cool-down,
+        # while a long drain holds the count up) walk the fleet below
+        # min_replicas, to zero in the worst case.  Excluding it also
+        # lets a scale-up REPLACE leaving capacity under fresh load.
+        # QUARANTINED replicas are excluded the same way: they serve
+        # nothing for an indefinite cool-off, so counting them would
+        # BOTH dilute queue-per-replica (12 requests on the one live
+        # engine of a 3-replica fleet reading as 4/replica) AND block
+        # scale-ups at the max bound exactly when chaos removed the
+        # capacity.  RESTARTING stays counted — it is warming capacity,
+        # and forgetting it would re-fire a scale-up every tick of the
+        # warmup.
+        staying = [r for r in self._in_service()
+                   if r.state is not ReplicaState.QUARANTINED
+                   and not (r.state is ReplicaState.DRAINING
+                            and r.retire_pending)]
+        engines = [r.engine for r in staying if r.engine is not None]
+        queue = sum(e.load for e in engines)
+        if self._classq is not None:
+            queue += self._classq.depth()
+        occ = 0.0
+        pools = [getattr(e, "scheduler", None) for e in engines]
+        pools = [s for s in pools if s is not None]
+        if pools:
+            occ = sum(s.occupancy for s in pools) / len(pools)
+        burning = any(
+            getattr(e, "slo", None) is not None and e.slo.breached
+            for e in engines)
+        itl = (self._itl_est.quantile(0.99)
+               if self._itl_est.count else None)
+        cfg = self.autoscaler.cfg
+        pred = (predicted_replicas(cfg.predictive, self.tick)
+                if cfg.predictive is not None else None)
+        sig = ScaleSignals(
+            tick=self.tick, in_service=len(staying),
+            queue_per_replica=queue / max(len(staying), 1),
+            occupancy=occ, itl_p99=itl, slo_burning=burning,
+            predicted_replicas=pred,
+            down_candidates=any(r.state in ADMITTING
+                                and r.engine is not None
+                                for r in self.replicas),
+        )
+        decision = self.autoscaler.observe(sig)
+        if decision > 0:
+            self._scale_up(sig)
+        elif decision < 0:
+            self._scale_down(sig)
+
+    def _emit_scale(self, direction: str, frm: int, to: int,
+                    reason: str) -> None:
+        self.counters[f"scale_{direction}s"] += 1
+        self._scale_counter.inc(direction=direction)
+        self._note_replica_trace()
+        if self.trace is not None:
+            self.trace.emit(EventType.FLEET_SCALE, direction=direction,
+                            from_replicas=frm, to_replicas=to,
+                            reason=reason, tick=self.tick)
+
+    def _scale_up(self, sig: Any) -> None:
+        """Add capacity: revive a RETIRED index (fresh generation —
+        journals retained) or append a new replica.  Either way the
+        engine build goes through the existing HBM headroom gate
+        (``hbm`` rides engine_kwargs), and the replica warms up through
+        RESTARTING like any rebuild — scale-up is never instant
+        admission."""
+        frm = len(self._in_service())
+        cfgc = self.config
+        rep = next((r for r in self.replicas
+                    if r.state is ReplicaState.RETIRED), None)
+        if rep is not None:
+            rep.gen += 1
+            self._build_replica(rep.index, prev=rep)
+        else:
+            rep = self._build_replica(len(self.replicas))
+            self.replicas.append(rep)
+        rep.warm_until = self.tick + cfgc.restart_ticks
+        rep.last_progress_tick = self.tick
+        self._transition(rep, ReplicaState.RESTARTING, "scale_up")
+        logger.warning("fleet: scale-up -> replica %d (queue/replica "
+                       "%.1f, occupancy %.2f)", rep.index,
+                       sig.queue_per_replica, sig.occupancy)
+        self._emit_scale("up", frm, len(self._in_service()), "scale_up")
+
+    def _scale_down(self, sig: Any) -> None:
+        """Shed capacity WITHOUT shedding work: pick the least-loaded
+        admitting replica (ties: newest index), migrate its queue now,
+        and let in-flight run out — a scale-down drain never
+        force-migrates at the grace deadline and never kills accepted
+        requests.  The drain completes into RETIRED: pool released,
+        journal retained, index reusable by the next scale-up."""
+        cands = [r for r in self.replicas
+                 if r.state in ADMITTING and r.engine is not None]
+        if not cands:
+            return  # nothing safely removable this tick
+        frm = len(self._in_service())
+        rep = min(cands, key=lambda r: (r.engine.load, -r.index))
+        rep.retire_pending = True
+        rep.quarantine_pending = False
+        self._transition(rep, ReplicaState.DRAINING, "scale_down")
+        self._migrate(rep, rep.engine.queued_ids,
+                      status="migrated", reason="scale_down")
+        logger.warning("fleet: scale-down draining replica %d "
+                       "(queue/replica %.1f, occupancy %.2f)",
+                       rep.index, sig.queue_per_replica, sig.occupancy)
+        self._emit_scale("down", frm, frm - 1, "scale_down")
 
     # -- terminal processing -----------------------------------------------
 
@@ -962,7 +1365,17 @@ class ServingFleet:
             status=result.status, replica=att.replica,
             attempts=rec.submissions, ttft_s=ttft,
             flagged=result.flagged, monitor_z=result.monitor_z,
+            tenant=rec.tenant, slo_class=rec.slo_class,
         )
+        if result.status == "completed":
+            for dt in result.itl_s:
+                self._itl_est.observe(dt)
+            if rec.slo_class is not None:
+                stats = self._class_stats[rec.slo_class]
+                stats["completed"] += 1
+                stats["tokens"] += len(result.tokens)
+                self._class_latency.observe(rec.slo_class, ttft_s=ttft,
+                                            itl_s=result.itl_s)
         self._ledger_canonical(rec, result, att, ttft)
         if self.spans is not None and rec.span_root is not None:
             self.spans.end(rec.span_root, status=result.status,
@@ -983,6 +1396,7 @@ class ServingFleet:
         self.results[rec.fid] = FleetResult(
             request_id=rec.fid, tokens=[], status=status, replica=None,
             attempts=rec.submissions, ttft_s=None,
+            tenant=rec.tenant, slo_class=rec.slo_class,
         )
         if self.ledger is not None:
             self.ledger.append({
@@ -992,6 +1406,7 @@ class ServingFleet:
                 "flagged": False, "monitor_z": 0.0, "tokens": 0,
                 "token_hash": attribution.token_hash([]),
                 "ttft_s": None, "submissions": rec.submissions,
+                "tenant": rec.tenant, "slo_class": rec.slo_class,
             })
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=rec.fid,
@@ -1024,6 +1439,7 @@ class ServingFleet:
             "tokens": len(result.tokens),
             "token_hash": attribution.token_hash(result.tokens),
             "ttft_s": ttft, "submissions": rec.submissions,
+            "tenant": rec.tenant, "slo_class": rec.slo_class,
         })
 
     def _ledger_loser(self, rec: _FleetRequest, att: _Attempt) -> None:
@@ -1095,6 +1511,8 @@ class ServingFleet:
         # NOTE: _drain_moves is NOT reset here — a vote-triggered drain
         # queues moves from terminal processing before this pass runs.
         for rep in self.replicas:
+            if rep.state is ReplicaState.RETIRED:
+                continue  # scaled in: no engine, no signals, no ladder
             if rep.state is ReplicaState.RESTARTING:
                 if self.tick >= rep.warm_until:
                     if rep.engine is None:
@@ -1177,13 +1595,41 @@ class ServingFleet:
                     self._transition(rep, ReplicaState.HEALTHY,
                                      "recovered")
             if rep.state is ReplicaState.DRAINING:
-                if rep.engine.load and self.tick >= rep.drain_deadline:
+                # Scale-down drains are exempt from the grace-deadline
+                # force-migration — a scale-in drain's in-flight work
+                # RUNS OUT where it is, bounded by max_new_tokens.  But
+                # that bound assumes the engine keeps TICKING: a
+                # replica that stops making progress mid-retire-drain
+                # (chaos stall, wedge) would strand its in-flight work
+                # forever, so a stalled retire-drain falls back to the
+                # force-migration after heartbeat_miss_limit silent
+                # ticks — the capacity was leaving anyway, the work
+                # must not leave with it.
+                stalled_retire = (
+                    rep.retire_pending and rep.engine.load
+                    and self.tick - rep.last_progress_tick
+                    >= cfg.heartbeat_miss_limit)
+                if stalled_retire or (
+                        not rep.retire_pending and rep.engine.load
+                        and self.tick >= rep.drain_deadline):
                     self._migrate(rep, rep.engine.queued_ids,
                                   status="migrated", reason="drain")
                     self._migrate(rep, rep.engine.inflight_ids,
-                                  status="failover", reason="drain_grace")
+                                  status="failover",
+                                  reason=("scale_down_stall"
+                                          if stalled_retire
+                                          else "drain_grace"))
                 if rep.engine.load == 0:
-                    if rep.quarantine_pending:
+                    if rep.retire_pending:
+                        # Scale-in complete: release the pool, keep the
+                        # journal (records naming its blocks must still
+                        # reconcile), leave the index reusable.
+                        rep.retire_pending = False
+                        rep.engine = None
+                        self._transition(rep, ReplicaState.RETIRED,
+                                         "scale_down_complete")
+                        self._note_replica_trace()
+                    elif rep.quarantine_pending:
                         rep.quarantine_pending = False
                         rep.cooloff_ticks = max(
                             rep.cooloff_ticks * 2,
@@ -1310,7 +1756,7 @@ class ServingFleet:
             local = voter.engine.submit(ServeRequest(
                 prompt=rec.prompt, max_new_tokens=rec.max_new_tokens,
                 temperature=rec.temperature, eos_id=rec.eos_id,
-                rng=rec.rng, priority=rec.priority,
+                rng=rec.rng, priority=rec.priority, tenant=rec.tenant,
                 # Audit semantics: no user stream, no deadline, and the
                 # replay's prompt blocks never enter the PrefixCache.
                 publish_prefix=False,
@@ -1487,6 +1933,16 @@ class ServingFleet:
             self._replicas_gauge.set(float(n), state=state.value)
         self._tif_gauge.set(float(tif))
         self._queue_gauge.set(float(load))
+        if self._classq is not None:
+            for name, depth in self._classq.depth_by_class().items():
+                self._classq_gauge.set(float(depth), slo_class=name)
+
+    @property
+    def open_requests(self) -> int:
+        """Accepted-but-unfinished fleet requests (class-queued, live
+        or between retries) — the closed-loop driver's in-flight
+        count."""
+        return sum(1 for r in self.requests.values() if not r.done)
 
     @property
     def busy(self) -> bool:
@@ -1541,4 +1997,14 @@ class ServingFleet:
         }
         if slo_active:
             out["replica_slo_active"] = slo_active
+        if self._classes:
+            out["per_class"] = {
+                c.name: {**self._class_stats[c.name],
+                         **self._class_latency.summary(c.name)}
+                for c in self._classes
+            }
+            out["class_queue_depth"] = self._classq.depth_by_class()
+        if self.autoscaler is not None:
+            out["replicas_in_service"] = len(self._in_service())
+            out["replica_trace"] = list(self.replica_trace)
         return out

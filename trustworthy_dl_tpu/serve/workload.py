@@ -22,11 +22,14 @@ replay identical traffic on every arm.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +104,11 @@ def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
     """Materialise the full arrival schedule.  Lengths are clipped so
     ``prompt + new <= max_seq`` always holds — a generated workload is
     submittable against any engine with that geometry."""
+    # The envelope is control.diurnal_rate — the ONE spelling the
+    # autoscaler's predictive arm also reads, so anticipating the
+    # envelope IS anticipating this generator's traffic.
+    from trustworthy_dl_tpu.serve.control import diurnal_rate
+
     rng = np.random.default_rng(cfg.seed)
     weights = np.asarray([t.weight for t in cfg.tenants], np.float64)
     weights = weights / weights.sum()
@@ -110,9 +118,8 @@ def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
         # Non-homogeneous Poisson via rate modulation: the gap at time t
         # is exponential at the CURRENT envelope rate — bursts pack
         # arrivals, troughs stretch them.
-        rate = cfg.mean_rps * (1.0 + cfg.burstiness * math.sin(
-            2.0 * math.pi * t / cfg.burst_period_s))
-        rate = max(rate, cfg.mean_rps * (1.0 - cfg.burstiness), 1e-6)
+        rate = diurnal_rate(cfg.mean_rps, cfg.burstiness,
+                            cfg.burst_period_s, t)
         t += float(rng.exponential(1.0 / rate))
         tenant = cfg.tenants[int(rng.choice(len(cfg.tenants), p=weights))]
         out_hi = max(max_seq // 2, 1)
@@ -158,4 +165,61 @@ def replay_workload(target: Any, items: Sequence[WorkloadItem],
                            idle_sleep_s))
             continue
         target.step()
+    return accepted
+
+
+def drive_closed_loop(target: Any, items: Sequence[WorkloadItem],
+                      make_request: Callable[[WorkloadItem], Any],
+                      inflight_target: int,
+                      max_ticks: int = 200_000,
+                      max_refused_ticks: int = 2_000) -> int:
+    """CLOSED-loop bounded-queue driver: hold ``inflight_target``
+    accepted-but-unfinished requests against the target (anything with
+    the serving surface plus ``open_requests`` — a ServingFleet or a
+    ServingEngine), submitting from ``items`` in order as capacity
+    frees and ticking the target every iteration.
+
+    This is the saturating driver the adversary bench introduced (PR
+    12) and the autoscale/overload drills need: an open-loop wall-clock
+    replay only loads a degraded/scaling fleet on a machine-specific
+    service-rate knife edge, while a closed loop keeps backpressure —
+    and therefore routing, throttling and scaling decisions — engaged
+    deterministically, tick for tick.  ONE spelling shared by
+    ``bench.py``, the drills and the CLI.  A submission the target
+    refuses (engine backpressure or a tenant-bucket throttle) is
+    retried on a later tick; a head item the target refuses for
+    ``max_refused_ticks`` CONSECUTIVE ticks is dropped (logged, not
+    counted accepted) — a permanently-throttled item (cost above its
+    tenant's bucket capacity, zero refill) must not head-of-line-block
+    every other tenant behind it until the ``max_ticks`` liveness
+    backstop kills the whole drive.  Returns how many submissions were
+    accepted."""
+    pending = list(items)
+    accepted = 0
+    ticks = 0
+    refused_streak = 0
+    while pending or target.busy:
+        while pending and target.open_requests < inflight_target:
+            fid = target.submit(make_request(pending[0]))
+            if fid is None:
+                # Backpressure/throttle: retry next tick — but give up
+                # on a head nothing will ever admit.
+                refused_streak += 1
+                if refused_streak >= max_refused_ticks:
+                    logger.warning(
+                        "drive_closed_loop: dropping head item after "
+                        "%d consecutive refused ticks (permanently "
+                        "throttled?)", refused_streak)
+                    pending.pop(0)
+                    refused_streak = 0
+                break
+            pending.pop(0)
+            accepted += 1
+            refused_streak = 0
+        target.step()
+        ticks += 1
+        if ticks > max_ticks:
+            raise RuntimeError(
+                f"closed-loop drive did not drain in {max_ticks} ticks "
+                f"({len(pending)} submissions still pending)")
     return accepted
