@@ -55,7 +55,10 @@ to disk — TDDL_BENCH_PROBE_CACHE sets the file, default
 fresh probe — so one healthy probe stops later rounds from re-probing
 a flaky tunnel into 3x180 s timeouts),
 TDDL_BENCH_COMPILE_CACHE=1 (persistent XLA compilation cache under
-TDDL_BENCH_OBS_DIR, so repeat runs skip recompiles).
+TDDL_BENCH_OBS_DIR, so repeat runs skip recompiles);
+TDDL_BENCH_LINT=1 (tddl-lint static-analysis leg in a jax-free
+subprocess before any device work: clean -> "lint" record section,
+findings -> rc 4; TDDL_BENCH_LINT_TIMEOUT seconds, default 300).
 
 ``--config <preset>`` selects a BASELINE.md benchmark-matrix shape
 (`--config list` prints them); env overrides still apply on top.  The
@@ -170,6 +173,9 @@ def _skip_record(reason: str, **extra) -> dict:
         "vs_baseline": None, "skipped": True, "reason": reason,
         "prior_ledger": _prior_ledger_pointer(),
     }
+    if _LINT_RECORD is not None:
+        # A lint leg that ran before the backend died still reports.
+        record["lint"] = _LINT_RECORD
     try:
         from trustworthy_dl_tpu.obs.meta import run_metadata
 
@@ -188,6 +194,57 @@ def _sentinel_rc(record: dict) -> int:
         return 0
     sentinel = record.get("sentinel") or {}
     return 3 if sentinel.get("regressed") else 0
+
+
+_LINT_RECORD = None
+
+
+def bench_lint() -> "dict | None":
+    """Static-analysis leg (TDDL_BENCH_LINT=1): run trustworthy-dl-lint
+    in a SUBPROCESS — the lint process is host-only by contract and
+    never imports jax, so this leg works (and matters most) when the
+    accelerator backend is the broken thing.  No-op (None) when unset.
+
+    Clean lint attaches a compact "lint" section to whatever record the
+    round emits (perf row or skip record); findings fail the round
+    loudly with rc 4 BEFORE any device work is paid for — the CI arm
+    asserts rc 0 exactly like the sentinel's rc-3 contract."""
+    if os.environ.get("TDDL_BENCH_LINT") != "1":
+        return None
+    import subprocess
+
+    t0 = time.time()
+    timeout = float(os.environ.get("TDDL_BENCH_LINT_TIMEOUT", "300"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "trustworthy_dl_tpu.analysis",
+             "--format", "json"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        # A hung lint subprocess must degrade to a reportable failure,
+        # never a raw traceback — same contract as the backend probe.
+        return {"rc": -1, "timeout_s": timeout,
+                "wall_s": round(time.time() - t0, 2),
+                "files_scanned": None, "findings": [], "by_rule": {},
+                "baselined": 0, "stale_baseline": [],
+                "error": f"lint subprocess exceeded {timeout:g}s"}
+    try:
+        payload = json.loads(proc.stdout.strip() or "{}")
+    except ValueError:
+        payload = {}
+    record = {
+        "rc": proc.returncode,
+        "wall_s": round(time.time() - t0, 2),
+        "files_scanned": payload.get("files_scanned"),
+        "findings": payload.get("findings", []),
+        "by_rule": payload.get("by_rule", {}),
+        "baselined": payload.get("baselined", 0),
+        "stale_baseline": payload.get("stale_baseline", []),
+    }
+    if proc.returncode != 0 and proc.stderr:
+        record["stderr"] = proc.stderr[-2000:]
+    return record
 
 
 def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
@@ -1660,6 +1717,20 @@ def main() -> None:
         _inner_main()
         return
 
+    # Static-analysis leg first: host-only, cheapest, and its verdict
+    # must not depend on backend health.
+    global _LINT_RECORD
+    _LINT_RECORD = bench_lint()
+    if _LINT_RECORD is not None:
+        log(f"lint: rc {_LINT_RECORD['rc']} over "
+            f"{_LINT_RECORD['files_scanned']} files "
+            f"({len(_LINT_RECORD['findings'])} finding(s), "
+            f"{_LINT_RECORD['baselined']} baselined)")
+        if _LINT_RECORD["rc"] != 0:
+            print(json.dumps(_skip_record("lint_findings",
+                                          lint=_LINT_RECORD)))
+            sys.exit(4)
+
     # Evidence-proofing: the axon remote-TPU tunnel is documented-flaky
     # (BASELINE.md methodology notes).  A dead backend must still produce
     # the driver's one-line JSON — bounded retry, then a skip record at
@@ -1972,6 +2043,8 @@ def _inner_main() -> None:
         "mfu": mfu,
         "run_metadata": meta,
     }
+    if _LINT_RECORD is not None:
+        record["lint"] = _LINT_RECORD
     if spec_record is not None:
         # Attached BEFORE the perf sections: the sentinel fingerprint
         # lifts accepted_rate from it, so draft-quality regressions
@@ -2001,12 +2074,12 @@ def _inner_main() -> None:
         # this is the on-disk copy experiments can join against).
         os.makedirs(obs_dir, exist_ok=True)
         report_path = os.path.join(obs_dir, "obs_report.json")
-        with open(report_path, "w") as f:
-            json.dump({"source": "bench", "run_metadata": meta,
-                       "mfu": mfu,
-                       "steps_per_s_detection_on": sps_on,
-                       "throughput": record["value"],
-                       "unit": unit}, f, indent=2)
+        from trustworthy_dl_tpu.utils.io import atomic_write_json
+
+        atomic_write_json(report_path, {
+            "source": "bench", "run_metadata": meta, "mfu": mfu,
+            "steps_per_s_detection_on": sps_on,
+            "throughput": record["value"], "unit": unit})
         log(f"obs report written to {report_path}")
     print(json.dumps(record))
 

@@ -382,20 +382,12 @@ def test_run_metadata_carries_the_required_keys():
 
 def test_artifact_writers_are_stamped_with_run_metadata():
     """CONTRACT: every experiments/ module and bench.py that writes a
-    JSON artifact must reference the shared run_metadata helper.  A new
-    artifact writer that forgets the stamp fails here, not in review."""
-    writers = sorted(
-        (REPO / "trustworthy_dl_tpu" / "experiments").glob("*.py")
-    ) + [REPO / "bench.py"]
-    unstamped = []
-    for module in writers:
-        source = module.read_text()
-        if "json.dump(" in source and "run_metadata" not in source:
-            unstamped.append(str(module.relative_to(REPO)))
-    assert not unstamped, (
-        f"JSON artifact writer(s) without the run-metadata stamp "
-        f"(use trustworthy_dl_tpu.obs.run_metadata): {unstamped}"
-    )
+    JSON artifact (``json.dump`` or ``utils.io.atomic_write_json``)
+    must reference the shared run_metadata helper.  A new artifact
+    writer that forgets the stamp fails here, not in review — enforced
+    by tddl-lint's AST ``artifact-metadata`` rule (PR 14), which
+    replaced the substring scan that lived here."""
+    assert _lint_package("artifact-metadata") == []
 
 
 # ---------------------------------------------------------------------------
@@ -807,29 +799,25 @@ def test_verify_attribution_survives_journal_ring_rotation():
 # ---------------------------------------------------------------------------
 
 
-def _package_sources():
-    pkg = REPO / "trustworthy_dl_tpu"
-    return sorted(pkg.rglob("*.py")) + [REPO / "bench.py"]
+def _lint_package(rule: str) -> list:
+    """Run ONE tddl-lint rule over the standing perimeter (package +
+    bench.py + tests), suppressions honoured, NO baseline — these two
+    contracts are absolute and may never be grandfathered."""
+    from trustworthy_dl_tpu.analysis import run_lint
+
+    result = run_lint(root=str(REPO), rule_names=[rule],
+                      use_baseline=False)
+    return [f"{f.location}: {f.message}" for f in result.findings]
 
 
 def test_every_emit_call_site_uses_a_schema_typed_event():
     """CONTRACT: every ``*.emit(...)`` call site in the package passes an
     ``EventType.<NAME>`` whose NAME exists — new instrumentation cannot
-    bypass schema validation with a raw string (or a typo'd member)."""
-    import re
-
-    pattern = re.compile(r"\.emit\(\s*([A-Za-z_][\w.]*|[\"'][^\"']*[\"'])")
-    offenders = []
-    for module in _package_sources():
-        if module.name == "events.py":
-            continue  # the bus itself (validates at runtime)
-        for m in pattern.finditer(module.read_text()):
-            arg = m.group(1)
-            if not arg.startswith("EventType."):
-                offenders.append(f"{module.name}: emit({arg}")
-            elif arg.split(".", 1)[1] not in EventType.__members__:
-                offenders.append(f"{module.name}: unknown {arg}")
-    assert not offenders, offenders
+    bypass schema validation with a raw string (or a typo'd member).
+    Enforced by tddl-lint's AST ``obs-emit-type`` rule (PR 14), which
+    replaced the regex scan that lived here: multi-line calls and
+    aliased buses resolve the way the interpreter would."""
+    assert _lint_package("obs-emit-type") == []
 
 
 def test_fleet_events_and_gauges_are_inside_the_lint_perimeter():
@@ -955,31 +943,14 @@ def test_spec_surface_inside_the_lint_perimeter():
 
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
-    (counter/gauge/histogram) starts with ``tddl_`` — the naming
-    convention the Prometheus surface promises."""
-    import re
-
-    patterns = (
-        re.compile(
-            r"\.(?:counter|gauge|histogram)\(\s*\n?\s*([fF]?[\"'])([^\"']+)"
-        ),
-        # serve/engine.py's degrade-on-conflict wrapper: the name is the
-        # wrapper's second argument — still a literal, still linted.
-        re.compile(
-            r"_metric\(\s*\n?\s*\w+\.(?:counter|gauge|histogram),"
-            r"\s*\n?\s*([fF]?[\"'])([^\"']+)"
-        ),
-    )
-    offenders = []
-    for module in _package_sources():
-        if module.name == "registry.py":
-            continue  # defines the methods; registers nothing itself
-        source = module.read_text()
-        for pattern in patterns:
-            for m in pattern.finditer(source):
-                if not m.group(2).startswith("tddl_"):
-                    offenders.append(f"{module.name}: {m.group(2)!r}")
-    assert not offenders, offenders
+    (counter/gauge/histogram, plus serve/engine.py's ``_metric``
+    degrade-on-conflict wrapper) starts with ``tddl_`` — the naming
+    convention the Prometheus surface promises.  Enforced by
+    tddl-lint's AST ``metric-prefix`` rule (PR 14), which replaced the
+    regex scan that lived here; the companion ``metric-label-vocab``
+    rule additionally pins label names to the dashboard vocabulary."""
+    assert _lint_package("metric-prefix") == []
+    assert _lint_package("metric-label-vocab") == []
 
 
 # ---------------------------------------------------------------------------

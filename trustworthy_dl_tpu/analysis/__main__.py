@@ -1,0 +1,7 @@
+"""``python -m trustworthy_dl_tpu.analysis`` == trustworthy-dl-lint."""
+
+import sys
+
+from trustworthy_dl_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trustworthy_dl_tpu.detect import baseline as bl
+from trustworthy_dl_tpu.utils.io import atomic_write_json
 from trustworthy_dl_tpu.detect import stats as st
 from trustworthy_dl_tpu.detect.baseline import BaselineState
 
@@ -646,8 +647,7 @@ class AttackDetector:
                 for node_id, history in self.output_history.items()
             },
         }
-        with open(filepath, "w") as f:
-            json.dump(export_data, f, indent=2)
+        atomic_write_json(filepath, export_data)
         logger.info("Detection data exported to %s", filepath)
 
     def cleanup(self) -> None:

@@ -336,6 +336,9 @@ class CheckpointManager:
             state = jax.tree_util.tree_map(
                 lambda a: jnp.copy(a) if hasattr(a, "dtype") else a, state
             )
+        # tddl-lint: disable=atomic-write — presence-only marker: its
+        # existence (not its bytes) distinguishes a crashed save from a
+        # legacy dir; the manifest is the real COMMIT record.
         with open(self._inflight_path(step), "w") as f:
             f.write("save in flight; the manifest is the COMMIT marker\n")
         self._ckptr.save(target, state)

@@ -791,6 +791,9 @@ class DistributedTrainer:
                             timer.discard_step()
                         continue
                 self.metrics_collector.tick()
+                # tddl-lint: disable=host-sync — the sync path's ONE
+                # deliberate pull; async_host_depth>0 takes the packed
+                # D2H pipeline instead.
                 loss = float(metrics.loss)  # host sync closes the step
                 if timer is not None:
                     timer.lap("compute")  # dispatch + device step + sync

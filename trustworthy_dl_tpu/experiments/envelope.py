@@ -32,6 +32,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from trustworthy_dl_tpu.attacks import AdversarialAttacker, AttackConfig
+from trustworthy_dl_tpu.utils.io import atomic_write_json, \
+    atomic_write_text
 from trustworthy_dl_tpu.attacks.adversarial import ATTACK_KINDS
 from trustworthy_dl_tpu.core.config import TrainingConfig
 from trustworthy_dl_tpu.data import get_dataloader
@@ -235,10 +237,9 @@ def run_detection_envelope(
         "cells": cells,
         "wall_time_s": time.time() - t0,
     }
-    with open(out / "detection_envelope.json", "w") as f:
-        json.dump(results, f, indent=2)
+    atomic_write_json(out / "detection_envelope.json", results)
     table = render_table(results)
-    (out / "detection_envelope.md").write_text(table)
+    atomic_write_text(out / "detection_envelope.md", table)
     if make_figure:
         try:
             _figure(results, out / "detection_envelope.png")

@@ -33,6 +33,9 @@ from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from trustworthy_dl_tpu.utils.io import atomic_write_json, \
+    atomic_write_text
+
 logger = logging.getLogger(__name__)
 
 TINY = dict(n_embd=64, n_head=4, vocab_size=256, n_positions=64,
@@ -128,9 +131,9 @@ def run_pipeline_study(
         "cells": cells,
         "wall_time_s": time.time() - t0,
     }
-    with open(out / "pipeline_schedule_study.json", "w") as f:
-        json.dump(results, f, indent=2)
-    (out / "pipeline_schedule_study.md").write_text(render_study(results))
+    atomic_write_json(out / "pipeline_schedule_study.json", results)
+    atomic_write_text(out / "pipeline_schedule_study.md",
+                      render_study(results))
     return results
 
 

@@ -35,6 +35,8 @@ from trustworthy_dl_tpu.core.config import (
     TrainingConfig,
 )
 from trustworthy_dl_tpu.data import get_dataloader
+from trustworthy_dl_tpu.utils.io import atomic_write_json, \
+    atomic_write_text
 from trustworthy_dl_tpu.engine.trainer import DistributedTrainer
 
 logger = logging.getLogger(__name__)
@@ -187,9 +189,8 @@ class ExperimentRunner:
                         time.time() - epoch_start)
             if (epoch + 1) % 5 == 0:
                 path = self.output_dir / f"intermediate_epoch_{epoch}.json"
-                with open(path, "w") as f:
-                    json.dump(self.epoch_records, f, indent=2,
-                              default=_jsonable)
+                atomic_write_json(path, self.epoch_records,
+                                  default=_jsonable)
 
     def _epoch_snapshot(self, epoch: int, train_loss: float,
                         val_loss: Optional[float], epoch_time: float
@@ -363,8 +364,8 @@ class ExperimentRunner:
     def _save_results(self, results: Dict[str, Any]) -> None:
         import csv
 
-        with open(self.output_dir / "experiment_results.json", "w") as f:
-            json.dump(results, f, indent=2, default=_jsonable)
+        atomic_write_json(self.output_dir / "experiment_results.json",
+                          results, default=_jsonable)
         records = self._step_records()
         if records:
             fields = list(records[0].keys())
@@ -372,11 +373,14 @@ class ExperimentRunner:
                 for k in r:
                     if k not in fields:
                         fields.append(k)
-            with open(self.output_dir / "training_metrics.csv", "w",
-                      newline="") as f:
-                writer = csv.DictWriter(f, fieldnames=fields)
-                writer.writeheader()
-                writer.writerows(records)
+            import io as _io
+
+            buf = _io.StringIO(newline="")
+            writer = csv.DictWriter(buf, fieldnames=fields)
+            writer.writeheader()
+            writer.writerows(records)
+            atomic_write_text(
+                self.output_dir / "training_metrics.csv", buf.getvalue())
         logger.info("Results saved to %s", self.output_dir)
 
     # ------------------------------------------------------------------
@@ -666,8 +670,8 @@ class ExperimentRunner:
             "",
             f"*Generated {time.strftime('%Y-%m-%d %H:%M:%S')}*",
         ]
-        with open(self.output_dir / "experiment_report.md", "w") as f:
-            f.write("\n".join(lines) + "\n")
+        atomic_write_text(self.output_dir / "experiment_report.md",
+                          "\n".join(lines) + "\n")
         logger.info("Experiment report generated")
 
     def _cleanup(self) -> None:
@@ -765,8 +769,8 @@ def run_threshold_sweep(base: ExperimentConfig,
         }
     out_dir = Path(base.output_dir) / f"{base.experiment_name}_sweep"
     out_dir.mkdir(parents=True, exist_ok=True)
-    with open(out_dir / "sweep_results.json", "w") as f:
-        json.dump(sweep, f, indent=2, default=_jsonable)
+    atomic_write_json(out_dir / "sweep_results.json", sweep,
+                      default=_jsonable)
     return sweep
 
 

@@ -40,6 +40,9 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from trustworthy_dl_tpu.utils.io import atomic_write_json, \
+    atomic_write_text
+
 logger = logging.getLogger(__name__)
 
 STRENGTHS = (0.15, 0.45, 0.9)
@@ -260,9 +263,8 @@ def run_serve_envelope(
         "cells": cells,
         "wall_time_s": round(time.time() - t0, 2),
     }
-    with open(out / "serve_envelope.json", "w") as f:
-        json.dump(results, f, indent=2)
-    (out / "serve_envelope.md").write_text(render_table(results))
+    atomic_write_json(out / "serve_envelope.json", results)
+    atomic_write_text(out / "serve_envelope.md", render_table(results))
     if make_figure:
         try:
             _figure(results, out / "serve_envelope.png")

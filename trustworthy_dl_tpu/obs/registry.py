@@ -231,13 +231,13 @@ class MetricsRegistry:
                          ) -> Dict[str, Any]:
         """Write the snapshot (+ run metadata) to ``path``; returns it."""
         from trustworthy_dl_tpu.obs.meta import run_metadata
+        from trustworthy_dl_tpu.utils.io import atomic_write_json
 
         snap = self.snapshot()
         snap["run_metadata"] = run_metadata()
         if extra:
             snap.update(extra)
-        with open(path, "w") as f:
-            json.dump(snap, f, indent=2)
+        atomic_write_json(path, snap)
         return snap
 
     def prometheus_text(self) -> str:

@@ -67,6 +67,9 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
     ])
     fp: Dict[str, Any] = {
         "schema": FINGERPRINT_SCHEMA,
+        # tddl-lint: disable=tick-determinism — ledger wall stamp for
+        # humans reading PERF_LEDGER.jsonl; never a comparison input
+        # (the sentinel bands on metric values keyed by ``key``).
         "t": time.time(),
         "source": source,
         "key": key,

@@ -41,6 +41,8 @@ import os
 from typing import Any, Dict, Optional
 
 from trustworthy_dl_tpu.obs.events import EventType, TraceBus
+from trustworthy_dl_tpu.utils.io import atomic_write_json, \
+    atomic_write_text
 from trustworthy_dl_tpu.obs.recorder import FlightRecorder
 from trustworthy_dl_tpu.obs.registry import MetricsRegistry
 from trustworthy_dl_tpu.obs.report import StepTimeReporter
@@ -225,8 +227,8 @@ class ObsSession:
         self.registry.snapshot_to_json(
             path, extra={"step": step} if step is not None else None
         )
-        with open(os.path.join(self.obs_dir, "metrics.prom"), "w") as f:
-            f.write(self.registry.prometheus_text())
+        atomic_write_text(os.path.join(self.obs_dir, "metrics.prom"),
+                          self.registry.prometheus_text())
         self.trace.emit(EventType.METRICS_SNAPSHOT, step=step, path=path)
         return path
 
@@ -267,11 +269,8 @@ class ObsSession:
         if self.anomaly is not None:
             status["anomaly"] = self.anomaly.status()
         if self.obs_dir:
-            import json
-
-            with open(os.path.join(self.obs_dir, "slo_status.json"),
-                      "w") as f:
-                json.dump(status, f, indent=2)
+            atomic_write_json(
+                os.path.join(self.obs_dir, "slo_status.json"), status)
         return status
 
     def perf_fingerprint(self) -> Dict[str, Any]:

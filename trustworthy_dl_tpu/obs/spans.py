@@ -41,6 +41,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+from trustworthy_dl_tpu.utils.io import atomic_write_json
+
 
 @dataclasses.dataclass
 class Span:
@@ -170,8 +172,7 @@ class SpanTracker:
         ) for s in self.closed_spans()]
         payload = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:
-            with open(path, "w") as f:
-                json.dump(payload, f)
+            atomic_write_json(path, payload, indent=None)
         return payload
 
 
@@ -218,6 +219,5 @@ def chrome_trace_from_events(events: Sequence[Dict[str, Any]],
         ))
     payload = {"traceEvents": out, "displayTimeUnit": "ms"}
     if path is not None:
-        with open(path, "w") as f:
-            json.dump(payload, f)
+        atomic_write_json(path, payload, indent=None)
     return payload

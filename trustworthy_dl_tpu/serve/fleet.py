@@ -1099,7 +1099,18 @@ class ServingFleet:
             rec = self.requests.get(fid)
             if rec is None or rec.done:
                 continue
-            if self._try_submit(rec) != "submitted":
+            try:
+                outcome = self._try_submit(rec)
+            except BaseException:
+                # An engine-side RAISE mid-batch must not orphan the
+                # already-dequeued tail either: re-queue everything
+                # from this entry on (the raising entry keeps its
+                # record and stays queued), then let the caller see
+                # the error.
+                for name2, fid2, cost2 in reversed(batch[i:]):
+                    self._classq.push_front(name2, fid2, cost2)
+                raise
+            if outcome != "submitted":
                 # Engine backpressure mid-batch: EVERY not-yet-placed
                 # entry goes back (reversed push_front restores order)
                 # — dropping the tail would orphan requests with no

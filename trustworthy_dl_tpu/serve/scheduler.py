@@ -544,6 +544,7 @@ class ContinuousBatchingScheduler:
         self.kv = SlotKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         task.slot = slot
         # ONE host sync per admission: token/entropy/margin land together.
+        # tddl-lint: disable=host-sync — the intentional per-prefill pull
         token, ent, margin = np.asarray(packed)[:, 0]
         task._record(int(token), float(ent), float(margin))
         self.lengths[slot] = p
@@ -578,6 +579,7 @@ class ContinuousBatchingScheduler:
         self.kv = SlotKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         # ONE host pull for the whole tick (the cache stays on device);
         # the per-slot feed below reads the already-landed numpy rows.
+        # tddl-lint: disable=host-sync — the tick's single intentional pull
         host = np.asarray(packed)
         next_tok, ent, margin = host[0], host[1], host[2]
         live = list(self.tasks.items())
@@ -953,6 +955,7 @@ class PagedBatchingScheduler:
         if not final:
             st.pos += c
             return None
+        # tddl-lint: disable=host-sync — the intentional per-prefill pull
         token, ent, margin = np.asarray(packed)[:, 0]
         task._record(int(token), float(ent), float(margin))
         self.lengths[slot] = st.plen
@@ -1024,6 +1027,7 @@ class PagedBatchingScheduler:
                     jnp.asarray(greedy),
                 )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+        # tddl-lint: disable=host-sync — the tick's single intentional pull
         host = np.asarray(packed)
         next_tok, ent, margin = host[0], host[1], host[2]
         for slot in active:
@@ -1103,6 +1107,7 @@ class PagedBatchingScheduler:
             draft_dev.append(cur)
         # ONE host sync point for the whole draft chain: the k draft
         # token rows land together and become the verify inputs.
+        # tddl-lint: disable=host-sync — the draft chain's one deliberate sync
         drafts = np.stack([np.asarray(d) for d in draft_dev], axis=1)
         t1 = _time.perf_counter()
         self.spec_draft_s += t1 - t0
@@ -1114,6 +1119,7 @@ class PagedBatchingScheduler:
                 temps_dev, greedy_dev,
             )
         self.kv = PagedKV(k=pk, v=pv, k_scale=pks, v_scale=pvs)
+        # tddl-lint: disable=host-sync — verify lands all windows in one pull
         host = np.asarray(packed)                     # [3, ms, k+1]
         t2 = _time.perf_counter()
         self.spec_verify_s += t2 - t1

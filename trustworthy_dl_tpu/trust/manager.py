@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trustworthy_dl_tpu.trust import state as ts
+from trustworthy_dl_tpu.utils.io import atomic_write_json
 from trustworthy_dl_tpu.trust.state import METRIC_NAMES, NodeStatus, TrustState
 
 logger = logging.getLogger(__name__)
@@ -389,8 +390,7 @@ class TrustManager:
             "attack_history": {str(i): a for i, a in self.attack_history.items()},
             "statistics": self.get_trust_statistics(),
         }
-        with open(filepath, "w") as f:
-            json.dump(payload, f, indent=2)
+        atomic_write_json(filepath, payload)
         logger.info("trust: exported world-view to %s", filepath)
 
     # -- device bridge ----------------------------------------------------

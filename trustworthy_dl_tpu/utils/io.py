@@ -1,0 +1,40 @@
+"""Atomic artifact writes: tmp file + ``os.replace`` in one helper.
+
+The repo's durability rule (enforced statically by tddl-lint's
+``atomic-write``): a persistent artifact is never truncated in place —
+a crash mid-write must leave either the OLD complete artifact or the
+NEW complete artifact, never a torn one.  ``os.replace`` is atomic on
+POSIX when source and destination share a filesystem, which the
+sibling ``.tmp`` path guarantees.
+
+Host-only, stdlib-only (obs/ and the experiments writers import it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+
+def atomic_write_text(path: Any, text: str, encoding: str = "utf-8"
+                      ) -> str:
+    """Write ``text`` to ``path`` atomically; returns the path."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding=encoding) as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def atomic_write_json(path: Any, payload: Any, *,
+                      indent: Optional[int] = 2,
+                      sort_keys: bool = False,
+                      default: Any = None) -> str:
+    """``json.dump`` with the same atomicity guarantee."""
+    return atomic_write_text(
+        os.fspath(path),
+        json.dumps(payload, indent=indent, sort_keys=sort_keys,
+                   default=default) + "\n",
+    )
