@@ -30,7 +30,13 @@ TDDL_BENCH_PAGED_* knobs; TDDL_BENCH_SPEC=1 rides it and adds the
 speculative-decode A/B — spec off vs spec_k ∈ {2,4} over identical
 seeded traffic, accepted_rate + draft/verify tick fractions +
 tokens/s per arm, "spec" record key whose accepted_rate feeds the
-sentinel fingerprint, TDDL_BENCH_SPEC_* knobs), TDDL_BENCH_CHAOS=1 (seeded
+sentinel fingerprint, TDDL_BENCH_SPEC_* knobs; TDDL_BENCH_PAGED_ATTN=1
+also rides it and adds the paged-attention kernel A/B — attn_impl
+"pallas" vs the jnp gather fallback over identical seeded traffic,
+tokens/s + decode-tick fraction + standalone monitor-reduction cost
+delta, "paged_attn" record key whose decode_tick_fraction feeds the
+sentinel fingerprint; honest skip off-TPU where compiled Mosaic cannot
+dispatch, TDDL_BENCH_PAGED_ATTN_* knobs), TDDL_BENCH_CHAOS=1 (seeded
 chaos survival sweep through the self-healing supervisor),
 TDDL_BENCH_ASYNC=1 (async host-pipeline A/B: trainer loop at
 async_host_depth 0 vs default, tokens/sec + obs phase shares),
@@ -284,6 +290,11 @@ def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
         # rides the fingerprint so the sentinel bands it (direction
         # higher-is-better) like any perf metric.
         accepted_rate=(record.get("spec") or {}).get("accepted_rate"),
+        # Decode-phase serve-wall share of the paged-attention kernel arm
+        # (TDDL_BENCH_PAGED_ATTN rounds): direction lower-is-better — a
+        # silent fallback to the jnp gather path inflates it.
+        decode_tick_fraction=(record.get("paged_attn")
+                              or {}).get("decode_tick_fraction"),
         run_metadata=record.get("run_metadata"),
         extra={"vs_baseline": record.get("vs_baseline")},
     )
@@ -610,6 +621,11 @@ def _serve_sweep_row(engine, watcher, rate, shed) -> dict:
     return {
         "offered_rps": rate,
         "tokens_per_s": round(summary["tokens_per_s"], 1),
+        # Decode-phase share of the serve wall + the attention path that
+        # produced it — the pair the perf sentinel / attn-kernel gauge
+        # watch for silent fallbacks to the slow jnp gather.
+        "decode_tick_fraction": round(summary["decode_tick_fraction"], 4),
+        "attn_kernel_path": summary["attn_kernel_path"],
         "itl_p50_ms": round(summary.get("itl_p50_ms", 0.0), 3),
         "itl_p99_ms": round(summary.get("itl_p99_ms", 0.0), 3),
         "ttft_p50_ms": round(summary.get("ttft_p50_ms", 0.0), 3),
@@ -940,6 +956,161 @@ def bench_spec() -> "dict":
     off_tps = record["arms"]["off"]["tokens_per_s"]
     record["tokens_per_s_ratio"] = round(
         record["arms"][best]["tokens_per_s"] / max(off_tps, 1e-9), 3)
+    return record
+
+
+def bench_paged_attn() -> "dict":
+    """Paged-attention kernel A/B (TDDL_BENCH_PAGED_ATTN=1, riding
+    TDDL_BENCH_SERVE=1): the SAME seeded open-loop workload through a
+    kernel-on arm (``attn_impl="pallas"`` — the ragged Pallas
+    paged-decode attention + fused trust epilogue) and the jnp-fallback
+    arm (``attn_impl="jnp"`` — today's gather path), both rows in the
+    shared serve record shape (tokens/s, latency percentiles, SLO block,
+    decode_tick_fraction + attn_kernel_path).  On top it microbenches
+    the output monitor's per-token reductions standalone — the jnp
+    log_softmax/exp/top-k battery vs the single-pass trust epilogue over
+    decode-shaped [slots, vocab] logits — so the "trust monitoring is
+    literally free" claim has its own number (``monitor_cost_delta_us``
+    per tick).
+
+    HONEST SKIP: compiled Mosaic cannot dispatch on a non-TPU backend
+    (interpret mode measures the Pallas interpreter, not the kernel), so
+    off-TPU this returns a skip record with the reason — unless
+    TDDL_BENCH_PAGED_ATTN_INTERPRET=1, the record-shape smoke knob the
+    contract test uses (its numbers are interpreter wall time, never a
+    perf claim).  An untileable pool geometry (int8 KV with block_size
+    not a multiple of 32, f32 not a multiple of 8) skips the same way.
+
+    Env: TDDL_BENCH_SERVE_MODEL (gpt2), TDDL_BENCH_PAGED_ATTN_SLOTS (4),
+    TDDL_BENCH_PAGED_ATTN_SEQ (256), TDDL_BENCH_PAGED_ATTN_BLOCK (16),
+    TDDL_BENCH_PAGED_ATTN_REQUESTS (16), TDDL_BENCH_PAGED_ATTN_NEW (32),
+    TDDL_BENCH_PAGED_ATTN_RATE (64)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.obs.slo import SLOWatcher, default_serve_rules
+    from trustworthy_dl_tpu.ops.paged_attention import (
+        logit_trust_stats,
+        supports_paged_attention,
+    )
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+    from trustworthy_dl_tpu.serve.scheduler import _logit_signals
+
+    backend = jax.default_backend()
+    interpret_smoke = \
+        os.environ.get("TDDL_BENCH_PAGED_ATTN_INTERPRET") == "1"
+    if backend != "tpu" and not interpret_smoke:
+        log(f"paged_attn A/B skipped: backend={backend} cannot dispatch "
+            "compiled Mosaic (interpret mode would measure the "
+            "interpreter, not the kernel)")
+        return {"skipped": True,
+                "reason": f"pallas_undispatchable:backend={backend}"}
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_SERVE_MODEL", "gpt2")
+    )
+    max_slots = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_SEQ", "256"))
+    block = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_BLOCK", "16"))
+    n_requests = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_REQUESTS",
+                                    "16"))
+    max_new = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_NEW", "32"))
+    rate = float(os.environ.get("TDDL_BENCH_PAGED_ATTN_RATE", "64"))
+    kernel_impl = "interpret" if backend != "tpu" else "pallas"
+    if not supports_paged_attention(
+            head_dim=cfg.n_embd // cfg.n_head, block_size=block,
+            kv_dtype=cfg.dtype, interpret=(kernel_impl == "interpret")):
+        log(f"paged_attn A/B skipped: geometry does not tile "
+            f"(head_dim={cfg.n_embd // cfg.n_head}, block_size={block})")
+        return {"skipped": True,
+                "reason": f"pallas_untileable:block_size={block}"}
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    plen_hi = min(64, max_seq - max_new + 1)
+    if plen_hi <= 8:
+        raise ValueError(
+            f"TDDL_BENCH_PAGED_ATTN_SEQ={max_seq} leaves no room for "
+            f"prompts >= 8 tokens at TDDL_BENCH_PAGED_ATTN_NEW={max_new}"
+        )
+
+    def build_workload():
+        # Re-seeded per arm: identical request sequences, so tokens/s
+        # differences are the attention path's alone.
+        rng = np.random.default_rng(23)
+        workload = []
+        t_arrive = 0.0
+        for _ in range(n_requests):
+            t_arrive += rng.exponential(1.0 / rate)
+            plen = int(rng.integers(8, plen_hi))
+            workload.append((t_arrive, ServeRequest(
+                prompt=rng.integers(0, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=int(rng.integers(min(4, max_new),
+                                                max_new + 1)),
+                temperature=0.0,
+            )))
+        return workload
+
+    record: dict = {"arms": {}, "offered_rps": rate,
+                    "backend": backend, "block_size": block}
+    streams = {}
+    for label, impl in (("pallas", kernel_impl), ("jnp", "jnp")):
+        watcher = SLOWatcher(default_serve_rules())
+        engine = ServingEngine(params, cfg, max_slots=max_slots,
+                               max_seq=max_seq, queue_limit=n_requests,
+                               rng=jax.random.PRNGKey(1), slo=watcher,
+                               block_size=block, attn_impl=impl)
+        shed = _drive_serve_open_loop(engine, build_workload())
+        row = _serve_sweep_row(engine, watcher, rate, shed)
+        record["arms"][label] = row
+        streams[label] = {r: v.tokens
+                          for r, v in engine.results.items()
+                          if v.status == "completed"}
+        log(f"paged_attn [{label}/{engine.attn_kernel_path}]: "
+            f"{row['tokens_per_s']:8.1f} tok/s, decode-tick fraction "
+            f"{row['decode_tick_fraction']:.3f}")
+    # Greedy workload: the two paths must emit the same streams for the
+    # A/B to mean anything (near-tie flips are possible in principle —
+    # report, don't assert; the kernel tests pin equality properly).
+    record["streams_identical"] = streams["pallas"] == streams["jnp"]
+    record["tokens_per_s_ratio"] = round(
+        record["arms"]["pallas"]["tokens_per_s"]
+        / max(record["arms"]["jnp"]["tokens_per_s"], 1e-9), 3)
+    # The headline the sentinel fingerprint lifts: the KERNEL arm's
+    # decode-phase share of the serve wall.
+    record["decode_tick_fraction"] = \
+        record["arms"]["pallas"]["decode_tick_fraction"]
+
+    # Monitor-cost microbench: the output monitor's per-token reductions
+    # over decode-shaped logits, jnp battery vs fused epilogue, jitted
+    # and timed standalone.  This is the "trust monitoring becomes
+    # literally free" delta, per decode tick.
+    logits = jax.random.normal(jax.random.PRNGKey(3),
+                               (max_slots, cfg.vocab_size),
+                               jnp.float32) * 4.0
+    def _jnp_reductions(x):
+        return _logit_signals(x, "jnp")
+
+    def _kernel_reductions(x):
+        return _logit_signals(x, kernel_impl)
+
+    jnp_fn = jax.jit(_jnp_reductions)
+    ker_fn = jax.jit(_kernel_reductions)
+    timings = {}
+    for name, fn in (("jnp", jnp_fn), ("kernel", ker_fn)):
+        jax.block_until_ready(fn(logits))          # compile + warm
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(logits)
+        jax.block_until_ready(out)
+        timings[name] = (time.perf_counter() - t0) / reps * 1e6
+    record["monitor_us_jnp"] = round(timings["jnp"], 2)
+    record["monitor_us_kernel"] = round(timings["kernel"], 2)
+    record["monitor_cost_delta_us"] = round(
+        timings["jnp"] - timings["kernel"], 2)
+    log(f"paged_attn monitor reductions: jnp {timings['jnp']:.1f} us vs "
+        f"epilogue {timings['kernel']:.1f} us per tick "
+        f"(delta {record['monitor_cost_delta_us']:.1f} us)")
     return record
 
 
@@ -2004,11 +2175,14 @@ def _inner_main() -> None:
     serve_records = None
     paged_record = None
     spec_record = None
+    paged_attn_record = None
     if os.environ.get("TDDL_BENCH_SERVE") == "1":
         serve_records = bench_serve()
         paged_record = bench_paged()
         if os.environ.get("TDDL_BENCH_SPEC") == "1":
             spec_record = bench_spec()
+        if os.environ.get("TDDL_BENCH_PAGED_ATTN") == "1":
+            paged_attn_record = bench_paged_attn()
     fleet_record = None
     if os.environ.get("TDDL_BENCH_FLEET") == "1":
         fleet_record = bench_fleet()
@@ -2050,6 +2224,11 @@ def _inner_main() -> None:
         # lifts accepted_rate from it, so draft-quality regressions
         # band-check (and page) exactly like throughput regressions.
         record["spec"] = spec_record
+    if paged_attn_record is not None:
+        # Same contract: the fingerprint lifts the kernel arm's
+        # decode_tick_fraction, so a silent fall-back to the jnp gather
+        # bands (and pages) like a perf regression.
+        record["paged_attn"] = paged_attn_record
     _attach_perf_sections(record, compiles=compiles, hbm=hbm_monitor)
     if serve_records is not None:
         record["serve"] = serve_records

@@ -266,6 +266,57 @@ def test_bench_spec_ab_records(monkeypatch):
     assert record["tokens_per_s_ratio"] > 0
 
 
+def test_bench_paged_attn_ab_records(monkeypatch):
+    """bench_paged_attn's kernel-vs-jnp A/B: on the CPU container it
+    returns the HONEST skip record (compiled Mosaic cannot dispatch —
+    interpret mode would measure the interpreter, not the kernel); under
+    the record-shape smoke knob the arms are the shared serve record
+    shape riding decode_tick_fraction + attn_kernel_path, the top-level
+    decode_tick_fraction is the kernel arm's (what the sentinel
+    fingerprint lifts), and the monitor-reduction microbench reports the
+    epilogue-vs-jnp cost delta."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    # Honest skip off-TPU: attributable reason, no arms.
+    monkeypatch.delenv("TDDL_BENCH_PAGED_ATTN_INTERPRET", raising=False)
+    skip = bench.bench_paged_attn()
+    assert skip["skipped"] and "pallas_undispatchable" in skip["reason"]
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_INTERPRET", "1")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_BLOCK", "8")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_REQUESTS", "4")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_NEW", "4")
+    monkeypatch.setenv("TDDL_BENCH_PAGED_ATTN_RATE", "100")
+    record = bench.bench_paged_attn()
+    assert set(record["arms"]) == {"pallas", "jnp"}
+    # Both arms ride the shared serve record shape, so enabling the leg
+    # can never fork the serve contract.
+    assert set(record["arms"]["pallas"]) == set(record["arms"]["jnp"])
+    for label, path in (("pallas", "interpret"), ("jnp", "jnp")):
+        row = record["arms"][label]
+        assert row["completed"] + row["shed"] == 4
+        assert row["tokens_per_s"] > 0
+        assert 0.0 < row["decode_tick_fraction"] <= 1.0
+        assert row["attn_kernel_path"] == path
+    assert record["decode_tick_fraction"] \
+        == record["arms"]["pallas"]["decode_tick_fraction"]
+    assert record["streams_identical"] is True
+    assert record["tokens_per_s_ratio"] > 0
+    assert record["monitor_us_jnp"] > 0
+    assert record["monitor_us_kernel"] > 0
+    assert "monitor_cost_delta_us" in record
+
+
 def test_bench_quant_ab_records(monkeypatch):
     """bench_quant's equal-HBM A/B on a tiny model: the int8 arm admits
     >= 1.5x slots inside the baseline pool's byte budget, serves the

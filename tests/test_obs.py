@@ -941,6 +941,31 @@ def test_spec_surface_inside_the_lint_perimeter():
         ("name", "kind", "span_id", "duration_s")
 
 
+def test_paged_attn_surface_inside_the_lint_perimeter():
+    """Paged-attention kernel extension: the attention-path gauge is a
+    literal ``tddl_`` name the metric-name lint scans, registered
+    through the same ``_metric`` replica-label surface as the rest of
+    the tddl_serve_* family with the ``path`` label (added to the
+    dashboard vocabulary deliberately, contracts.KNOWN_METRIC_LABELS),
+    and the sentinel fingerprint carries the decode-tick fraction with
+    a lower-is-better direction."""
+    import re
+
+    from trustworthy_dl_tpu.analysis.contracts import KNOWN_METRIC_LABELS
+    from trustworthy_dl_tpu.obs.sentinel import SENTINEL_METRICS
+
+    engine_src = (REPO / "trustworthy_dl_tpu" / "serve"
+                  / "engine.py").read_text()
+    assert '"tddl_serve_attn_kernel"' in engine_src
+    pattern = re.compile(
+        r'"tddl_serve_attn_kernel",.*?'
+        r'labels=\("path",\) \+ self\._rlabel_names', re.DOTALL)
+    assert pattern.search(engine_src), \
+        "tddl_serve_attn_kernel not path+replica labelled"
+    assert "path" in KNOWN_METRIC_LABELS
+    assert SENTINEL_METRICS["decode_tick_fraction"] == "lower"
+
+
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
     (counter/gauge/histogram, plus serve/engine.py's ``_metric``

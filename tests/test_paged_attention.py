@@ -1,0 +1,468 @@
+"""Pallas ragged paged-decode attention + in-kernel trust epilogue
+(ops/paged_attention.py, wired through models/generate._paged_block and
+the serve scheduler's attn_impl static).
+
+Fast tier, ``pagedattn`` marker.  Interpret-mode kernel equality vs the
+jnp gather path (fp32 AND int8 KV pools, ragged lengths, windows
+crossing block boundaries, bit-identical pool writes), epilogue
+entropy/margin equality vs the engine's existing reductions (margin
+bit-exact, entropy f32-epsilon), the resolve/supports dispatch gate,
+the compile-once pin under two waves of block churn with the compile
+watcher attached (zero storms), bit-identical streams through
+``ServingEngine`` (greedy + sampled, spec_k on and off) vs
+``generate()``, the ``tddl_serve_attn_kernel{path=}`` gauge +
+decode_tick_fraction summary surface, and same-flag-decisions on the
+seeded poison drill with the epilogue in the loop."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trustworthy_dl_tpu.models import generate as gen
+from trustworthy_dl_tpu.models import gpt2
+from trustworthy_dl_tpu.models.generate import generate
+from trustworthy_dl_tpu.obs.registry import MetricsRegistry
+from trustworthy_dl_tpu.ops import paged_attention as pattn
+from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+from trustworthy_dl_tpu.serve.scheduler import _logit_signals
+
+pytestmark = pytest.mark.pagedattn
+
+# vocab_size continues the 97/101/103/107/113/127/139 process-global
+# jit-cache isolation sequence: the paged program caches are
+# process-global (scheduler._PROGRAMS), so a config identical to a
+# sibling suite's would let that file pre-warm the programs this file's
+# strict compile-once pins measure (and vice versa).  The attn_impl
+# static separates kernel-on from kernel-off programs WITHIN this file.
+CFG = gpt2.GPT2Config(vocab_size=157, n_positions=64, n_layer=2, n_embd=32,
+                      n_head=4, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2.init_params(jax.random.PRNGKey(0), CFG)
+
+
+# --------------------------------------------------------------------------
+# Kernel vs reference semantics (standalone, no transformer in the loop)
+# --------------------------------------------------------------------------
+
+
+def test_kernel_matches_reference_fp32_ragged():
+    """Interpret-mode kernel equality against the gather-semantics
+    reference: ragged per-row lengths, causal windows crossing block
+    boundaries, decode (T=1) through chunk-sized windows, scalar and
+    vector ``start``."""
+    rng = np.random.default_rng(0)
+    nb, h, bsz, dh = 9, 3, 8, 16
+    r, nbps = 4, 4
+    pool_k = jnp.asarray(rng.normal(size=(nb, h, bsz, dh)), jnp.float32)
+    pool_v = jnp.asarray(rng.normal(size=(nb, h, bsz, dh)), jnp.float32)
+    table = jnp.asarray(rng.integers(0, nb, size=(r, nbps)), jnp.int32)
+    # Ragged: row 0 empty history, row 3 nearly full; starts 5 and 13
+    # put the causal window mid-block and across a block boundary.
+    start = jnp.asarray([0, 5, 13, 30], jnp.int32)
+    for t in (1, 3, 8):
+        q = jnp.asarray(rng.normal(size=(r, h, t, dh)), jnp.float32)
+        got = pattn.paged_attention(q, pool_k, pool_v, table, start,
+                                    interpret=True)
+        ref = pattn.paged_attention_reference(q, pool_k, pool_v, table,
+                                              start)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    # Scalar start (the chunked-prefill spelling, R=1).
+    q = jnp.asarray(rng.normal(size=(1, h, 5, dh)), jnp.float32)
+    got = pattn.paged_attention(q, pool_k, pool_v, table[:1],
+                                jnp.asarray(8, jnp.int32), interpret=True)
+    ref = pattn.paged_attention_reference(q, pool_k, pool_v, table[:1],
+                                          jnp.asarray(8, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_matches_reference_int8_scales():
+    """int8 KV streaming: the in-register dequant (K scale post-dot, V
+    scale folded into the probabilities) equals the reference's
+    gathered-view algebra."""
+    rng = np.random.default_rng(1)
+    nb, h, bsz, dh = 7, 2, 8, 8
+    r, nbps = 3, 3
+    pool_k = jnp.asarray(rng.integers(-127, 128, size=(nb, h, bsz, dh)),
+                         jnp.int8)
+    pool_v = jnp.asarray(rng.integers(-127, 128, size=(nb, h, bsz, dh)),
+                         jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(nb, h, bsz)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(nb, h, bsz)), jnp.float32)
+    table = jnp.asarray(rng.integers(0, nb, size=(r, nbps)), jnp.int32)
+    start = jnp.asarray([0, 7, 17], jnp.int32)
+    for t in (1, 4):
+        q = jnp.asarray(rng.normal(size=(r, h, t, dh)), jnp.float32)
+        got = pattn.paged_attention(q, pool_k, pool_v, table, start,
+                                    k_scale=ks, v_scale=vs, interpret=True)
+        ref = pattn.paged_attention_reference(q, pool_k, pool_v, table,
+                                              start, k_scale=ks,
+                                              v_scale=vs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# Kernel path vs jnp path through the REAL paged transformer stack
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_paged_apply_kernel_vs_jnp_logits_and_pools(params, kv_dtype):
+    """``_apply_with_cache_paged`` with attn_impl="interpret" vs "jnp"
+    over identical pools: decode logits agree to f32 epsilon, verify-
+    window (all_logits) logits agree, and the pool writes agree to the
+    same epsilon (layer 0's writes are value-identical — same qkv, same
+    scatter — and deeper layers inherit the upstream attention epsilon
+    through the scan; on the int8 tier that epsilon can flip a rounding
+    by at most one int8 step, the same numerics class the parity probe
+    tolerates) — fp32 and int8 tiers, ragged lengths, a window crossing
+    a block boundary."""
+    from trustworthy_dl_tpu.serve.kv_slots import init_paged_pool
+
+    rng = np.random.default_rng(2)
+    bsz, num_blocks, r, nbps = 8, 12, 3, 4
+    kv = init_paged_pool(CFG, num_blocks, bsz,
+                         kv_dtype=jnp.int8 if kv_dtype == "int8"
+                         else jnp.float32)
+    # Seed the pool with content so history actually matters.
+    if kv_dtype == "int8":
+        k0 = jnp.asarray(rng.integers(-127, 128, size=kv.k.shape), jnp.int8)
+        v0 = jnp.asarray(rng.integers(-127, 128, size=kv.v.shape), jnp.int8)
+        ks0 = jnp.asarray(rng.uniform(0.005, 0.05, size=kv.k_scale.shape),
+                          jnp.float32)
+        pools = (k0, v0, ks0, ks0)
+    else:
+        k0 = jnp.asarray(rng.normal(size=kv.k.shape) * 0.3, jnp.float32)
+        v0 = jnp.asarray(rng.normal(size=kv.v.shape) * 0.3, jnp.float32)
+        pools = (k0, v0, None, None)
+    # DISJOINT tables — the BlockAllocator's invariant: a row only ever
+    # WRITES exclusively-owned blocks (shared prefix blocks are read-only
+    # history).  The write-then-attend kernel path and the
+    # gather-then-write jnp path agree exactly under that invariant; a
+    # row reading another row's same-tick write block would be an
+    # allocator bug, not an attention-path choice.  Ragged lengths: 1,
+    # 11 (history crosses a block boundary) and 26.
+    table = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]],
+                        jnp.int32)
+    lengths = jnp.asarray([1, 11, 26], jnp.int32)
+    view = gen._decode_view(params, CFG)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(r, 1)),
+                         jnp.int32)
+    outs = {}
+    for impl in ("jnp", "interpret"):
+        outs[impl] = gen._apply_with_cache_paged(
+            view, tokens, *pools, table, lengths, CFG, attn_impl=impl)
+    np.testing.assert_allclose(np.asarray(outs["jnp"][0]),
+                               np.asarray(outs["interpret"][0]),
+                               rtol=2e-4, atol=2e-4)
+    for i in (1, 2, 3, 4):  # pool k, v, k_scale, v_scale
+        if outs["jnp"][i] is None:
+            assert outs["interpret"][i] is None
+            continue
+        a = np.asarray(outs["jnp"][i]).astype(np.float32)
+        b = np.asarray(outs["interpret"][i]).astype(np.float32)
+        if kv_dtype == "int8" and i in (1, 2):
+            assert np.abs(a - b).max() <= 1          # one rounding step
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # Verify-window shape (the spec_verify program's read): T=4 starting
+    # at the pre-draft lengths, all-position logits.
+    tokens_w = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(r, 4)),
+                           jnp.int32)
+    outs_w = {}
+    for impl in ("jnp", "interpret"):
+        outs_w[impl] = gen._apply_with_cache_paged(
+            view, tokens_w, *pools, table, lengths, CFG,
+            all_logits=True, attn_impl=impl)
+    np.testing.assert_allclose(np.asarray(outs_w["jnp"][0]),
+                               np.asarray(outs_w["interpret"][0]),
+                               rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------------------------
+# Trust epilogue
+# --------------------------------------------------------------------------
+
+
+def test_trust_epilogue_matches_engine_reductions():
+    """The fused epilogue equals the engine's existing per-token
+    reductions: margin BIT-exact (top-2 merge is max/min only, including
+    duplicated maxima), entropy to f32 epsilon — over random,
+    collapsed-distribution and near-tie logits at the serve vocab."""
+    rng = np.random.default_rng(3)
+    cases = [
+        jnp.asarray(rng.normal(size=(5, CFG.vocab_size)) * 4, jnp.float32),
+        # Collapse (one dominant logit — the backdoor signature).
+        jnp.zeros((3, CFG.vocab_size), jnp.float32).at[:, 7].set(30.0),
+        # Exact near-tie: duplicated maximum, margin must be exactly 0.
+        jnp.zeros((2, CFG.vocab_size), jnp.float32)
+        .at[:, 3].set(5.0).at[:, 100].set(5.0),
+    ]
+    for logits in cases:
+        ent_k, mar_k = _logit_signals(logits, "interpret")
+        ent_j, mar_j = _logit_signals(logits, "jnp")
+        np.testing.assert_array_equal(np.asarray(mar_k), np.asarray(mar_j))
+        np.testing.assert_allclose(np.asarray(ent_k), np.asarray(ent_j),
+                                   rtol=1e-5, atol=1e-5)
+    # And against the module's own reference spelling at an odd vocab.
+    logits = jnp.asarray(rng.normal(size=(4, 50257)) * 3, jnp.float32)
+    ent_k, mar_k = pattn.logit_trust_stats(logits, interpret=True)
+    ent_r, mar_r = pattn.logit_trust_stats_reference(logits)
+    np.testing.assert_array_equal(np.asarray(mar_k), np.asarray(mar_r))
+    np.testing.assert_allclose(np.asarray(ent_k), np.asarray(ent_r),
+                               rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Dispatch gate
+# --------------------------------------------------------------------------
+
+
+def test_resolve_and_supports_gate(monkeypatch):
+    """The shared-gate dispatch contract: "jnp" passes through; "auto"
+    follows TDDL_PAGED_ATTN (default off-TPU = jnp fallback, the CPU
+    container tier's green path); opt-in resolves to interpret off-TPU;
+    explicit "pallas" on a non-TPU backend RAISES (the interpreter is
+    not the kernel); compiled tiling rules (per-dtype sublane: f32 8,
+    bf16 16, int8 32) downgrade "auto" loudly and REJECT an explicit
+    ask."""
+    monkeypatch.delenv("TDDL_PAGED_ATTN", raising=False)
+    kw = dict(head_dim=64, block_size=16, kv_dtype=jnp.float32)
+    assert pattn.resolve_attn_impl("jnp", **kw) == "jnp"
+    # Default off-TPU: gate closed, jnp fallback stays the default.
+    assert pattn.resolve_attn_impl("auto", **kw) == "jnp"
+    monkeypatch.setenv("TDDL_PAGED_ATTN", "1")
+    assert pattn.resolve_attn_impl("auto", **kw) == "interpret"
+    monkeypatch.setenv("TDDL_PAGED_ATTN", "0")
+    assert pattn.resolve_attn_impl("auto", **kw) == "jnp"
+    with pytest.raises(ValueError, match="attn_impl"):
+        pattn.resolve_attn_impl("mosaic", **kw)
+    # Explicit "pallas" asked for COMPILED Mosaic by name — on this CPU
+    # backend that must fail loudly, not silently serve the interpreter.
+    with pytest.raises(ValueError, match="TPU backend"):
+        pattn.resolve_attn_impl("pallas", **kw)
+    # Compiled tiling rules: the sublane follows the POOL dtype
+    # (interpret mode has none — the int8 equality pins above run at
+    # block_size 8).
+    assert pattn.kv_sublane(jnp.float32) == 8
+    assert pattn.kv_sublane(jnp.bfloat16) == 16
+    assert pattn.kv_sublane(jnp.int8) == 32
+    assert pattn.supports_paged_attention(
+        head_dim=64, block_size=16, kv_dtype=jnp.float32, interpret=False)
+    assert not pattn.supports_paged_attention(
+        head_dim=64, block_size=12, kv_dtype=jnp.float32, interpret=False)
+    # bf16 pools need the 16-sublane: block_size 8 must NOT pass.
+    assert not pattn.supports_paged_attention(
+        head_dim=64, block_size=8, kv_dtype=jnp.bfloat16, interpret=False)
+    assert pattn.supports_paged_attention(
+        head_dim=64, block_size=16, kv_dtype=jnp.bfloat16, interpret=False)
+    assert pattn.supports_paged_attention(
+        head_dim=64, block_size=32, kv_dtype=jnp.int8, interpret=False)
+    assert not pattn.supports_paged_attention(
+        head_dim=64, block_size=16, kv_dtype=jnp.int8, interpret=False)
+    assert pattn.supports_paged_attention(
+        head_dim=64, block_size=8, kv_dtype=jnp.int8, interpret=True)
+    with pytest.raises(ValueError, match="cannot dispatch"):
+        pattn.resolve_attn_impl("interpret", head_dim=1024, block_size=8,
+                                kv_dtype=jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Served streams: bit-identical vs generate(), compile-once, zero storms
+# --------------------------------------------------------------------------
+
+
+def _requests():
+    rng = np.random.default_rng(7)
+    reqs = []
+    for _ in range(5):
+        plen = int(rng.integers(3, 14))
+        reqs.append(ServeRequest(
+            prompt=rng.integers(0, CFG.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(2, 9))))
+    reqs.append(ServeRequest(prompt=[2, 71, 8, 28], max_new_tokens=6,
+                             temperature=0.8, rng=jax.random.PRNGKey(42)))
+    return reqs
+
+
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_streams_bit_identical_vs_generate(params, spec_k):
+    """THE acceptance pin: with the kernel in the loop (interpret mode —
+    the same code path the TPU compiles) the engine serves greedy AND
+    seeded-sampled streams bit-identical to ``generate()``, spec_k on
+    and off, across chunked prefill, block churn and prefix sharing."""
+    engine = ServingEngine(params, CFG, max_slots=3, max_seq=48,
+                           queue_limit=32, rng=jax.random.PRNGKey(5),
+                           block_size=8, prefill_chunk=16, spec_k=spec_k,
+                           attn_impl="interpret")
+    assert engine.attn_kernel_path == "interpret"
+    for req in _requests():
+        engine.submit(req)
+    results = engine.run_until_idle()
+    assert all(r.status == "completed" for r in results.values())
+    for rid, req in enumerate(_requests()):
+        ref = generate(params, CFG,
+                       jnp.asarray([list(req.prompt)], jnp.int32),
+                       req.max_new_tokens, temperature=req.temperature,
+                       rng=(req.rng if req.rng is not None
+                            else jax.random.fold_in(jax.random.PRNGKey(5),
+                                                    rid)))
+        ref_tokens = np.asarray(ref)[0, len(req.prompt):].tolist()
+        assert results[rid].tokens == ref_tokens, f"request {rid}"
+
+
+def test_int8_kv_kernel_streams_match_jnp(params):
+    """int8 KV pool with the kernel in the loop: streams equal the jnp
+    gather path token for token (the in-register dequant is the same
+    algebra; the attn_impl static keys separate compiled programs, so
+    the two engines genuinely run different code)."""
+    kwargs = dict(max_slots=2, max_seq=48, queue_limit=16, block_size=8,
+                  kv_dtype="int8", kv_parity_check=False,
+                  rng=jax.random.PRNGKey(5))
+    outs = {}
+    for impl in ("jnp", "interpret"):
+        engine = ServingEngine(params, CFG, attn_impl=impl, **kwargs)
+        for i in range(3):
+            engine.submit(ServeRequest(prompt=[5, 17, 3, 2 + i],
+                                       max_new_tokens=5))
+        outs[impl] = {r: v.tokens
+                      for r, v in engine.run_until_idle().items()}
+    assert outs["jnp"] == outs["interpret"]
+
+
+def test_compile_once_under_block_churn_zero_storms(params):
+    """The compile-once pin with the kernel in the loop and the PR 10
+    CompileWatcher attached: two waves of ragged requests (retirements
+    free and re-map blocks between waves; a shared prefix exercises the
+    radix cache) — the fused decode program compiles exactly once and
+    the watcher records ZERO storms."""
+    from trustworthy_dl_tpu.obs.compilewatch import (
+        CompileRegistry,
+        CompileWatcher,
+    )
+
+    registry = CompileRegistry().install()
+    watcher = CompileWatcher(registry)
+    try:
+        engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                               block_size=8, prefill_chunk=8,
+                               queue_limit=32, attn_impl="interpret",
+                               compilewatch=watcher)
+        before = engine.scheduler.decode_cache_size()
+        rng = np.random.default_rng(3)
+        shared = rng.integers(0, CFG.vocab_size, 9).tolist()
+        served = 0
+        for _wave in range(2):
+            engine.submit(ServeRequest(prompt=shared, max_new_tokens=3))
+            for _ in range(3):
+                plen = int(rng.integers(3, 12))
+                engine.submit(ServeRequest(
+                    prompt=rng.integers(0, CFG.vocab_size, plen).tolist(),
+                    max_new_tokens=int(rng.integers(2, 6))))
+            results = engine.run_until_idle()
+            served += len(engine.drain_results())
+        assert served == 8
+        assert all(r.status == "completed" for r in results.values())
+        assert engine.scheduler.decode_cache_size() - before == 1
+        assert watcher.storm_total == 0
+    finally:
+        registry.uninstall()
+
+
+# --------------------------------------------------------------------------
+# Obs surface + the poison drill
+# --------------------------------------------------------------------------
+
+
+def test_attn_gauge_and_summary_surface(params):
+    """Every serve snapshot names the active attention path: the
+    ``tddl_serve_attn_kernel{path=}`` gauge sets 1 on exactly the
+    resolved path, and metrics_summary carries decode_tick_fraction +
+    attn_kernel_path (the pair the perf sentinel bands)."""
+    for impl, expect in (("interpret", "interpret"), ("jnp", "jnp")):
+        registry = MetricsRegistry()
+        engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                               block_size=8, registry=registry,
+                               attn_impl=impl)
+        engine.submit(ServeRequest(prompt=[3, 1, 4], max_new_tokens=3))
+        engine.run_until_idle()
+        gauge = registry.get("tddl_serve_attn_kernel")
+        for path in ("pallas", "interpret", "jnp"):
+            assert gauge.value(path=path) == (1.0 if path == expect
+                                              else 0.0), (impl, path)
+        summary = engine.metrics_summary()
+        assert summary["attn_kernel_path"] == expect
+        assert 0.0 < summary["decode_tick_fraction"] <= 1.0
+    # The stripe pool has no paged kernel: its path is always jnp.
+    stripe = ServingEngine(params, CFG, max_slots=2, max_seq=32,
+                           paged=False, registry=MetricsRegistry())
+    assert stripe.attn_kernel_path == "jnp"
+
+
+def test_config_knob_validation_and_threading(params):
+    """ServeConfig.attn_impl fails loudly where the operator typed it
+    and threads through from_config to the resolved scheduler path."""
+    from trustworthy_dl_tpu.core.config import ServeConfig
+
+    with pytest.raises(ValueError, match="attn_impl"):
+        ServeConfig(attn_impl="mosaic")
+    engine = ServingEngine.from_config(
+        params, CFG, ServeConfig(max_slots=2, max_seq=32, block_size=8,
+                                 attn_impl="interpret"))
+    assert engine.attn_kernel_path == "interpret"
+    off = ServingEngine.from_config(
+        params, CFG, ServeConfig(max_slots=2, max_seq=32, block_size=8))
+    # Default "auto" resolves to the jnp fallback on the CPU tier (gate
+    # closed) — the container default stays green and kernel-free.
+    assert off.attn_kernel_path == "jnp"
+    # A forced path on the stripe pool (no kernel exists there) fails
+    # loudly at the engine, and ServeConfig warns like any paged knob
+    # set alongside paged=False.
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(params, CFG, max_slots=2, max_seq=32, paged=False,
+                      attn_impl="interpret")
+    with pytest.warns(UserWarning, match="attn_impl"):
+        ServeConfig(paged=False, attn_impl="jnp")
+
+
+def test_poison_drill_same_flag_decisions(params):
+    """The seeded SERVE_POISON drill with the epilogue in the loop: the
+    kernel-path engine flags the SAME request and quarantines the same
+    number of slots as the jnp-path engine — monitor decisions ride the
+    epilogue's entropy/margin without drift."""
+    from trustworthy_dl_tpu.chaos import FaultEvent, FaultInjector, \
+        FaultKind, FaultPlan
+    from trustworthy_dl_tpu.serve.engine import OutputMonitor
+
+    verdicts = {}
+    for impl in ("interpret", "jnp"):
+        plan = FaultPlan.scripted([
+            FaultEvent(step=4, kind=FaultKind.SERVE_POISON),
+        ])
+        # z_threshold 50: this vocab's natural margin variation reaches
+        # z~6 at warmup 3, while the poison overwrite lands z > 10^4 —
+        # the drill isolates the poison path, and the assertion below is
+        # the cross-impl one that matters: SAME decisions on both paths.
+        engine = ServingEngine(params, CFG, max_slots=2, max_seq=48,
+                               block_size=8, attn_impl=impl,
+                               monitor=OutputMonitor(warmup=3,
+                                                     z_threshold=50.0),
+                               chaos=FaultInjector(plan))
+        rng = np.random.default_rng(0)
+        for _ in range(5):   # ids 0..4; id 4 is the poisoned one
+            plen = int(rng.integers(3, 10))
+            engine.submit(ServeRequest(
+                prompt=rng.integers(0, CFG.vocab_size, plen).tolist(),
+                max_new_tokens=int(rng.integers(2, 6))))
+        results = engine.run_until_idle()
+        verdicts[impl] = {rid: r.flagged for rid, r in results.items()}
+        assert results[4].flagged and not results[3].flagged
+        assert len(engine.quarantined_slots) == 1
+    assert verdicts["interpret"] == verdicts["jnp"]

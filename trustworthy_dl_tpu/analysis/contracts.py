@@ -59,6 +59,10 @@ HOT_LOOP_MODULES = (
     "trustworthy_dl_tpu/engine/step.py",
     "trustworthy_dl_tpu/engine/trainer.py",
     "trustworthy_dl_tpu/models/generate.py",
+    # The paged-attention kernel module runs INSIDE every paged decode
+    # program (its wrapper traces per layer per tick) — a per-call
+    # device constant here is a per-tick constant upload.
+    "trustworthy_dl_tpu/ops/paged_attention.py",
 )
 
 #: module -> function names forming the latency-critical dispatch paths
@@ -70,6 +74,12 @@ HOST_SYNC_SCOPES = {
         "decode_tick", "_spec_tick", "_advance_prefill", "admit",
     ),
     "trustworthy_dl_tpu/engine/trainer.py": ("train_epoch",),
+    # The kernel dispatch wrappers trace inside jitted serve programs:
+    # any host pull of a traced value here would serialise every decode
+    # tick (there is no intentional pull — these scopes allow zero).
+    "trustworthy_dl_tpu/ops/paged_attention.py": (
+        "paged_attention", "logit_trust_stats",
+    ),
 }
 
 #: Modules that write persistent artifacts (checkpoints, ledgers,
@@ -126,8 +136,8 @@ PREDICT_FUNCTION_PATTERNS = (
 #: added HERE (and to the dashboards) deliberately, not slipped in.
 KNOWN_METRIC_LABELS = frozenset({
     "action", "device", "direction", "dtype", "kind", "metric", "node",
-    "outcome", "phase", "replica", "scope", "signal", "slo", "slo_class",
-    "stage", "state", "status", "tenant", "to_state", "type",
+    "outcome", "path", "phase", "replica", "scope", "signal", "slo",
+    "slo_class", "stage", "state", "status", "tenant", "to_state", "type",
 })
 
 #: Metric-name prefix every registered literal must carry (the

@@ -393,12 +393,24 @@ class ServeConfig:
     prefix_cache: bool = True
     prefill_chunk: Optional[int] = None
     spec_k: int = 0
+    # Decode-attention path (paged pool only): "auto" resolves through
+    # the shared Pallas gate (TDDL_PAGED_ATTN; kernel on TPU, jnp gather
+    # fallback elsewhere), "pallas"/"interpret"/"jnp" force a path —
+    # README §Serving/"Decode attention kernel".
+    attn_impl: str = "auto"
 
     def __post_init__(self) -> None:
         from trustworthy_dl_tpu.quant import validate_dtypes
         from trustworthy_dl_tpu.serve.kv_slots import validate_paged_geometry
 
         validate_dtypes(self.kv_dtype, self.weight_dtype)
+        if self.attn_impl not in ("auto", "pallas", "interpret", "jnp"):
+            # Mirrors ops.paged_attention.ATTN_IMPLS — checked here with
+            # a literal so a bad knob fails without touching jax.
+            raise ValueError(
+                f"attn_impl must be one of ('auto', 'pallas', "
+                f"'interpret', 'jnp'), got {self.attn_impl!r}"
+            )
         validate_spec(self.spec_k, self.paged, self.weight_dtype)
         if self.max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
@@ -409,7 +421,7 @@ class ServeConfig:
                                     self.num_blocks, self.prefill_chunk)
         else:
             paged_knobs = ("block_size", "num_blocks", "prefix_cache",
-                           "prefill_chunk")
+                           "prefill_chunk", "attn_impl")
             # Compare against the dataclass field defaults themselves —
             # a hand-written (name, default) table here would be a third
             # copy of the defaults that could silently drift.

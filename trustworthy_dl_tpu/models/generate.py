@@ -103,6 +103,39 @@ def _write_cache_rows(layer_kv: jax.Array, new: jax.Array,
     return row_update(layer_kv, new, start)
 
 
+def _attn_qkv(block: Params, x: jax.Array,
+              cfg: gpt2.GPT2Config) -> Tuple[jax.Array, jax.Array,
+                                             jax.Array]:
+    """The pre-attention scaffolding EVERY cached-decode block shares
+    (gathered-view path and kernel path alike — one spelling, so a
+    numerics fix cannot diverge them): ln_1 + fused qkv projection +
+    head split.  [B, T, D] -> q, k, v [B, H, T, Dh]."""
+    from trustworthy_dl_tpu.quant import int8 as q8
+
+    dtype = cfg.dtype
+    y = L.layernorm(block["ln_1"], x).astype(dtype)
+    qkv = q8.qdense(block["attn"]["qkv"], y, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return tuple(_split_heads(a, cfg.n_head) for a in (q, k, v))
+
+
+def _attn_mlp_tail(block: Params, x: jax.Array, out: jax.Array,
+                   cfg: gpt2.GPT2Config) -> jax.Array:
+    """The post-attention scaffolding every cached-decode block shares:
+    merge heads, attention projection + residual, ln_2 + MLP +
+    residual.  ``out`` [B, H, T, Dh] is the attention output."""
+    from trustworthy_dl_tpu.quant import int8 as q8
+
+    dtype = cfg.dtype
+    b, t, d = x.shape
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    x = x + q8.qdense(block["attn"]["proj"], out, dtype).astype(x.dtype)
+    y = L.layernorm(block["ln_2"], x).astype(dtype)
+    y = q8.qdense(block["mlp"]["fc"], y, dtype)
+    y = jax.nn.gelu(y)
+    return x + q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+
+
 def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
                       layer_v: jax.Array, start: jax.Array,
                       cfg: gpt2.GPT2Config,
@@ -136,10 +169,7 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
     s = layer_k.shape[-2]
     quantized = layer_k_scale is not None
 
-    y = L.layernorm(block["ln_1"], x).astype(dtype)
-    qkv = q8.qdense(block["attn"]["qkv"], y, dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_split_heads(a, h) for a in (q, k, v))  # [B, H, T, Dh]
+    q, k, v = _attn_qkv(block, x, cfg)                 # [B, H, T, Dh]
 
     if quantized:
         k_q, k_s = q8.quantize_kv(k)                   # int8, f32 [B,H,T]
@@ -174,13 +204,7 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
         out = jnp.einsum("bhqk,bhkd->bhqd", pv, layer_v.astype(dtype))
     else:
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, layer_v)
-    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
-    x = x + q8.qdense(block["attn"]["proj"], out, dtype).astype(x.dtype)
-
-    y = L.layernorm(block["ln_2"], x).astype(dtype)
-    y = q8.qdense(block["mlp"]["fc"], y, dtype)
-    y = jax.nn.gelu(y)
-    x = x + q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+    x = _attn_mlp_tail(block, x, out, cfg)
     return x, layer_k, layer_v, layer_k_scale, layer_v_scale
 
 
@@ -322,19 +346,64 @@ def _paged_gather(layer_pool: jax.Array, table: jax.Array) -> jax.Array:
     return g.reshape(g.shape[0], g.shape[1], -1)
 
 
+def _pool_write_coords(table_read: jax.Array, start: jax.Array, r: int,
+                       t: int, bsz: int, nbps: int
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(positions [R, T], physical block [R·T], in-block offset [R·T])
+    for the T positions each row writes this call — positions past the
+    slot's real table land in the reserved trash block 0.  ONE spelling
+    shared by the gather path (which extracts the written rows from its
+    view at ``pos``) and the kernel path (which scatters the fresh K/V
+    directly), so for the SAME block input the two paths write identical
+    values to identical pool coordinates (across a multi-layer scan,
+    deeper layers inherit the attention paths' f32-rounding epsilon
+    through their activations)."""
+    if jnp.ndim(start) == 0:
+        pos = jnp.broadcast_to((start + jnp.arange(t))[None, :], (r, t))
+    else:
+        pos = start[:, None] + jnp.arange(t)[None, :]      # [R, T]
+    lb = pos // bsz
+    valid = lb < nbps
+    phys = jnp.take_along_axis(table_read, jnp.minimum(lb, nbps - 1),
+                               axis=1)
+    phys = jnp.where(valid, phys, 0).reshape(-1)           # 0 = trash
+    offs = (pos % bsz).reshape(-1)
+    return pos, phys, offs
+
+
 def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
                  pool_v_l: jax.Array, table: jax.Array, start: jax.Array,
                  cfg: gpt2.GPT2Config,
                  pool_ks_l: Optional[jax.Array] = None,
                  pool_vs_l: Optional[jax.Array] = None,
+                 attn_impl: str = "jnp",
                  ) -> Tuple[jax.Array, jax.Array, jax.Array,
                             Optional[jax.Array], Optional[jax.Array]]:
     """One transformer block over [R, T, D] new positions against a PAGED
-    layer pool: gather each row's view through ``table``, run the dense
-    ``_block_with_cache`` core on it (one numerics source for generate,
-    stripe serve and paged serve), then scatter the newly written rows
-    back into the pool.  ``start`` follows the dense contract: scalar
-    (chunked prefill, R=1) or i32[R] (fused decode, T=1)."""
+    layer pool.  ``attn_impl`` (trace-time static — the scheduler bakes
+    its resolved path into each compiled program) selects the attention
+    read:
+
+    * ``"jnp"`` (default, the reference semantics): gather each row's
+      view through ``table``, run the dense ``_block_with_cache`` core on
+      it (one numerics source for generate, stripe serve and paged
+      serve), then scatter the newly written rows back into the pool.
+    * ``"pallas"`` / ``"interpret"``: scatter the fresh K/V into the pool
+      FIRST (same quantize-at-write values, same ``_pool_write_coords``
+      scatter), then run the ragged ``ops.paged_attention`` kernel
+      straight over the pool: no [R, H, S, Dh] view is ever
+      materialised, int8 tiles dequantise in-register, rows stop
+      streaming at their true length.  Write-then-attend equals the jnp
+      path's write-into-view because writes only ever land in blocks the
+      row owns exclusively (kv_slots' COW discipline) — no row can
+      observe another row's same-tick write on either path.
+
+    ``start`` follows the dense contract: scalar (chunked prefill, R=1)
+    or i32[R] (fused decode, T=1)."""
+    if attn_impl != "jnp":
+        return _paged_block_kernel(block, x, pool_k_l, pool_v_l, table,
+                                   start, cfg, pool_ks_l, pool_vs_l,
+                                   interpret=(attn_impl == "interpret"))
     r, t, _ = x.shape
     nbps = table.shape[1]
     bsz = pool_k_l.shape[2]
@@ -358,16 +427,8 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
         block, x, view_k, view_v, start, cfg, view_ks, view_vs
     )
     # Positions this call wrote into the view -> (physical block, offset).
-    if jnp.ndim(start) == 0:
-        pos = jnp.broadcast_to((start + jnp.arange(t))[None, :], (r, t))
-    else:
-        pos = start[:, None] + jnp.arange(t)[None, :]      # [R, T]
-    lb = pos // bsz
-    valid = lb < nbps
-    phys = jnp.take_along_axis(table_read, jnp.minimum(lb, nbps - 1),
-                               axis=1)
-    phys = jnp.where(valid, phys, 0).reshape(-1)           # 0 = trash
-    offs = (pos % bsz).reshape(-1)
+    pos, phys, offs = _pool_write_coords(table_read, start, r, t, bsz,
+                                         nbps)
     idx = pos[:, None, :, None]                            # [R, 1, T, 1]
 
     def rows_of(view):                                     # [R, H, S(,Dh)]
@@ -386,6 +447,61 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
     return x, pool_k_l, pool_v_l, pool_ks_l, pool_vs_l
 
 
+def _paged_block_kernel(block: Params, x: jax.Array, pool_k_l: jax.Array,
+                        pool_v_l: jax.Array, table: jax.Array,
+                        start: jax.Array, cfg: gpt2.GPT2Config,
+                        pool_ks_l: Optional[jax.Array],
+                        pool_vs_l: Optional[jax.Array],
+                        interpret: bool,
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                   Optional[jax.Array],
+                                   Optional[jax.Array]]:
+    """The kernel-path twin of the gather branch in :func:`_paged_block`:
+    write-then-attend.  The fresh K/V (quantized at the write on the int8
+    tier — the exact values the gather path writes) scatter into the pool
+    first; the ``ops.paged_attention`` kernel then reads positions
+    [0, start+T) straight from the pool with the causal window masked
+    in absolute positions, which is precisely what the gathered view
+    exposes to ``_block_with_cache``."""
+    from trustworthy_dl_tpu.ops import paged_attention as pattn
+    from trustworthy_dl_tpu.quant import int8 as q8
+
+    r, t, _ = x.shape
+    h = cfg.n_head
+    nbps = table.shape[1]
+    bsz = pool_k_l.shape[2]
+    quantized = pool_ks_l is not None
+
+    # Shared pre/post-attention scaffolding (_attn_qkv/_attn_mlp_tail):
+    # only the attention READ differs from _block_with_cache.
+    q, k, v = _attn_qkv(block, x, cfg)                     # [R, H, T, Dh]
+
+    _, phys, offs = _pool_write_coords(table, start, r, t, bsz, nbps)
+
+    def rows_of(a):                       # [R, H, T(, Dh)] -> [R·T, H(, Dh)]
+        if a.ndim == 4:
+            return a.transpose(0, 2, 1, 3).reshape(r * t, h, a.shape[-1])
+        return a.transpose(0, 2, 1).reshape(r * t, h)
+
+    if quantized:
+        k_w, k_s = q8.quantize_kv(k)                       # int8, f32 [R,H,T]
+        v_w, v_s = q8.quantize_kv(v)
+        pool_ks_l = pool_ks_l.at[phys, :, offs].set(rows_of(k_s))
+        pool_vs_l = pool_vs_l.at[phys, :, offs].set(rows_of(v_s))
+    else:
+        k_w = k.astype(pool_k_l.dtype)
+        v_w = v.astype(pool_v_l.dtype)
+    pool_k_l = pool_k_l.at[phys, :, offs].set(rows_of(k_w))
+    pool_v_l = pool_v_l.at[phys, :, offs].set(rows_of(v_w))
+
+    out = pattn.paged_attention(
+        q, pool_k_l, pool_v_l, table, start,
+        k_scale=pool_ks_l, v_scale=pool_vs_l, interpret=interpret,
+    ).astype(cfg.dtype)                                    # [R, H, T, Dh]
+    x = _attn_mlp_tail(block, x, out, cfg)
+    return x, pool_k_l, pool_v_l, pool_ks_l, pool_vs_l
+
+
 def _apply_with_cache_paged(params: Params, tokens: jax.Array,
                             pool_k: jax.Array, pool_v: jax.Array,
                             pool_ks: Optional[jax.Array],
@@ -394,6 +510,7 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
                             cfg: gpt2.GPT2Config,
                             last_pos: Optional[jax.Array] = None,
                             all_logits: bool = False,
+                            attn_impl: str = "jnp",
                             ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                        Optional[jax.Array],
                                        Optional[jax.Array]]:
@@ -404,7 +521,11 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
     arrays) — pool updates are functional, the scheduler threads them.
     ``all_logits`` (trace-time bool) returns [R, T, V] logits at every
     fed position instead — the speculative-verify program's tail, where
-    the target's token choice is needed at each draft position."""
+    the target's token choice is needed at each draft position.
+    ``attn_impl`` (trace-time static, see :func:`_paged_block`) swaps the
+    gathered-view attention for the ragged ``ops.paged_attention``
+    kernel; tables/starts stay traced values either way, so the
+    compile-once pin holds on both paths."""
     t = tokens.shape[-1]
     if jnp.ndim(start) == 0:
         pos = start + jnp.arange(t)                        # [T]
@@ -416,7 +537,8 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
         x = carry
         block, pk, pv, pks, pvs = layer
         x, pk, pv, pks, pvs = _paged_block(block, x, pk, pv, table, start,
-                                           cfg, pks, pvs)
+                                           cfg, pks, pvs,
+                                           attn_impl=attn_impl)
         return x, (pk, pv, pks, pvs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(

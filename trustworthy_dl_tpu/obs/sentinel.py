@@ -45,6 +45,13 @@ SENTINEL_METRICS: Dict[str, str] = {
     # like a throughput regression — tokens/s would eventually show it,
     # but accepted_rate names the cause.
     "accepted_rate": "higher",
+    # Decode-phase share of the serve wall (engine.decode_tick_s /
+    # elapsed).  A silent fall-back from the paged-attention kernel to
+    # the jnp gather path (gate flipped, geometry stopped tiling,
+    # backend change) inflates exactly this number — it pages like a
+    # perf regression even while tokens/s noise hides it, and the
+    # tddl_serve_attn_kernel{path=} gauge names the culprit.
+    "decode_tick_fraction": "lower",
 }
 
 
@@ -56,6 +63,7 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                 compile_seconds: Optional[float] = None,
                 hbm_watermark_bytes: Optional[int] = None,
                 accepted_rate: Optional[float] = None,
+                decode_tick_fraction: Optional[float] = None,
                 run_metadata: Optional[Dict[str, Any]] = None,
                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One compact perf fingerprint.  ``key`` scopes comparability:
@@ -81,7 +89,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                         ("compile_total", compile_total),
                         ("compile_seconds", compile_seconds),
                         ("hbm_watermark_bytes", hbm_watermark_bytes),
-                        ("accepted_rate", accepted_rate)):
+                        ("accepted_rate", accepted_rate),
+                        ("decode_tick_fraction", decode_tick_fraction)):
         if value is not None:
             fp[name] = float(value)
     if phase_fractions:
@@ -293,6 +302,7 @@ def _flatten_perf(view: Dict[str, Any]) -> "List[Tuple[str, Any]]":
         hbm.get("watermark_bytes", fp.get("hbm_watermark_bytes")))
     add("tokens_per_s", fp.get("tokens_per_s"))
     add("accepted_rate", fp.get("accepted_rate"))
+    add("decode_tick_fraction", fp.get("decode_tick_fraction"))
     return rows
 
 

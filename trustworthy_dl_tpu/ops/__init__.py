@@ -11,7 +11,52 @@ isn't enough.  Current kernels:
 * ``fused_dequant_matmul`` — int8-weight dequant matmul tile for the
   serving engine's weight-only-int8 decode path (quant/): streams int8
   weight tiles HBM→VMEM, upcasts in-register, scales per output channel.
+* ``paged_attention`` — ragged paged-decode attention over the serving
+  engine's block pool (one program per block-table row, int8 KV tiles
+  dequantized in-register, online softmax, early exit at each row's true
+  length) plus the fused logit trust epilogue (entropy / top-1 margin in
+  one pass over the vocab).
+
+All four dispatch through the ONE shared gate below: :func:`pallas_enabled`
+(env-var opt-in/out, TPU-backend default) and :func:`pallas_interpret`
+(off-TPU kernels run in Pallas interpret mode — tests only).  The gate
+lives HERE, above the kernel imports, so the kernels can import it from
+the package without a cycle.
 """
+
+import os
+
+
+def pallas_enabled(env: str = "TDDL_FUSED_STATS") -> bool:
+    """THE dispatch gate every Pallas kernel in this package shares:
+    default ON on TPU, opt-out via ``<env>=0`` (and opt-in via ``=1``
+    off-TPU, where the kernel runs in interpret mode — tests only).
+
+    Env-var map: ``TDDL_FUSED_STATS`` gates fused_stats AND
+    dequant_matmul (the int8 decode tier shipped riding the stats gate
+    and keeps that coupling — flipping it off disables both kernels);
+    ``TDDL_PAGED_ATTN`` gates paged_attention.  The policy is
+    deliberately identical everywhere: the jnp/XLA path stays the
+    always-available reference semantics, and the CPU container tier
+    never compiles Mosaic.  Measured dispatch notes live with the
+    kernels (e.g. fused_stats: ~20 % step-time win on VGG/ResNet conv
+    gradients, parity on transformer gradients)."""
+    flag = os.environ.get(env)
+    if flag is not None:
+        return flag != "0"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    """Interpret-mode helper shared by every kernel's dispatch: compiled
+    Mosaic on the TPU backend, Pallas interpret mode anywhere else (the
+    CPU test tier pins kernel-vs-jnp equality through this)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
 
 from trustworthy_dl_tpu.ops.flash_attention import flash_attention
 from trustworthy_dl_tpu.ops.fused_dequant_matmul import dequant_matmul
@@ -19,7 +64,17 @@ from trustworthy_dl_tpu.ops.fused_stats import (
     BLOCK_ROWS,
     LANES,
     fused_moments,
-    pallas_enabled,
+)
+# NOTE: the ``paged_attention`` ENTRY-POINT FUNCTION is deliberately not
+# re-exported here: ``from ops import paged_attention`` must keep
+# resolving to the submodule — generate/scheduler import it as a module
+# for the whole kernel surface (attention + trust epilogue + resolver),
+# unlike ``flash_attention`` where the function deliberately shadows its
+# submodule and callers only ever want the one entry point.
+from trustworthy_dl_tpu.ops.paged_attention import (
+    logit_trust_stats,
+    resolve_attn_impl,
+    supports_paged_attention,
 )
 
 __all__ = [
@@ -28,5 +83,9 @@ __all__ = [
     "dequant_matmul",
     "flash_attention",
     "fused_moments",
+    "logit_trust_stats",
     "pallas_enabled",
+    "pallas_interpret",
+    "resolve_attn_impl",
+    "supports_paged_attention",
 ]

@@ -356,7 +356,12 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, bq: int, bk: int,
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # The shared ops-package interpret helper (one gate for all four
+    # kernels); kept as a module-local name because the custom_vjp
+    # plumbing below calls it at every trace.
+    from trustworthy_dl_tpu.ops import pallas_interpret
+
+    return pallas_interpret()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from trustworthy_dl_tpu.ops.fused_stats import pallas_enabled
+from trustworthy_dl_tpu.ops import pallas_enabled, pallas_interpret
 
 TILE_N = 128
 
@@ -90,7 +90,7 @@ def dequant_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
     m, k = x.shape
     n = w_q.shape[1]
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = pallas_interpret()
     if pallas_enabled() and dequant_matmul_tiles(m, k, n):
         pad = (-m) % 8   # f32 sublane on x/out; M = MAX_SLOTS is tiny
         if pad:

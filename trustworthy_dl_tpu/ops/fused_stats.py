@@ -17,13 +17,22 @@ is pinned by tests/test_ops.py.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# The ONE shared Pallas gate (ops/__init__.py) — re-exported here because
+# this module introduced it and call sites (detect/stats.py, PARITY.md)
+# name it as ``fused_stats.pallas_enabled``.  Measured dispatch policy for
+# THIS kernel: on GPT-2-sized transformer gradients XLA's own fusion of
+# the eight reductions is at parity with the kernel (round 3), but on
+# VGG/ResNet conv gradients XLA emits multiple HBM passes and the
+# kernel's explicit single pass is a ~20 % step-time win with detection
+# on (round 4: VGG-16 48.3 → 57.8 steps/s).
+from trustworthy_dl_tpu.ops import pallas_enabled, pallas_interpret  # noqa: F401
 
 LANES = 128
 BLOCK_ROWS = 512          # 512×128 f32 tile = 256 KB VMEM per step
@@ -111,24 +120,6 @@ def _xla_moments(x: jax.Array) -> Tuple[jax.Array, ...]:
             jnp.sum(jnp.abs(x)), jnp.max(jnp.abs(x)) if x.size else jnp.asarray(0.0))
 
 
-def pallas_enabled() -> bool:
-    """Default ON on TPU, opt-out via TDDL_FUSED_STATS=0 (and opt-in via
-    =1 off-TPU, where it runs in interpret mode — tests only).
-
-    Measured dispatch policy: on GPT-2-sized transformer gradients XLA's
-    own fusion of the eight reductions is at parity with the kernel
-    (round 3), but on VGG/ResNet conv gradients XLA emits multiple HBM
-    passes and the kernel's explicit single pass is a ~20 % step-time win
-    with detection on (round 4: VGG-16 48.3 → 57.8 steps/s, taking the
-    vision detection overhead from ~10 % to ≤5 %)."""
-    flag = os.environ.get("TDDL_FUSED_STATS")
-    if flag is not None:
-        return flag != "0"
-    import jax
-
-    return jax.default_backend() == "tpu"
-
-
 def fused_moments(x: jax.Array,
                   interpret: Optional[bool] = None) -> Tuple[jax.Array, ...]:
     """(s1, s2, s3, s4, min, max, l1, linf) of a flattened f32 vector in one
@@ -145,7 +136,7 @@ def fused_moments(x: jax.Array,
         x = x.astype(jnp.float32)
     n = x.shape[0]
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = pallas_interpret()
     chunk = BLOCK_ROWS * LANES
     n_aligned = (n // chunk) * chunk
     if n_aligned == 0:

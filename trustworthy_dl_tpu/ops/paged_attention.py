@@ -1,0 +1,539 @@
+"""Pallas TPU kernel: ragged paged-decode attention + fused trust epilogue.
+
+Decode attention over the paged KV pool (serve/kv_slots.PagedKV) has been
+reading the cache through jnp gathers: ``models/generate._paged_gather``
+materialises each row's FULL logical view [R, H, NBPS·BLOCK, Dh] in HBM
+every layer of every tick, pays the gather bandwidth for positions past
+the row's true length, and dequantises the int8 tier by algebra over that
+view.  This kernel makes the stream explicit — the single biggest
+tokens/sec lever ROADMAP item 2 names:
+
+* **one program per block-table row** (grid ``(R, H, NBPS)``): the block
+  table and per-row lengths ride as scalar-prefetch operands, so the
+  KV BlockSpec index map resolves ``logical block j -> physical block
+  table[r, j]`` before the DMA is issued — the gather IS the pipeline,
+  no [R, H, S, Dh] view is ever materialised;
+* **int8 streaming**: int8 KV tiles DMA HBM→VMEM at half the bf16 bytes
+  (a quarter of f32), upcast in-register, and the per-(head, position)
+  scales PagedKV already pages multiply the scores/probabilities exactly
+  where the algebraic jnp path applies them;
+* **online softmax** (flash-attention style (m, l, acc) accumulators,
+  f32 regardless of input dtype);
+* **ragged early exit**: a row with ``start + T`` valid positions streams
+  ``ceil((start+T)/BLOCK)`` blocks and not one more — the index map
+  CLAMPS masked iterations to the row's last useful block (a repeated
+  block index issues no copy, the same bandwidth trick as
+  ``flash_attention``'s causal skip) and ``pl.when`` skips their compute.
+
+**Trust epilogue** (:func:`logit_trust_stats`): the serve-side output
+monitor reduces every decode step's logits to softmax entropy + top-1
+margin (serve/scheduler._logit_signals).  Left to jnp that is a
+log_softmax pass, an exp/sum pass and a hierarchical top-k over the
+vocab; the epilogue kernel streams the [B, V] logits ONCE, keeping
+online (max, Σe^{x−m}, Σx·e^{x−m}) and an exact top-2 merge — entropy
+``logZ − Σxp`` and margin ``top1 − top2`` in a single HBM read, so
+serve-side trust monitoring rides the decode step at the cost of reading
+logits once (which sampling pays anyway).
+
+Dispatch: behind the shared ops-package gate (``pallas_enabled
+("TDDL_PAGED_ATTN")`` — default ON on TPU, opt-in off-TPU where it runs
+in interpret mode) with the jnp path as the always-available fallback
+and reference semantics.  The serving engine resolves ONE path at
+construction (:func:`resolve_attn_impl` — "pallas" | "interpret" |
+"jnp") and threads it through its compiled programs as a STATIC value,
+so A/B arms and tests retrace cleanly instead of aliasing each other in
+the process-global jit cache, and the compile-once pin is untouched:
+tables/lengths stay traced VALUES, block churn never recompiles.
+
+Numerics: the online softmax is mathematically identical to the jnp
+path's full softmax but accumulates in a different order, so kernel
+logits agree to f32-rounding epsilon rather than bit-for-bit (the same
+contract as flash-vs-XLA attention; near-tie greedy flips are possible
+in principle).  The margin half of the epilogue IS bit-exact (max/merge
+only); entropy agrees to epsilon.  tests/test_paged_attention.py pins
+both, plus bit-identical served streams vs ``generate()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trustworthy_dl_tpu.ops import pallas_enabled, pallas_interpret
+
+logger = logging.getLogger(__name__)
+
+NEG_INF = -1e30          # finite stand-in: exp(NEG_INF - m) flushes to 0
+MAX_HEAD_DIM = 512       # same Mosaic comfort bound as flash_attention
+#: f32 sublane: the query tile's second-to-minor dim (T) pads up to this.
+QROWS = 8
+#: Vocab tile of the trust epilogue (lanes; V pads up to a multiple).
+TRUST_TILE = 512
+
+#: Engine-facing path names.  "auto" resolves through the shared gate;
+#: the resolved value is one of the other three.
+ATTN_IMPLS = ("auto", "pallas", "interpret", "jnp")
+
+
+def kv_sublane(kv_dtype) -> int:
+    """Mosaic sublane width for a compiled KV tile of ``kv_dtype``: the
+    second-to-minor dim must be a multiple of 32/itemsize — 8 for f32,
+    16 for bf16, 32 for int8 (= quant.int8.INT8_SUBLANE)."""
+    import numpy as np
+
+    return max(QROWS, 32 // np.dtype(kv_dtype).itemsize)
+
+
+def supports_paged_attention(*, head_dim: int, block_size: int,
+                             kv_dtype, interpret: bool) -> bool:
+    """THE kernel-eligibility predicate (the ``supports_flash`` pattern):
+    every dispatch site must consult it so the fallback condition can
+    never drift from the kernel's real constraints.
+
+    Compiled Mosaic needs the KV tile's sublane (= pool ``block_size``)
+    to be a multiple of :func:`kv_sublane` for the POOL's storage dtype
+    (8 f32, 16 bf16, 32 int8), and ``head_dim <= MAX_HEAD_DIM``.
+    Interpret mode (CPU tests) has no tiling rules — only sanity bounds
+    — so the int8 equality pins run at the small block sizes the test
+    pools use."""
+    if head_dim < 1 or block_size < 1 or head_dim > MAX_HEAD_DIM:
+        return False
+    if interpret:
+        return True
+    return block_size % kv_sublane(kv_dtype) == 0
+
+
+def resolve_attn_impl(requested: str, *, head_dim: int, block_size: int,
+                      kv_dtype) -> str:
+    """Resolve the engine's ``attn_impl`` knob ONCE, at construction —
+    never inside a traced program — to the path its compiled programs
+    will bake in: ``"pallas"`` (compiled Mosaic, TPU), ``"interpret"``
+    (the same kernel through the Pallas interpreter, off-TPU tests) or
+    ``"jnp"`` (the gather fallback, the default everywhere the gate is
+    off).
+
+    ``"auto"`` consults the shared ``pallas_enabled("TDDL_PAGED_ATTN")``
+    gate and downgrades to "jnp" with a loud warning when the geometry
+    cannot tile (a silent fallback must at least log; the serve snapshot
+    gauge + the sentinel's decode-tick fraction page the rest).  An
+    explicit ``"pallas"`` that cannot dispatch COMPILED Mosaic raises —
+    the operator asked for the kernel by name, and that includes a
+    non-TPU backend (the interpreter is not the kernel; ask for
+    ``"interpret"`` explicitly to run it)."""
+    if requested not in ATTN_IMPLS:
+        raise ValueError(
+            f"attn_impl must be one of {ATTN_IMPLS}, got {requested!r}"
+        )
+    if requested == "jnp":
+        return "jnp"
+    if requested == "pallas" and pallas_interpret():
+        raise ValueError(
+            "attn_impl='pallas' needs the TPU backend to dispatch "
+            "compiled Mosaic (this process is on "
+            "a non-TPU backend); use attn_impl='interpret' to run the "
+            "kernel through the Pallas interpreter, or 'auto'/'jnp'"
+        )
+    if requested == "auto" and not pallas_enabled("TDDL_PAGED_ATTN"):
+        return "jnp"
+    mode = "interpret" if (requested == "interpret"
+                           or pallas_interpret()) else "pallas"
+    if supports_paged_attention(head_dim=head_dim, block_size=block_size,
+                                kv_dtype=kv_dtype,
+                                interpret=(mode == "interpret")):
+        return mode
+    detail = (
+        f"head_dim={head_dim}, block_size={block_size}, "
+        f"kv_dtype={kv_dtype}: compiled Mosaic needs block_size % "
+        f"{kv_sublane(kv_dtype)} (the dtype's sublane) == 0 "
+        f"and head_dim <= {MAX_HEAD_DIM}"
+    )
+    if requested in ("pallas", "interpret"):
+        raise ValueError(
+            f"attn_impl={requested!r} cannot dispatch the paged-attention "
+            f"kernel ({detail})"
+        )
+    logger.warning(
+        "paged-attention kernel unsupported for this pool geometry (%s); "
+        "falling back to the jnp gather path — expect the decode-tick "
+        "fraction to page in the perf sentinel", detail,
+    )
+    return "jnp"
+
+
+def _dot(a: jax.Array, b: jax.Array, trans_b: bool = False) -> jax.Array:
+    """f32-accumulating matmul for the MXU."""
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(
+        a, b, (((1,), (cb,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged-decode attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(table_ref, start_ref, jmax_ref, q_ref, k_ref, v_ref,
+                       ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       scale: float, bsz: int, tq: int, quantized: bool):
+    """One (row, head, logical-block) grid step of the online softmax.
+
+    Scalar-prefetch refs: ``table_ref`` i32[R, NBPS] (physical ids —
+    also consumed by the index maps, which is what makes the gather part
+    of the DMA pipeline), ``start_ref`` i32[R] (first query's absolute
+    position) and ``jmax_ref`` i32[R] (the row's last useful logical
+    block — the ragged early-exit bound)."""
+    r = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    jmax = jmax_ref[r]
+
+    @pl.when(j <= jmax)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [tq, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)              # [bsz, Dh]
+        s = _dot(q, k, trans_b=True) * scale             # [tq, bsz] f32
+        if quantized:
+            # Per-(head, position) K scale: constant along the contracted
+            # Dh axis, so it multiplies the int8 score AFTER the dot —
+            # the same algebra models/generate._block_with_cache applies
+            # to the gathered view.
+            s = s * ks_ref[0, 0][None, :]
+        # Causal + ragged mask in absolute positions: query start+t sees
+        # cache slots [0, start+t]; everything past the row's true length
+        # (garbage in the final block, trash-block padding) is masked.
+        kpos = j * bsz + jax.lax.broadcasted_iota(jnp.int32, (tq, bsz), 1)
+        qpos = start_ref[r] + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, bsz), 0)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[:, :1]                            # [tq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)                           # masked -> 0
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        if quantized:
+            # V scale folds into the probabilities before the PV
+            # contraction — again the gathered-view algebra, in-register.
+            p = p * vs_ref[0, 0][None, :]
+        v = v_ref[0, 0].astype(jnp.float32)              # [bsz, Dh]
+        acc_ref[:] = acc_ref[:] * corr + _dot(p, v)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(j == jmax)
+    def _finalize():
+        # Finalised at the row's LAST USEFUL block, not the grid's last
+        # iteration — the remaining j > jmax steps touch neither the
+        # accumulators nor the output block, and their DMAs are clamped
+        # to repeats by the index maps (no copies issued).
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attn_call(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                     k_scale: Optional[jax.Array],
+                     v_scale: Optional[jax.Array],
+                     table: jax.Array, start: jax.Array, jmax: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """q [R, H, TQ, Dh] (TQ a multiple of QROWS) x pool [NB, H, BLOCK, Dh]
+    -> out [R, H, TQ, Dh]."""
+    r, h, tq, dh = q.shape
+    nbps = table.shape[1]
+    bsz = pool_k.shape[2]
+    quantized = k_scale is not None
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(
+        _paged_attn_kernel, scale=scale, bsz=bsz, tq=tq,
+        quantized=quantized,
+    )
+
+    # Ragged early exit at the DMA level: logical block j of row r maps
+    # to physical block table[r, min(j, jmax[r])] — beyond the row's last
+    # useful block the index repeats and Pallas issues no further copy.
+    def kv_idx(ri, hi, ji, tbl, st, jm):
+        return (tbl[ri, jnp.minimum(ji, jm[ri])], hi, 0, 0)
+
+    def scale_idx(ri, hi, ji, tbl, st, jm):
+        return (tbl[ri, jnp.minimum(ji, jm[ri])], hi, 0)
+
+    def q_idx(ri, hi, ji, tbl, st, jm):
+        return (ri, hi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, tq, dh), q_idx),
+        pl.BlockSpec((1, 1, bsz, dh), kv_idx),
+        pl.BlockSpec((1, 1, bsz, dh), kv_idx),
+    ]
+    operands = [q, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bsz), scale_idx),
+            pl.BlockSpec((1, 1, bsz), scale_idx),
+        ]
+        operands += [k_scale, v_scale]
+    else:
+        # Arity filler for the unquantized trace: the kernel never reads
+        # ks_ref/vs_ref when ``quantized`` is static-False; feeding the
+        # (already-resident) table keeps one kernel body for both tiers.
+        in_specs += [
+            pl.BlockSpec((1, nbps), lambda ri, hi, ji, tbl, st, jm: (0, 0)),
+            pl.BlockSpec((1, nbps), lambda ri, hi, ji, tbl, st, jm: (0, 0)),
+        ]
+        operands += [table, table]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(r, h, nbps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, tq, dh), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((tq, dh), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, h, tq, dh), q.dtype),
+        interpret=interpret,
+    )(table, start, jmax, *operands)
+
+
+def paged_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                    table: jax.Array, start: jax.Array, *,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Ragged paged-decode attention over ONE layer's block pool.
+
+    ``q`` [R, H, T, Dh] queries at absolute positions ``start[r] + t``
+    (``start`` i32[R] or scalar); ``pool_k``/``pool_v`` [NB, H, BLOCK,
+    Dh] with optional int8 tier scales [NB, H, BLOCK]; ``table`` i32
+    [R, NBPS] physical block ids (traced values — block churn never
+    recompiles).  The row's K/V for positions [0, start+T) — INCLUDING
+    the freshly written window — must already be in the pool: the
+    kernel-path block (models/generate._paged_block) scatters the new
+    rows first, then attends, where the jnp path writes into its
+    gathered view.  Returns [R, H, T, Dh] in q's dtype with f32
+    accumulation throughout.
+
+    Semantics contract (pinned by tests/test_paged_attention.py against
+    :func:`paged_attention_reference` and the jnp serve path): causal
+    mask ``kpos <= start+t`` in absolute positions, int8 scales applied
+    post-dot (K) / pre-contraction (V), positions past a row's length
+    never read — neither compute nor DMA."""
+    r, h, t, dh = q.shape
+    bsz = pool_k.shape[2]
+    nbps = table.shape[1]
+    if interpret is None:
+        interpret = pallas_interpret()
+    if jnp.ndim(start) == 0:
+        start = jnp.broadcast_to(start, (r,))
+    start = start.astype(jnp.int32)
+    # Last useful logical block per row (clipped into the table: a padded
+    # prefill chunk can extend past the slot's allocation — those query
+    # rows are discarded by the caller, and the mask keeps them finite).
+    jmax = jnp.clip((start + t - 1) // bsz, 0, nbps - 1).astype(jnp.int32)
+    t_pad = -(-t // QROWS) * QROWS
+    if t_pad != t:
+        # Mosaic sublane: the query tile's T dim pads to 8.  Pad rows
+        # compute a (finite, masked) attention nobody reads.
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    out = _paged_attn_call(q, pool_k, pool_v, k_scale, v_scale,
+                           table, start, jmax, interpret=interpret)
+    return out[:, :, :t]
+
+
+def paged_attention_reference(q: jax.Array, pool_k: jax.Array,
+                              pool_v: jax.Array, table: jax.Array,
+                              start: jax.Array, *,
+                              k_scale: Optional[jax.Array] = None,
+                              v_scale: Optional[jax.Array] = None
+                              ) -> jax.Array:
+    """The jnp gather semantics the kernel is pinned against — the same
+    math models/generate routes through ``_paged_gather`` +
+    ``_block_with_cache``, spelled standalone (f32 softmax, full-width
+    mask) so the kernel test does not depend on the transformer block."""
+    r, h, t, dh = q.shape
+    bsz = pool_k.shape[2]
+    if jnp.ndim(start) == 0:
+        start = jnp.broadcast_to(start, (r,))
+
+    def gather(pool):                       # [R, H, NBPS*BLOCK(, Dh)]
+        g = pool[table]
+        if g.ndim == 5:
+            g = g.transpose(0, 2, 1, 3, 4)
+            return g.reshape(r, h, -1, dh)
+        g = g.transpose(0, 2, 1, 3)
+        return g.reshape(r, h, -1)
+
+    view_k = gather(pool_k).astype(jnp.float32)
+    view_v = gather(pool_v).astype(jnp.float32)
+    s = jnp.einsum("rhtd,rhkd->rhtk", q.astype(jnp.float32), view_k)
+    s = s / math.sqrt(dh)
+    if k_scale is not None:
+        s = s * gather(k_scale)[:, :, None, :]
+    kpos = jnp.arange(view_k.shape[2])[None, None, None, :]
+    qpos = (start[:, None] + jnp.arange(t)[None, :])[:, None, :, None]
+    s = jnp.where(kpos <= qpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * gather(v_scale)[:, :, None, :]
+    return jnp.einsum("rhtk,rhkd->rhtd", p, view_v).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Trust epilogue: entropy + top-1 margin in one pass over the vocab
+# ---------------------------------------------------------------------------
+
+
+def _trust_stats_kernel(x_ref, ent_ref, mar_ref, m_ref, s_ref, w_ref,
+                        t1_ref, t2_ref, *, nv: int):
+    """One [B, TRUST_TILE] logit tile: online logsumexp pieces
+    (m, Σe^{x−m}, Σx·e^{x−m}) for the entropy and an exact top-2 merge
+    for the margin."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        w_ref[:] = jnp.zeros_like(w_ref)
+        t1_ref[:] = jnp.full_like(t1_ref, NEG_INF)
+        t2_ref[:] = jnp.full_like(t2_ref, NEG_INF)
+
+    x = x_ref[:]                                         # [B, TV] f32
+    b, tv = x.shape
+    tile_m = jnp.max(x, axis=-1, keepdims=True)          # [B, 1]
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.maximum(m_prev, tile_m)
+    corr = jnp.exp(m_prev - m_cur)
+    e = jnp.exp(x - m_cur)
+    s_ref[:] = jnp.broadcast_to(
+        s_ref[:, :1] * corr + jnp.sum(e, axis=-1, keepdims=True),
+        s_ref.shape,
+    )
+    w_ref[:] = jnp.broadcast_to(
+        w_ref[:, :1] * corr + jnp.sum(x * e, axis=-1, keepdims=True),
+        w_ref.shape,
+    )
+    m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+    # Exact top-2 within the tile: mask ONE argmax occurrence (duplicated
+    # maxima must surface as top2 == top1), then merge with the running
+    # pair — max/min only, so the margin is bit-exact vs lax.top_k.
+    amax = jnp.argmax(x, axis=-1)[:, None]               # [B, 1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, tv), 1)
+    tile_t2 = jnp.max(jnp.where(cols == amax, NEG_INF, x), axis=-1,
+                      keepdims=True)
+    t1_prev = t1_ref[:, :1]
+    t2_prev = t2_ref[:, :1]
+    t1_ref[:] = jnp.broadcast_to(jnp.maximum(t1_prev, tile_m),
+                                 t1_ref.shape)
+    t2_ref[:] = jnp.broadcast_to(
+        jnp.maximum(jnp.minimum(t1_prev, tile_m),
+                    jnp.maximum(t2_prev, tile_t2)),
+        t2_ref.shape,
+    )
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        s = jnp.maximum(s_ref[:, :1], 1e-30)
+        logz = m_ref[:, :1] + jnp.log(s)
+        # entropy = -Σ p·logp = logZ - Σ p·x with p = e^{x-m}/s.
+        ent_ref[:] = logz - w_ref[:, :1] / s             # [B, 1]
+        mar_ref[:] = t1_ref[:, :1] - t2_ref[:, :1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _trust_stats_call(logits: jax.Array,
+                      interpret: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
+    b, v = logits.shape
+    nv = v // TRUST_TILE
+    ent, mar = pl.pallas_call(
+        functools.partial(_trust_stats_kernel, nv=nv),
+        grid=(nv,),
+        in_specs=[pl.BlockSpec((b, TRUST_TILE), lambda j: (0, j))],
+        out_specs=[
+            # [B, 1] columns — the same Mosaic lane-dim rule as flash
+            # attention's lse output.
+            pl.BlockSpec((b, 1), lambda j: (0, 0)),
+            pl.BlockSpec((b, 1), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, 128), jnp.float32)
+                        for _ in range(5)],
+        interpret=interpret,
+    )(logits)
+    return ent[:, 0], mar[:, 0]
+
+
+def logit_trust_stats(logits: jax.Array,
+                      interpret: Optional[bool] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """(softmax entropy [B], top-1 logit margin [B]) of ``logits``
+    [B, V] in ONE streaming pass — the output monitor's per-token
+    reductions, fused so serve-side trust monitoring costs one extra
+    read of nothing (the logits tile is already in VMEM).
+
+    Margin is bit-exact vs the jnp reductions; entropy agrees to f32
+    epsilon (online vs two-pass logsumexp)."""
+    b, v = logits.shape
+    if interpret is None:
+        interpret = pallas_interpret()
+    logits = logits.astype(jnp.float32)
+    pad_v = (-v) % TRUST_TILE
+    if pad_v:
+        # NEG_INF (finite) padding: e^{pad-m} flushes to exactly 0 and
+        # x·0 stays 0 (a true -inf would NaN the Σx·e term), and a pad
+        # column can never win either top-2 slot.
+        logits = jnp.pad(logits, ((0, 0), (0, pad_v)),
+                         constant_values=NEG_INF)
+    pad_b = (-b) % QROWS
+    if pad_b:
+        logits = jnp.pad(logits, ((0, pad_b), (0, 0)))
+    ent, mar = _trust_stats_call(logits, interpret=interpret)
+    return ent[:b], mar[:b]
+
+
+def logit_trust_stats_reference(logits: jax.Array
+                                ) -> Tuple[jax.Array, jax.Array]:
+    """The jnp reference reductions (identical math to
+    serve/scheduler._logit_signals' fallback path), for the equality
+    pins."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    entropy = -jnp.sum(p * logp, axis=-1)
+    top2 = jax.lax.top_k(logits, 2)[0]
+    return entropy, top2[:, 0] - top2[:, 1]
+
+
+__all__ = [
+    "ATTN_IMPLS",
+    "MAX_HEAD_DIM",
+    "logit_trust_stats",
+    "logit_trust_stats_reference",
+    "paged_attention",
+    "paged_attention_reference",
+    "resolve_attn_impl",
+    "supports_paged_attention",
+]

@@ -207,7 +207,7 @@ class ServingEngine:
                  replica_id: Optional[int] = None,
                  retire_hook: Optional[Callable[..., None]] = None,
                  compilewatch: Any = None, hbm: Any = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0, attn_impl: str = "auto"):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -347,14 +347,32 @@ class ServingEngine:
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
         if paged:
+            # ``attn_impl`` selects the decode-attention read (README
+            # §Serving/"Decode attention kernel"): "auto" resolves
+            # through the shared Pallas gate to the ragged paged-
+            # attention kernel (+ fused trust epilogue) on TPU and the
+            # jnp gather fallback elsewhere; "pallas"/"jnp" force a
+            # path.  Resolution happens once, in the scheduler, and is
+            # baked into every compiled program as a static.
             self.scheduler: Any = PagedBatchingScheduler(
                 params, cfg, max_slots, max_seq, buckets,
                 kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
                 block_size=block_size, num_blocks=num_blocks,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                 spec_k=self.spec_k, draft_view=draft_view,
+                attn_impl=attn_impl,
             )
         else:
+            if attn_impl not in ("auto", "jnp"):
+                # The stripe pool has no paged-attention kernel: an
+                # explicit kernel ask must fail where the operator typed
+                # it, not silently serve the gather path (ServeConfig
+                # additionally warns for any paged knob on paged=False).
+                raise ValueError(
+                    f"attn_impl={attn_impl!r} requires the paged pool "
+                    "(paged=True); the stripe engine always runs the "
+                    "jnp attention path"
+                )
             self.scheduler = ContinuousBatchingScheduler(
                 params, cfg, max_slots, max_seq, buckets,
                 kv_dtype=kv_dtype, weight_dtype=weight_dtype, view=view,
@@ -467,6 +485,23 @@ class ServingEngine:
             labels=self._rlabel_names,
         )
         self._prefix_hits_seen = 0
+        # Decode-attention path gauge: one series per path, the active
+        # one set to 1 — a silent fallback to the slow jnp gather (gate
+        # off, untileable geometry, non-TPU backend) is visible in EVERY
+        # serve snapshot, and pages alongside the sentinel's decode-tick
+        # fraction instead of hiding inside tokens/s.
+        self._attn_gauge = _metric(
+            registry.gauge, "tddl_serve_attn_kernel",
+            "Active decode-attention path (1 = in use): the Pallas "
+            "ragged paged-attention kernel, its interpret-mode twin, or "
+            "the jnp gather fallback",
+            labels=("path",) + self._rlabel_names,
+        )
+        for _path in ("pallas", "interpret", "jnp"):
+            self._attn_gauge.set(
+                1.0 if _path == self.attn_kernel_path else 0.0,
+                path=_path, **self._rlabels,
+            )
         # Speculative-decode surface: drafted vs accepted tokens (their
         # ratio is the accepted_rate the bench A/B and the perf sentinel
         # track).  Registered on every engine — replica-labelled in
@@ -496,6 +531,12 @@ class ServingEngine:
         self._iteration = 0
         self._tokens_emitted = 0
         self._t_start: Optional[float] = None
+        # Host wall spent inside scheduler.decode_tick() (chunked
+        # prefill + the fused decode step + its packed pull): the
+        # decode-phase tick fraction of metrics_summary and the perf
+        # sentinel fingerprint — where a silent attention-path fallback
+        # shows up as time.
+        self.decode_tick_s = 0.0
         # -- active observability plane (all optional, all host-only) --
         # ``spans``: obs.spans.SpanTracker — request/phase timeline.
         # ``ledger``: obs.attribution.AttributionLedger — one durable
@@ -578,6 +619,7 @@ class ServingEngine:
             prefix_cache=serve_config.prefix_cache,
             prefill_chunk=serve_config.prefill_chunk,
             spec_k=serve_config.spec_k,
+            attn_impl=serve_config.attn_impl,
             **kwargs,
         )
 
@@ -801,6 +843,7 @@ class ServingEngine:
                     self._finish(task, request, "completed")
         t_tick = time.perf_counter()
         ticked = self.scheduler.decode_tick()
+        self.decode_tick_s += time.perf_counter() - t_tick
         if self.spans is not None and ticked:
             self.spans.add("serve.decode_tick", t_tick,
                            time.perf_counter(), kind="serve",
@@ -1152,6 +1195,15 @@ class ServingEngine:
         return out
 
     @property
+    def attn_kernel_path(self) -> str:
+        """The resolved decode-attention path this engine's compiled
+        programs bake in: "pallas" | "interpret" | "jnp" (the stripe
+        scheduler is always "jnp" — it has no paged kernel).  The
+        monitor's entropy/margin come from the kernel's fused trust
+        epilogue exactly when this is not "jnp"."""
+        return self.scheduler.attn_impl
+
+    @property
     def quarantined_slots(self):
         return self.scheduler.allocator.quarantined
 
@@ -1185,6 +1237,12 @@ class ServingEngine:
             "iterations": self._iteration,
             "peak_tokens_in_flight": self.peak_tokens_in_flight,
             "peak_active_requests": self.peak_active,
+            # Decode-phase share of the serve wall: the number the perf
+            # sentinel bands (a silent attention-path fallback inflates
+            # it) and the gauge's companion.
+            "decode_tick_fraction":
+                (self.decode_tick_s / elapsed) if elapsed > 0 else 0.0,
+            "attn_kernel_path": self.attn_kernel_path,
         }
         if self.paged:
             sched = self.scheduler

@@ -101,10 +101,24 @@ def _sample_tokens(logits: jax.Array, keys: jax.Array, temps: jax.Array,
     return jnp.where(greedy, greedy_tok, sampled)
 
 
-def _logit_signals(logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _logit_signals(logits: jax.Array, attn_impl: str = "jnp"
+                   ) -> Tuple[jax.Array, jax.Array]:
     """Per-slot trust signals from the step's logits [B, V]: softmax
     entropy (collapse → ~0, garbage → ~log V) and top-1 logit margin.
-    Computed in-step — the [B, V] logits never leave the device."""
+    Computed in-step — the [B, V] logits never leave the device.
+
+    On the kernel path (``attn_impl`` "pallas"/"interpret" — the same
+    static the paged-attention dispatch bakes in) the two reductions run
+    as the fused ``ops.paged_attention.logit_trust_stats`` epilogue: one
+    streaming pass over the vocab instead of a log_softmax pass, an
+    exp/sum pass and a hierarchical top-k — the margin is bit-exact vs
+    this jnp spelling, the entropy f32-epsilon-equal (pinned by
+    tests/test_paged_attention.py)."""
+    if attn_impl != "jnp":
+        from trustworthy_dl_tpu.ops import paged_attention as pattn
+
+        return pattn.logit_trust_stats(
+            logits, interpret=(attn_impl == "interpret"))
     logp = jax.nn.log_softmax(logits, axis=-1)
     p = jnp.exp(logp)
     entropy = -jnp.sum(p * logp, axis=-1)
@@ -148,11 +162,11 @@ def _local_prefill(cfg: gpt2.GPT2Config, view: Any, tokens: jax.Array,
 
 
 def _sample_pack(logits: jax.Array, key: jax.Array, temp: jax.Array,
-                 greedy: jax.Array) -> jax.Array:
+                 greedy: jax.Array, attn_impl: str = "jnp") -> jax.Array:
     """Single-slot sampling tail: first token + trust signals as one
     packed f32[3, 1] — a single host sync per prefill, not three."""
     token = _sample_tokens(logits, key[None], temp[None], greedy[None])
-    ent, margin = _logit_signals(logits)
+    ent, margin = _logit_signals(logits, attn_impl)
     return _pack_step_outputs(token, ent, margin)
 
 
@@ -216,7 +230,8 @@ def _paged_prefill_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                         pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
                         view: Any, tokens: jax.Array, real_len: jax.Array,
                         block_ids: jax.Array, key: jax.Array,
-                        temp: jax.Array, greedy: jax.Array):
+                        temp: jax.Array, greedy: jax.Array,
+                        attn_impl: str = "jnp"):
     """Fresh whole-prompt prefill into PAGED blocks: the SAME
     ``_local_prefill`` prologue as the stripe path — so prompt
     self-attention and the first sampled token match the stripe engine
@@ -254,14 +269,15 @@ def _paged_prefill_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     else:
         new_ks, new_vs = pool_ks, pool_vs
     return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
-                                                      greedy)
+                                                      greedy, attn_impl)
 
 
 def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                       pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
                       view: Any, tokens: jax.Array, table: jax.Array,
                       start: jax.Array, last_idx: jax.Array,
-                      key: jax.Array, temp: jax.Array, greedy: jax.Array):
+                      key: jax.Array, temp: jax.Array, greedy: jax.Array,
+                      attn_impl: str = "jnp"):
     """One CHUNK of a paged prefill: C prompt positions starting at
     ``start`` (block-aligned — a prefix-cache hit starts the suffix at a
     block boundary), attending to everything already in the slot's
@@ -272,17 +288,18 @@ def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     One compiled program serves every chunk of every prompt."""
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[None, :], pool_k, pool_v, pool_ks, pool_vs,
-        table, start, cfg, last_pos=last_idx,
+        table, start, cfg, last_pos=last_idx, attn_impl=attn_impl,
     )
     return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
-                                                      greedy)
+                                                      greedy, attn_impl)
 
 
 def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                        pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
                        view: Any, tokens: jax.Array, tables: jax.Array,
                        lengths: jax.Array, keys: jax.Array,
-                       temps: jax.Array, greedy: jax.Array):
+                       temps: jax.Array, greedy: jax.Array,
+                       attn_impl: str = "jnp"):
     """THE fused paged decode step: one token for every slot, live or
     not.  ``tables`` i32[MAX_SLOTS, NBPS] are the per-slot block maps
     (inactive rows all-trash — their garbage writes land in block 0) and
@@ -293,10 +310,10 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     generate run, over the gathered view — bit-identical streams."""
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
-        tables, lengths, cfg,
+        tables, lengths, cfg, attn_impl=attn_impl,
     )
     next_tok = _sample_tokens(logits, keys, temps, greedy)
-    ent, margin = _logit_signals(logits)
+    ent, margin = _logit_signals(logits, attn_impl)
     return (_pack_step_outputs(next_tok, ent, margin), new_k, new_v,
             new_ks, new_vs)
 
@@ -305,7 +322,8 @@ def _spec_draft_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                      pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
                      view: Any, tokens: jax.Array, tables: jax.Array,
                      lengths: jax.Array, keys: jax.Array,
-                     temps: jax.Array, greedy: jax.Array):
+                     temps: jax.Array, greedy: jax.Array,
+                     attn_impl: str = "jnp"):
     """ONE draft step of the speculative tick: the fused paged decode
     body run with the int8 DRAFT view (quant.draft_decode_view).  Same
     shapes and table/length discipline as ``_paged_decode_impl`` —
@@ -316,7 +334,7 @@ def _spec_draft_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     monitor, only the verify pass's target logits do."""
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
-        tables, lengths, cfg,
+        tables, lengths, cfg, attn_impl=attn_impl,
     )
     next_tok = _sample_tokens(logits, keys, temps, greedy)
     return next_tok.astype(jnp.int32), new_k, new_v, new_ks, new_vs
@@ -326,7 +344,8 @@ def _spec_verify_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                       pool_v: jax.Array, pool_ks: Any, pool_vs: Any,
                       view: Any, tokens: jax.Array, tables: jax.Array,
                       lengths: jax.Array, keys: jax.Array,
-                      temps: jax.Array, greedy: jax.Array):
+                      temps: jax.Array, greedy: jax.Array,
+                      attn_impl: str = "jnp"):
     """THE batched verify: one MODEL-dtype forward over every slot's
     whole draft window ``tokens`` [R, k+1] = [last emitted, d_1 .. d_k],
     attending through the same paged cache at the PRE-draft lengths and
@@ -342,12 +361,12 @@ def _spec_verify_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     r, t = tokens.shape
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens, pool_k, pool_v, pool_ks, pool_vs,
-        tables, lengths, cfg, all_logits=True,
+        tables, lengths, cfg, all_logits=True, attn_impl=attn_impl,
     )
     flat = logits.reshape(r * t, -1)
     tok = _sample_tokens(flat, keys.reshape(r * t, 2),
                          jnp.repeat(temps, t), jnp.repeat(greedy, t))
-    ent, margin = _logit_signals(flat)
+    ent, margin = _logit_signals(flat, attn_impl)
     packed = jnp.stack([tok.astype(jnp.float32), ent, margin])
     return packed.reshape(3, r, t), new_k, new_v, new_ks, new_vs
 
@@ -367,14 +386,23 @@ def _programs() -> Dict[str, Any]:
         _PROGRAMS["decode"] = jax.jit(
             _decode_impl, static_argnums=(0,), donate_argnums=donate
         )
+        # The paged programs also take ``attn_impl`` as a STATIC keyword
+        # (the scheduler's construction-resolved attention path): the jit
+        # cache keys on it, so a kernel-on engine and a jnp-fallback
+        # engine with identical geometry trace separate programs instead
+        # of silently aliasing each other through this process-global
+        # table (bench A/B arms and the kernel tests depend on that).
         _PROGRAMS["paged_prefill"] = jax.jit(
-            _paged_prefill_impl, static_argnums=(0,), donate_argnums=donate
+            _paged_prefill_impl, static_argnums=(0,),
+            static_argnames=("attn_impl",), donate_argnums=donate
         )
         _PROGRAMS["paged_chunk"] = jax.jit(
-            _paged_chunk_impl, static_argnums=(0,), donate_argnums=donate
+            _paged_chunk_impl, static_argnums=(0,),
+            static_argnames=("attn_impl",), donate_argnums=donate
         )
         _PROGRAMS["paged_decode"] = jax.jit(
-            _paged_decode_impl, static_argnums=(0,), donate_argnums=donate
+            _paged_decode_impl, static_argnums=(0,),
+            static_argnames=("attn_impl",), donate_argnums=donate
         )
         # Speculative tier: draft + verify get their OWN jit wrappers so
         # the fused-decode compile-once pin (decode_cache_size == 1)
@@ -383,10 +411,12 @@ def _programs() -> Dict[str, Any]:
         # times per tick), spec_verify (one batched model-dtype pass),
         # and paged_decode as the single-token fallback.
         _PROGRAMS["spec_draft"] = jax.jit(
-            _spec_draft_impl, static_argnums=(0,), donate_argnums=donate
+            _spec_draft_impl, static_argnums=(0,),
+            static_argnames=("attn_impl",), donate_argnums=donate
         )
         _PROGRAMS["spec_verify"] = jax.jit(
-            _spec_verify_impl, static_argnums=(0,), donate_argnums=donate
+            _spec_verify_impl, static_argnums=(0,),
+            static_argnames=("attn_impl",), donate_argnums=donate
         )
     return _PROGRAMS
 
@@ -485,6 +515,9 @@ class ContinuousBatchingScheduler:
         self.lengths = np.zeros(max_slots, np.int32)
         self.tasks: Dict[int, SlotTask] = {}   # slot -> task
         self.max_seq = max_seq
+        # The stripe pool has no paged-attention kernel: the engine's
+        # attention-path surface (gauge, summary) reads this uniformly.
+        self.attn_impl = "jnp"
         self.spans: Any = None  # optional obs.spans.SpanTracker (engine)
         # Optional obs.compilewatch.CompileWatcher (engine): the fused
         # decode dispatch runs under its "serve_decode" guard, so a
@@ -692,7 +725,8 @@ class PagedBatchingScheduler:
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = None,
-                 spec_k: int = 0, draft_view: Any = None):
+                 spec_k: int = 0, draft_view: Any = None,
+                 attn_impl: str = "auto"):
         q8.validate_dtypes(kv_dtype, weight_dtype)
         validate_paged_geometry(max_seq, block_size, num_blocks,
                                 prefill_chunk)
@@ -734,6 +768,20 @@ class PagedBatchingScheduler:
         self.kv = init_paged_pool(cfg, self.num_blocks, block_size,
                                   kv_dtype=q8.resolve_kv_dtype(kv_dtype,
                                                                cfg))
+        # Decode-attention path, resolved ONCE here (never inside a
+        # traced program) and baked into every paged program as a static:
+        # "pallas" (compiled Mosaic kernel, TPU), "interpret" (same
+        # kernel through the Pallas interpreter — tests), or "jnp" (the
+        # gather fallback, the default wherever the gate is off or the
+        # geometry cannot tile).  ops/paged_attention.py documents the
+        # gate (TDDL_PAGED_ATTN) and tiling rules.
+        from trustworthy_dl_tpu.ops import paged_attention as pattn
+
+        self.attn_impl = pattn.resolve_attn_impl(
+            attn_impl, head_dim=cfg.n_embd // cfg.n_head,
+            block_size=block_size,
+            kv_dtype=q8.resolve_kv_dtype(kv_dtype, cfg),
+        )
         self.allocator = SlotAllocator(max_slots)  # decode rows
         self.blocks = BlockAllocator(self.num_blocks)
         self.prefix = (PrefixCache(block_size, self.blocks)
@@ -933,6 +981,7 @@ class PagedBatchingScheduler:
                 jnp.asarray(task.keys[0], jnp.uint32),
                 jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
                 jnp.asarray(task.greedy),
+                attn_impl=self.attn_impl,
             )
         else:
             last_idx = int(np.clip(st.plen - 1 - st.pos, 0, c - 1))
@@ -945,6 +994,7 @@ class PagedBatchingScheduler:
                 jnp.asarray(task.keys[0], jnp.uint32),
                 jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
                 jnp.asarray(task.greedy),
+                attn_impl=self.attn_impl,
             )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         if self.spans is not None:
@@ -1025,6 +1075,7 @@ class PagedBatchingScheduler:
                     jnp.asarray(self.lengths),
                     jnp.asarray(keys), jnp.asarray(temps),
                     jnp.asarray(greedy),
+                    attn_impl=self.attn_impl,
                 )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         # tddl-lint: disable=host-sync — the tick's single intentional pull
@@ -1101,7 +1152,7 @@ class PagedBatchingScheduler:
                 cur, pk, pv, pks, pvs = prog["spec_draft"](
                     self.cfg, *pool, self.draft_view, cur, tables_dev,
                     jnp.asarray(lengths0 + j), jnp.asarray(keys[:, j]),
-                    temps_dev, greedy_dev,
+                    temps_dev, greedy_dev, attn_impl=self.attn_impl,
                 )
             pool = (pk, pv, pks, pvs)
             draft_dev.append(cur)
@@ -1116,7 +1167,7 @@ class PagedBatchingScheduler:
             packed, pk, pv, pks, pvs = prog["spec_verify"](
                 self.cfg, *pool, self.view, jnp.asarray(tokens_v),
                 tables_dev, jnp.asarray(lengths0), jnp.asarray(keys),
-                temps_dev, greedy_dev,
+                temps_dev, greedy_dev, attn_impl=self.attn_impl,
             )
         self.kv = PagedKV(k=pk, v=pv, k_scale=pks, v_scale=pvs)
         # tddl-lint: disable=host-sync — verify lands all windows in one pull
@@ -1288,7 +1339,7 @@ class PagedBatchingScheduler:
             jnp.asarray(1, jnp.int32),
             jnp.zeros(c // bsz, jnp.int32), jnp.zeros(2, jnp.uint32),
             jnp.asarray(1.0, jnp.float32), jnp.asarray(True),
-            memory=memory,
+            memory=memory, attn_impl=self.attn_impl,
         )
         ledger.analyze(
             "serve.paged_chunk", prog["paged_chunk"], self.cfg,
@@ -1296,7 +1347,7 @@ class PagedBatchingScheduler:
             jnp.zeros((1, self.nbps), jnp.int32),
             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
             jnp.zeros(2, jnp.uint32), jnp.asarray(1.0, jnp.float32),
-            jnp.asarray(True), memory=memory,
+            jnp.asarray(True), memory=memory, attn_impl=self.attn_impl,
         )
         ledger.analyze(
             "serve.paged_decode", prog["paged_decode"], self.cfg,
@@ -1304,5 +1355,5 @@ class PagedBatchingScheduler:
             jnp.zeros((ms, self.nbps), jnp.int32),
             jnp.asarray(self.lengths), jnp.zeros((ms, 2), jnp.uint32),
             jnp.ones(ms, jnp.float32), jnp.ones(ms, bool),
-            memory=memory,
+            memory=memory, attn_impl=self.attn_impl,
         )
