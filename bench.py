@@ -295,6 +295,13 @@ def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
         # silent fallback to the jnp gather path inflates it.
         decode_tick_fraction=(record.get("paged_attn")
                               or {}).get("decode_tick_fraction"),
+        # Adapter-pool locality + equal-HBM personalisation cost
+        # (TDDL_BENCH_ADAPTERS rounds): both higher-is-better — a
+        # colder pool or a pricier adapter path bands like a perf
+        # regression.
+        adapter_hit_rate=(record.get("adapters") or {}).get("hit_rate"),
+        adapter_tokens_ratio=(record.get("adapters")
+                              or {}).get("tokens_per_s_ratio"),
         run_metadata=record.get("run_metadata"),
         extra={"vs_baseline": record.get("vs_baseline")},
     )
@@ -1814,6 +1821,130 @@ def bench_quant() -> "dict | None":
     return record
 
 
+def bench_adapters() -> "dict | None":
+    """Paged adapter-pool A/B (TDDL_BENCH_ADAPTERS=1): multi-tenant
+    serving throughput at an EQUAL HBM BUDGET — the budget is what the
+    adapter-OFF arm's paged KV pool costs; the adapter arm carves its
+    low-rank pool (serve/adapters.py) out of that SAME budget, giving
+    back KV blocks block-for-block, so the row answers the deployment
+    question: what does per-tenant personalisation cost at fixed HBM?
+    Both arms drain an IDENTICAL seeded Zipf multi-tenant workload
+    (``zipf_adapter_assignments`` — a hot adapter head + a long tail, so
+    pool pages << adapters forces real LRU eviction traffic).  The
+    record reports tokens/s per arm plus the pool's hit rate, eviction
+    and upload counts; hit rate and the tokens/s ratio ride the perf
+    sentinel fingerprint so pool-locality regressions band-check (and
+    page) like throughput regressions.
+
+    Env: TDDL_BENCH_ADAPTERS_MODEL (gpt2), TDDL_BENCH_ADAPTERS_SLOTS
+    (8), TDDL_BENCH_ADAPTERS_SEQ (256), TDDL_BENCH_ADAPTERS_REQUESTS
+    (48), TDDL_BENCH_ADAPTERS_NEW (16), TDDL_BENCH_ADAPTERS_RANK (8),
+    TDDL_BENCH_ADAPTERS_PAGES (4), TDDL_BENCH_ADAPTERS_TENANTS (12),
+    TDDL_BENCH_ADAPTERS_COUNT (8, distinct adapters),
+    TDDL_BENCH_ADAPTERS_DTYPE (model|int8)."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import ServeRequest, ServingEngine
+    from trustworthy_dl_tpu.serve.adapters import adapter_pool_bytes
+    from trustworthy_dl_tpu.serve.workload import (
+        WorkloadConfig,
+        generate_workload,
+        make_tenant_population,
+        zipf_adapter_assignments,
+    )
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_ADAPTERS_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    max_slots = int(os.environ.get("TDDL_BENCH_ADAPTERS_SLOTS", "8"))
+    max_seq = int(os.environ.get("TDDL_BENCH_ADAPTERS_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_ADAPTERS_REQUESTS", "48"))
+    max_new = int(os.environ.get("TDDL_BENCH_ADAPTERS_NEW", "16"))
+    rank = int(os.environ.get("TDDL_BENCH_ADAPTERS_RANK", "8"))
+    pages = int(os.environ.get("TDDL_BENCH_ADAPTERS_PAGES", "4"))
+    n_tenants = int(os.environ.get("TDDL_BENCH_ADAPTERS_TENANTS", "12"))
+    n_adapters = int(os.environ.get("TDDL_BENCH_ADAPTERS_COUNT", "8"))
+    adapter_dtype = os.environ.get("TDDL_BENCH_ADAPTERS_DTYPE", "model")
+
+    tenants = make_tenant_population(n_tenants)
+    adapter_map = zipf_adapter_assignments(
+        [t.name for t in tenants], n_adapters, seed=0)
+    wl = generate_workload(
+        WorkloadConfig(seed=0, num_requests=n_requests,
+                       output_median=max_new // 2 or 1,
+                       max_output=max_new, tenants=tenants),
+        vocab_size=cfg.vocab_size, max_seq=max_seq)
+
+    block_size = 16
+    base_blocks = max_slots * (max_seq // block_size)
+
+    def run_arm(label, num_blocks, **kw):
+        engine = ServingEngine(params, cfg, max_slots=max_slots,
+                               max_seq=max_seq, queue_limit=n_requests,
+                               paged=True, block_size=block_size,
+                               num_blocks=num_blocks,
+                               rng=jax.random.PRNGKey(1), **kw)
+        t0 = time.perf_counter()
+        for item in wl:
+            engine.submit(ServeRequest(
+                prompt=list(item.prompt),
+                max_new_tokens=item.max_new_tokens,
+                temperature=0.0, tenant=item.tenant))
+        engine.run_until_idle()
+        elapsed = time.perf_counter() - t0
+        summary = engine.metrics_summary()
+        row = {
+            "blocks": num_blocks,
+            "kv_bytes": int(engine.scheduler.kv.pool_bytes),
+            "tokens_per_s": round(summary["tokens_per_s"], 1),
+            "completed": summary["requests_completed"],
+            "wall_s": round(elapsed, 3),
+        }
+        if "adapters" in summary:
+            row["adapters"] = summary["adapters"]
+        log(f"adapters A/B [{label}]: {num_blocks} block(s), "
+            f"{row['tokens_per_s']:.1f} tok/s "
+            f"({row['completed']} completed)")
+        return engine, row
+
+    record = {"arms": {}, "rank": rank, "pages": pages,
+              "adapter_dtype": adapter_dtype,
+              "tenants": n_tenants, "adapters": n_adapters}
+    engine, row = run_arm("off", base_blocks)
+    record["budget_bytes"] = int(engine.scheduler.kv.pool_bytes)
+    bpb = engine.scheduler.kv.bytes_per_block
+    record["arms"]["off"] = row
+    pool_bytes = adapter_pool_bytes(cfg, pages, rank, adapter_dtype)
+    give_back = -(-int(pool_bytes) // bpb)   # ceil: the pool pays in full
+    on_blocks = base_blocks - give_back
+    if on_blocks < max_slots:
+        raise ValueError(
+            f"TDDL_BENCH_ADAPTERS_PAGES={pages} at rank {rank} costs "
+            f"{give_back} of {base_blocks} KV blocks — under one block "
+            f"per slot; shrink the pool or the rank")
+    _, row = run_arm("on", on_blocks, adapter_rank=rank,
+                     adapter_pool_pages=pages,
+                     adapter_dtype=adapter_dtype,
+                     adapter_map=adapter_map)
+    record["arms"]["on"] = row
+    record["adapter_pool_bytes"] = int(pool_bytes)
+    pool = row["adapters"]
+    record["hit_rate"] = round(pool["hit_rate"], 4)
+    record["evictions"] = pool["evictions"]
+    record["uploads"] = pool["uploads"]
+    record["tokens_per_s_ratio"] = round(
+        row["tokens_per_s"]
+        / max(record["arms"]["off"]["tokens_per_s"], 1e-9), 3)
+    log(f"adapters A/B: {record['tokens_per_s_ratio']}x tokens/s at "
+        f"equal HBM ({record['budget_bytes'] / 1e6:.1f} MB; pool "
+        f"{pool_bytes / 1e6:.2f} MB = {give_back} blocks), hit rate "
+        f"{record['hit_rate']}, {record['evictions']} eviction(s)")
+    return record
+
+
 def bench_generate() -> None:
     """Optional decode benchmark (TDDL_BENCH_GEN=1): KV-cache generation
     steady-state cost on the full GPT-2.  Diagnostics only — stderr.
@@ -2201,6 +2332,9 @@ def _inner_main() -> None:
     quant_records = None
     if os.environ.get("TDDL_BENCH_QUANT") == "1":
         quant_records = bench_quant()
+    adapters_record = None
+    if os.environ.get("TDDL_BENCH_ADAPTERS") == "1":
+        adapters_record = bench_adapters()
 
     record = {
         "metric": f"{model}_{unit.split('/')[0]}_per_sec_per_chip"
@@ -2229,6 +2363,11 @@ def _inner_main() -> None:
         # decode_tick_fraction, so a silent fall-back to the jnp gather
         # bands (and pages) like a perf regression.
         record["paged_attn"] = paged_attn_record
+    if adapters_record is not None:
+        # Same contract: the fingerprint lifts the adapter pool's hit
+        # rate and the equal-HBM tokens/s ratio, so pool-locality and
+        # personalisation-cost regressions band (and page) like perf.
+        record["adapters"] = adapters_record
     _attach_perf_sections(record, compiles=compiles, hbm=hbm_monitor)
     if serve_records is not None:
         record["serve"] = serve_records

@@ -348,6 +348,49 @@ def test_bench_quant_ab_records(monkeypatch):
             assert key in row, row
 
 
+def test_bench_adapters_ab_records(monkeypatch):
+    """bench_adapters' equal-HBM A/B on a tiny model: the adapter arm
+    pays for its low-rank pool in KV blocks (block-for-block inside the
+    base arm's byte budget), drains the same seeded Zipf multi-tenant
+    workload, and the record carries the sentinel lift keys
+    (hit_rate, tokens_per_s_ratio)."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_REQUESTS", "8")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_NEW", "4")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_RANK", "2")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_PAGES", "2")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_TENANTS", "4")
+    monkeypatch.setenv("TDDL_BENCH_ADAPTERS_COUNT", "3")
+    record = bench.bench_adapters()
+    assert set(record["arms"]) == {"off", "on"}
+    off, on = record["arms"]["off"], record["arms"]["on"]
+    # Equal-HBM contract: the KV blocks given back cover the low-rank
+    # pool in full, so the adapter arm never exceeds the base budget.
+    assert on["kv_bytes"] + record["adapter_pool_bytes"] \
+        <= record["budget_bytes"]
+    assert on["blocks"] < off["blocks"]
+    assert "adapters" not in off          # base arm carries no pool
+    pool = on["adapters"]
+    assert pool["uploads"] >= 1           # Zipf traffic touched the pool
+    assert 0.0 <= record["hit_rate"] <= 1.0
+    assert record["tokens_per_s_ratio"] > 0
+    assert record["evictions"] == pool["evictions"]
+    for row in (off, on):
+        assert row["completed"] >= 1
+        assert row["tokens_per_s"] > 0
+
+
 def test_bench_perf_sections_and_sentinel_fingerprint(monkeypatch,
                                                       tmp_path):
     """CONTRACT: every non-skip bench record carries the perf

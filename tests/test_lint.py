@@ -112,6 +112,59 @@ def test_metric_label_vocab_rule(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# resource locality
+# ---------------------------------------------------------------------------
+
+
+def test_adapter_locality_rule_flags_forked_spellings(tmp_path):
+    # The adapter page-table row and pool PartitionSpecs have ONE home
+    # (serve/adapters.py): a redefinition elsewhere, or an ad-hoc
+    # PartitionSpec inside an adapter-handling function, forks the
+    # compile-once pin.  Calling the imported home spelling is fine.
+    result = _run(tmp_path, {
+        "trustworthy_dl_tpu/serve/scheduler.py": '''\
+            from jax.sharding import PartitionSpec as P
+            from trustworthy_dl_tpu.serve.adapters import adapter_page_row
+
+            def adapter_page_row(slots, n):          # forked spelling
+                return [0] * n
+
+            def _shard_adapter_pool(arrs):           # ad-hoc adapter spec
+                return P("data")
+
+            def _shard_kv_pool(arrs):                # non-adapter: fine
+                return P("data")
+
+            def admit(task, n):
+                return adapter_page_row({}, n)       # calling home: fine
+            ''',
+    }, rules=["adapter-locality"])
+    assert sorted(f.line for f in result.findings) == [4, 8]
+    assert "one spelling" in result.findings[0].message
+
+
+def test_adapter_locality_rule_home_module_and_suppression_clean(tmp_path):
+    # The home module itself is exempt; elsewhere an inline suppression
+    # with a justification comment silences a deliberate exception.
+    result = _run(tmp_path, {
+        "trustworthy_dl_tpu/serve/adapters.py": '''\
+            from jax.sharding import PartitionSpec
+
+            def adapter_partition_specs():
+                return PartitionSpec(), PartitionSpec()
+            ''',
+        "trustworthy_dl_tpu/serve/engine.py": '''\
+            from jax.sharding import PartitionSpec as P
+
+            def _resize_adapter_pool(arrs):
+                # tddl-lint: disable=adapter-locality — test fixture
+                return P()
+            ''',
+    }, rules=["adapter-locality"])
+    assert result.findings == []
+
+
+# ---------------------------------------------------------------------------
 # determinism
 # ---------------------------------------------------------------------------
 

@@ -135,14 +135,26 @@ PREDICT_FUNCTION_PATTERNS = (
 #: set is either a typo (``tenent``) or a new dimension that must be
 #: added HERE (and to the dashboards) deliberately, not slipped in.
 KNOWN_METRIC_LABELS = frozenset({
-    "action", "device", "direction", "dtype", "kind", "metric", "node",
-    "outcome", "path", "phase", "replica", "scope", "signal", "slo",
-    "slo_class", "stage", "state", "status", "tenant", "to_state", "type",
+    "action", "adapter", "device", "direction", "dtype", "kind", "metric",
+    "node", "outcome", "path", "phase", "replica", "scope", "signal",
+    "slo", "slo_class", "stage", "state", "status", "tenant", "to_state",
+    "type",
 })
 
 #: Metric-name prefix every registered literal must carry (the
 #: Prometheus surface's naming promise).
 METRIC_PREFIX = "tddl_"
+
+#: The adapter-resource locality contract (PR 16): the per-slot adapter
+#: page-table row and the pool's PartitionSpecs each have exactly ONE
+#: spelling, in serve/adapters.py — the compile-once pin of the paged
+#: decode/prefill programs keys on that table's shape and the pool's
+#: sharding, so a second spelling elsewhere is a fork of the pin, not a
+#: convenience.  A definition of either name, or an adapter-targeted
+#: ``PartitionSpec(...)`` construction, outside the home module is a
+#: finding.
+ADAPTER_HOME_MODULE = "trustworthy_dl_tpu/serve/adapters.py"
+ADAPTER_LOCALITY_NAMES = ("adapter_page_row", "adapter_partition_specs")
 
 #: Default committed baseline of grandfathered findings (repo-relative).
 DEFAULT_BASELINE = "tddl_lint_baseline.json"

@@ -80,6 +80,8 @@ class LintConfig:
         contracts.PREDICT_FUNCTION_PATTERNS
     known_metric_labels: frozenset = contracts.KNOWN_METRIC_LABELS
     metric_prefix: str = contracts.METRIC_PREFIX
+    adapter_home_module: str = contracts.ADAPTER_HOME_MODULE
+    adapter_locality_names: Sequence[str] = contracts.ADAPTER_LOCALITY_NAMES
     package_name: str = "trustworthy_dl_tpu"
     #: EventType member names; ``None`` = resolve from the real enum.
     event_members: Optional[frozenset] = None

@@ -20,6 +20,7 @@ from trustworthy_dl_tpu.analysis.rules.hygiene import (
     MutableDefaultRule)
 from trustworthy_dl_tpu.analysis.rules.jit import (HostSyncRule,
                                                    RecompileHazardRule)
+from trustworthy_dl_tpu.analysis.rules.locality import AdapterLocalityRule
 from trustworthy_dl_tpu.analysis.rules.obs import (MetricLabelRule,
                                                    MetricPrefixRule,
                                                    ObsEmitRule)
@@ -41,6 +42,8 @@ def all_rules() -> List[Rule]:
         # jit hazards
         RecompileHazardRule(),
         HostSyncRule(),
+        # resource locality
+        AdapterLocalityRule(),
         # hygiene
         MutableDefaultRule(),
         BareExceptRule(),

@@ -1,0 +1,77 @@
+"""Resource-locality rules: one-spelling contracts for shared device
+resources.
+
+The paged serving tier keeps its compile-once pin by funnelling every
+shape- or sharding-relevant decision through a single home module; a
+helpful second spelling elsewhere (a local ``adapter_page_row`` clone,
+an ad-hoc adapter ``PartitionSpec``) compiles — and silently forks the
+pin, so churn that must never recompile starts recompiling on the
+replica that took the fork.  These rules make the locality contract a
+lint invariant instead of a code-review hope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from trustworthy_dl_tpu.analysis.engine import (Finding, LintConfig,
+                                                ModuleInfo, Project, Rule)
+
+
+def _partition_spec_aliases(module: ModuleInfo) -> Set[str]:
+    """Local names ``jax.sharding.PartitionSpec`` is bound to in this
+    module (``import ... as P`` included) — construction sites resolve
+    through these the way the interpreter would."""
+    names: Set[str] = set()
+    for node in module.walk():
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and "sharding" in node.module:
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class AdapterLocalityRule(Rule):
+    """The adapter page-table row and the adapter-pool PartitionSpecs
+    are spelled ONLY in serve/adapters.py (contracts.
+    ADAPTER_HOME_MODULE): a definition of ``adapter_page_row``/
+    ``adapter_partition_specs`` elsewhere, or a ``PartitionSpec(...)``
+    built inside an adapter-handling function elsewhere, forks the
+    compile-once pin the paged programs key on.  Importing and CALLING
+    the home spellings is the sanctioned path and is not flagged."""
+
+    name = "adapter-locality"
+    description = ("adapter page-table/PartitionSpec spellings live "
+                   "only in serve/adapters.py")
+
+    def applies(self, rel: str, config: LintConfig) -> bool:
+        return rel != config.adapter_home_module and (
+            rel.startswith(config.package_name + "/") or rel == "bench.py")
+
+    def check(self, module: ModuleInfo, project: Project,
+              config: LintConfig) -> Iterable[Finding]:
+        reserved = set(config.adapter_locality_names)
+        spec_names = _partition_spec_aliases(module)
+        for node in module.walk():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in reserved:
+                    yield self.finding(
+                        module, node,
+                        f"{node.name}() redefined outside "
+                        f"{config.adapter_home_module} — the adapter "
+                        f"page table/PartitionSpecs have one spelling")
+                    continue
+                if "adapter" not in node.name.lower() or not spec_names:
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Name) \
+                            and sub.func.id in spec_names:
+                        yield self.finding(
+                            module, sub,
+                            f"adapter-targeted PartitionSpec built in "
+                            f"{node.name}() — adapter sharding is "
+                            f"spelled only in "
+                            f"{config.adapter_home_module}")

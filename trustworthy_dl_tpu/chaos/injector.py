@@ -72,6 +72,11 @@ class FaultInjector:
         # flag-rate window back through :meth:`on_flag_observed`.
         self.adversary = adversary
         self._adaptive_replicas: Dict[int, Any] = {}
+        # Adapters with an ACTIVE ADAPTER_POISON (adapter id -> poison
+        # severity): artifact-addressed like TENANT_FLOOD, replica-blind
+        # by design — every request retiring UNDER the adapter, on ANY
+        # replica, is poisoned until :meth:`heal_adapter`.
+        self._poisoned_adapters: Dict[str, float] = {}
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -198,7 +203,18 @@ class FaultInjector:
         is the onset; the compromise persists until healed); an active
         REPLICA_ADAPTIVE_POISON delegates every retirement to the
         attached adversary (seeded token corruption + strength-scaled
-        signal shaping)."""
+        signal shaping).  An active ADAPTER_POISON matching the task's
+        adapter outranks ALL replica-scoped compromises — checked FIRST,
+        because the drill's exactness depends on the flag landing in the
+        per-ADAPTER window (the attribution record carries the adapter
+        id) regardless of which replica happened to host the page."""
+        if self._poisoned_adapters:
+            adapter = getattr(task, "adapter", None)
+            sev = (self._poisoned_adapters.get(adapter)
+                   if adapter is not None else None)
+            if sev is not None:
+                self._poison_signals(task, sev)
+                return
         adv = self._adaptive_replicas.get(-1 if replica is None else replica)
         if adv is not None:
             adv.corrupt(task)
@@ -242,6 +258,19 @@ class FaultInjector:
                             "chaos: tenant flood (%d requests from %r) "
                             "at tick %d", max(int(event.severity), 1),
                             event.tenant or "flood", tick)
+                        out.append(event)
+                        continue
+                    if kind is FaultKind.ADAPTER_POISON:
+                        # Artifact-addressed: the adapter id rides the
+                        # event's ``tenant`` field; the injector arms
+                        # the persistent per-adapter compromise and the
+                        # fleet only counts the onset.
+                        name = event.tenant or "adapter"
+                        logger.warning(
+                            "chaos: adapter poison on %r at tick %d",
+                            name, tick)
+                        self._poisoned_adapters[name] = \
+                            float(event.severity)
                         out.append(event)
                         continue
                     logger.warning("chaos: %s on replica %d at tick %d",
@@ -297,6 +326,16 @@ class FaultInjector:
     def replica_poisoned(self, replica: int) -> bool:
         return (replica in self._poisoned_replicas
                 or replica in self._adaptive_replicas)
+
+    def heal_adapter(self, adapter: str) -> None:
+        """Operator action: clear an active ADAPTER_POISON (until then a
+        readmitted adapter is immediately re-flagged — the fleet's
+        ``release_adapter_quarantine`` of a still-poisoned adapter must
+        re-trip, exactly like a replica readmission probe)."""
+        self._poisoned_adapters.pop(adapter, None)
+
+    def adapter_poisoned(self, adapter: str) -> bool:
+        return adapter in self._poisoned_adapters
 
 
 def _corrupt_largest_leaf(params: Any) -> Any:

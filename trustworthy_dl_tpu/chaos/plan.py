@@ -100,6 +100,17 @@ class FaultKind(str, enum.Enum):
     #: real accepted work and drive the autoscaler like any burst.  The
     #: replica ``target`` is meaningless for this kind (-1).
     TENANT_FLOOD = "tenant_flood"
+    #: Compromise ADAPTER ``tenant`` from tick ``step`` on (the adapter
+    #: id rides the ``tenant`` field — like TENANT_FLOOD the fault is
+    #: artifact-addressed, not replica-addressed: a poisoned adapter is
+    #: wherever its pool page is resident).  Every request retiring
+    #: UNDER that adapter — on any replica — gets the collapsed-entropy
+    #: poison signal profile, so the fleet's per-ADAPTER flag-rate
+    #: window must trip and quarantine the adapter fleet-wide while the
+    #: replicas that hosted it stay HEALTHY (zero drains, zero replica
+    #: quarantines).  Persists until
+    #: :meth:`FaultInjector.heal_adapter`.
+    ADAPTER_POISON = "adapter_poison"
 
 
 #: The serving-fleet kinds (consumed by ``FaultInjector.on_fleet_tick``
@@ -107,7 +118,7 @@ class FaultKind(str, enum.Enum):
 FLEET_KINDS = (FaultKind.REPLICA_CRASH, FaultKind.REPLICA_STALL,
                FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART,
                FaultKind.REPLICA_ADAPTIVE_POISON,
-               FaultKind.TENANT_FLOOD)
+               FaultKind.TENANT_FLOOD, FaultKind.ADAPTER_POISON)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,10 +175,13 @@ class FaultPlan:
         # Fixed kind order (enum declaration order) keeps the draw stream
         # stable across python versions / dict orderings.
         kinds = [k for k in FaultKind if rates.get(k, 0.0) > 0.0]
-        # TENANT_FLOOD is fleet-granularity but tenant-addressed, not
-        # replica-addressed — it needs no target draw.
+        # TENANT_FLOOD and ADAPTER_POISON are fleet-granularity but
+        # tenant-/adapter-addressed, not replica-addressed — they need
+        # no target draw.
         addressed = [k for k in kinds
-                     if k in FLEET_KINDS and k is not FaultKind.TENANT_FLOOD]
+                     if k in FLEET_KINDS
+                     and k not in (FaultKind.TENANT_FLOOD,
+                                   FaultKind.ADAPTER_POISON)]
         if num_replicas is None and addressed:
             raise ValueError(
                 "fleet fault rates need num_replicas to draw targets"
@@ -285,6 +299,15 @@ class FaultPlan:
           past the drain + ``scale_down_idle_ticks`` + cool-down so
           every extra replica retires back to the floor.  Scale-downs
           drain, so they are COUNTED in ``drains`` too.
+        * ADAPTER_POISON → 1 adapter_poison + 1 adapter_quarantine (the
+          fleet-wide per-ADAPTER flag window trips once the adapter
+          retires ``flag_min_count`` requests while poisoned) — and
+          NOTHING on the replica side: zero drains, zero replica
+          quarantines, zero suspicions.  The replicas hosting the
+          poisoned page stay HEALTHY by design; the quarantine lands on
+          the artifact.  Valid when at least ``flag_min_count``
+          adapter-attributed requests retire after the event and the
+          adapter is not released before the drill ends.
         """
         if vote_k == 1:
             raise ValueError(
@@ -351,4 +374,7 @@ class FaultPlan:
             "throttles": throttles,
             "scale_ups": scale_events,
             "scale_downs": scale_events,
+            "adapter_poisons": self.count(FaultKind.ADAPTER_POISON),
+            "adapter_quarantines": self.count(FaultKind.ADAPTER_POISON),
+            "adapter_throttles": 0,
         }

@@ -445,6 +445,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "the weight bytes streamed per decode "
                              "token (embedding/lm-head stay high "
                              "precision)")
+    parser.add_argument("--adapter-rank", type=int, default=0,
+                        help="per-tenant low-rank adapter tier: rank of "
+                             "the paged adapter deltas gathered into "
+                             "the decode/prefill matmuls by a traced "
+                             "per-slot page table.  0 disables "
+                             "(default) — the serve programs keep their "
+                             "adapter-free signatures, streams "
+                             "bit-identical to today's.  >0 requires "
+                             "the paged pool and is incompatible with "
+                             "--spec-k; README §Serving/Adapters")
+    parser.add_argument("--adapter-pool-pages", type=int, default=None,
+                        help="usable pages in the adapter HBM pool "
+                             "(page 0 is the pinned all-zero page; "
+                             "unset sizes the pool from the HBM "
+                             "headroom gate).  More distinct adapters "
+                             "than pages churn by LRU eviction of cold "
+                             "pages — never by recompiling")
+    parser.add_argument("--adapter-dtype", type=str, default="model",
+                        choices=["model", "int8"],
+                        help="adapter pool storage tier; int8 stores "
+                             "per-page-scaled deltas dequantized in "
+                             "register inside the gathered matmul "
+                             "(~1/4 the pool bytes at f32 model dtype)")
     parser.add_argument("--compile-cache", action="store_true",
                         help="enable JAX's persistent compilation cache "
                              "under the run dir (<obs-dir or "
@@ -582,6 +605,9 @@ def serve_main(argv: Optional[List[str]] = None,
         prefix_cache=not args.no_prefix_cache,
         prefill_chunk=args.prefill_chunk,
         spec_k=spec_k,
+        adapter_rank=args.adapter_rank,
+        adapter_pool_pages=args.adapter_pool_pages,
+        adapter_dtype=args.adapter_dtype,
     )
     if args.compile_cache:
         import os
@@ -663,12 +689,27 @@ def serve_main(argv: Optional[List[str]] = None,
                      slo=obs_session.slo, anomaly=obs_session.anomaly,
                      compilewatch=obs_session.compilewatch,
                      hbm=obs_session.hbm)
+    tenant_names: list = []
+    adapter_map = None
+    if serve_config.adapter_rank > 0:
+        # The smoke loop's synthetic traffic needs tenants for the
+        # adapter tier to resolve: a Zipf-skewed tenant->adapter map
+        # over more adapters than pool pages, so the run exercises
+        # residency churn (LRU eviction, never recompiles).
+        from trustworthy_dl_tpu.serve.workload import (
+            make_tenant_population, zipf_adapter_assignments)
+
+        tenant_names = [t.name for t in make_tenant_population(8)]
+        n_adapters = (args.adapter_pool_pages or 4) + 1
+        adapter_map = zipf_adapter_assignments(tenant_names, n_adapters,
+                                               seed=args.seed)
     engine = ServingEngine.from_config(
         trainer.state.params, cfg, serve_config,
         enable_monitor=not args.no_monitor,
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
         registry=obs_session.registry if obs_session else None,
+        adapter_map=adapter_map,
         **extra,
     )
     if engine.kv_fallback_reason:
@@ -677,21 +718,25 @@ def serve_main(argv: Optional[List[str]] = None,
     rng = np.random.default_rng(args.seed)
     deadline = args.deadline_ms / 1e3 if args.deadline_ms else None
     submitted = 0
-    for _ in range(args.num_requests):
+    for i in range(args.num_requests):
         plen = int(np.clip(rng.integers(max(args.prompt_len // 2, 1),
                                         args.prompt_len * 2 + 1),
                            1, args.max_seq - args.max_new_tokens))
         prompt = rng.integers(0, cfg.vocab_size, plen).tolist()
         new = int(rng.integers(1, args.max_new_tokens + 1))
+        tenant = tenant_names[i % len(tenant_names)] \
+            if tenant_names else None
         rid = engine.submit(ServeRequest(
             prompt=prompt, max_new_tokens=new,
             temperature=args.temperature, deadline_s=deadline,
+            tenant=tenant,
         ))
         if rid is None:
             engine.run_until_idle()  # drain, then retry the arrival
             rid = engine.submit(ServeRequest(
                 prompt=prompt, max_new_tokens=new,
                 temperature=args.temperature, deadline_s=deadline,
+                tenant=tenant,
             ))
         if rid is not None:
             submitted += 1
@@ -711,6 +756,14 @@ def serve_main(argv: Optional[List[str]] = None,
             print(f"  {key}: {shown}")
     if summary.get("quarantined_slots"):
         print(f"  quarantined slots: {summary['quarantined_slots']}")
+    adapters = summary.get("adapters")
+    if adapters:
+        print(f"  adapters: rank={adapters['rank']} "
+              f"dtype={adapters['dtype']} pages={adapters['pages']} "
+              f"resident={adapters['resident']} "
+              f"hit_rate={adapters['hit_rate']:.3f} "
+              f"evictions={adapters['evictions']} "
+              f"uploads={adapters['uploads']}")
     if obs_session is not None:
         ok, problems = engine.verify_attribution()
         print(f"attribution: {engine.ledger.total} record(s), "
@@ -841,6 +894,20 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
     except ValueError as exc:
         print(f"control plane: {exc}")
         return 2
+    adapter_map = None
+    if serve_config.adapter_rank > 0:
+        # Same adapter resolution as the single-engine path, over the
+        # workload generator's own tenant population: Zipf-skewed onto
+        # one more adapter than the pool holds, so the smoke run churns
+        # residency (and a crashed replica's rebuilt pool re-creates
+        # the same deterministic weights).
+        from trustworthy_dl_tpu.serve.workload import (
+            DEFAULT_TENANTS, zipf_adapter_assignments)
+
+        n_adapters = (args.adapter_pool_pages or 4) + 1
+        adapter_map = zipf_adapter_assignments(
+            [t.name for t in DEFAULT_TENANTS], n_adapters,
+            seed=args.seed)
     # One source of truth for the serving knobs: the SAME validated
     # ServeConfig the single-engine path uses, via from_config.
     fleet = ServingFleet.from_config(
@@ -867,6 +934,7 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
         # replica's pool allocation consults the HBM headroom gate.
         compilewatch=obs_session.compilewatch if obs_session else None,
         hbm=obs_session.hbm if obs_session else None,
+        adapter_map=adapter_map,
     )
     workload = generate_workload(
         WorkloadConfig(seed=args.seed, num_requests=args.num_requests,

@@ -332,6 +332,56 @@ class ExperimentConfig:
         )
 
 
+def validate_adapters(adapter_rank: int,
+                      adapter_pool_pages: Optional[int],
+                      adapter_dtype: str, paged: bool,
+                      spec_k: int) -> None:
+    """Loud construction-time validation of the adapter-tier knobs —
+    shared by ``ServeConfig`` and ``serve.adapters`` so a bad
+    combination fails where the operator typed it.
+
+    * ``adapter_rank`` must be >= 0 (0 = disabled: the serve programs
+      keep their adapter-free signatures, bit-for-bit today's output).
+    * adapters ride the PAGED pool only: the per-slot adapter-page
+      table is the same traced-table discipline as the KV block table,
+      which the legacy stripe pool has no machinery for.
+    * ``spec_k`` > 0 is rejected: the int8 draft model carries no
+      adapter deltas, so draft and verify would diverge on every
+      adapter-carrying request and speculation would never accept.
+    * ``adapter_dtype`` must be "model" or "int8".
+    * ``adapter_pool_pages`` (when given) must be >= 1 usable page.
+    """
+    if adapter_rank < 0:
+        raise ValueError(
+            f"adapter_rank must be >= 0 (0 disables), got {adapter_rank}"
+        )
+    if adapter_rank == 0:
+        return
+    if not paged:
+        raise ValueError(
+            "adapter_rank > 0 requires the paged KV pool (paged=True): "
+            "adapter pages are claimed per slot through the same traced "
+            "page-table discipline as KV blocks, which the legacy "
+            "stripe pool cannot express"
+        )
+    if spec_k > 0:
+        raise ValueError(
+            "adapter_rank > 0 is incompatible with spec_k > 0: the int8 "
+            "draft model carries no adapter deltas, so draft and verify "
+            "would diverge on every adapter-carrying request"
+        )
+    if adapter_dtype not in ("model", "int8"):
+        raise ValueError(
+            f"adapter_dtype must be 'model' or 'int8', got "
+            f"{adapter_dtype!r}"
+        )
+    if adapter_pool_pages is not None and adapter_pool_pages < 1:
+        raise ValueError(
+            f"adapter_pool_pages must be >= 1 (or None = max_slots), "
+            f"got {adapter_pool_pages}"
+        )
+
+
 @dataclass
 class ServeConfig:
     """Serving-engine configuration (serve/engine.py).
@@ -398,6 +448,26 @@ class ServeConfig:
     # fallback elsewhere), "pallas"/"interpret"/"jnp" force a path —
     # README §Serving/"Decode attention kernel".
     attn_impl: str = "auto"
+    # Multi-tenant adapter tier (serve/adapters.py; README §Adapters):
+    # per-tenant rank-r low-rank A/B deltas on the attention out
+    # projection + the MLP, stored in a SECOND paged HBM pool keyed by
+    # a traced per-slot adapter-page table, so tenant mix / adapter
+    # churn never recompiles the decode/prefill programs.
+    #
+    # * ``adapter_rank``: the low-rank width r; 0 (default) disables —
+    #   the serve path is bit-for-bit today's (the adapter arguments
+    #   stay structurally absent from every program signature).
+    # * ``adapter_pool_pages``: usable adapter pages (resident tenants);
+    #   None sizes the pool to ``max_slots`` (every slot could carry a
+    #   distinct adapter).  One extra reserved zero page (page 0) always
+    #   exists — the adapter-off slot's identity delta.
+    # * ``adapter_dtype``: "model" stores deltas in the model compute
+    #   dtype; "int8" stores symmetric-quantized int8 A/B with per-
+    #   (layer, page, site) scales, dequantized in-register inside the
+    #   low-rank matmul (ops/fused_dequant_matmul.py's template).
+    adapter_rank: int = 0
+    adapter_pool_pages: Optional[int] = None
+    adapter_dtype: str = "model"
 
     def __post_init__(self) -> None:
         from trustworthy_dl_tpu.quant import validate_dtypes
@@ -412,6 +482,8 @@ class ServeConfig:
                 f"'interpret', 'jnp'), got {self.attn_impl!r}"
             )
         validate_spec(self.spec_k, self.paged, self.weight_dtype)
+        validate_adapters(self.adapter_rank, self.adapter_pool_pages,
+                          self.adapter_dtype, self.paged, self.spec_k)
         if self.max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
         if self.max_seq < 1:

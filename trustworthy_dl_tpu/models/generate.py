@@ -120,20 +120,46 @@ def _attn_qkv(block: Params, x: jax.Array,
 
 
 def _attn_mlp_tail(block: Params, x: jax.Array, out: jax.Array,
-                   cfg: gpt2.GPT2Config) -> jax.Array:
+                   cfg: gpt2.GPT2Config,
+                   adapter: Optional[tuple] = None) -> jax.Array:
     """The post-attention scaffolding every cached-decode block shares:
     merge heads, attention projection + residual, ln_2 + MLP +
-    residual.  ``out`` [B, H, T, Dh] is the attention output."""
+    residual.  ``out`` [B, H, T, Dh] is the attention output.
+
+    ``adapter`` (serve/adapters.py) is the per-row gathered adapter
+    slice ``(a [B, 2, D, r], b [B, 2, r, D], a_scale, b_scale)`` —
+    scales None except on the int8 tier.  Site 0 rides the attention
+    output projection's input, site 1 the MLP's ln_2 input; a row
+    pointing at the reserved zero page contributes an exactly-zero
+    delta.  ``None`` (every non-serving caller, and every serve program
+    with ``adapter_rank == 0``) keeps this function bit-for-bit the
+    pre-adapter tail — structural absence, not a traced branch."""
+    from trustworthy_dl_tpu.ops.fused_dequant_matmul import lowrank_delta
     from trustworthy_dl_tpu.quant import int8 as q8
 
     dtype = cfg.dtype
     b, t, d = x.shape
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = x + q8.qdense(block["attn"]["proj"], out, dtype).astype(x.dtype)
+    if adapter is not None:
+        a_s, b_s, a_sc, b_sc = adapter
+        x = x + lowrank_delta(
+            out, a_s[:, 0], b_s[:, 0],
+            None if a_sc is None else a_sc[:, 0],
+            None if b_sc is None else b_sc[:, 0],
+        ).astype(x.dtype)
     y = L.layernorm(block["ln_2"], x).astype(dtype)
+    ln2 = y
     y = q8.qdense(block["mlp"]["fc"], y, dtype)
     y = jax.nn.gelu(y)
-    return x + q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+    mlp = q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
+    if adapter is not None:
+        mlp = mlp + lowrank_delta(
+            ln2, a_s[:, 1], b_s[:, 1],
+            None if a_sc is None else a_sc[:, 1],
+            None if b_sc is None else b_sc[:, 1],
+        ).astype(x.dtype)
+    return x + mlp
 
 
 def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
@@ -141,6 +167,7 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
                       cfg: gpt2.GPT2Config,
                       layer_k_scale: Optional[jax.Array] = None,
                       layer_v_scale: Optional[jax.Array] = None,
+                      adapter: Optional[tuple] = None,
                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                  Optional[jax.Array], Optional[jax.Array]]:
     """One transformer block over [B, T, D] new positions, attending to
@@ -204,7 +231,7 @@ def _block_with_cache(block: Params, x: jax.Array, layer_k: jax.Array,
         out = jnp.einsum("bhqk,bhkd->bhqd", pv, layer_v.astype(dtype))
     else:
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, layer_v)
-    x = _attn_mlp_tail(block, x, out, cfg)
+    x = _attn_mlp_tail(block, x, out, cfg, adapter=adapter)
     return x, layer_k, layer_v, layer_k_scale, layer_v_scale
 
 
@@ -377,6 +404,7 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
                  pool_ks_l: Optional[jax.Array] = None,
                  pool_vs_l: Optional[jax.Array] = None,
                  attn_impl: str = "jnp",
+                 adapter_l: Optional[tuple] = None,
                  ) -> Tuple[jax.Array, jax.Array, jax.Array,
                             Optional[jax.Array], Optional[jax.Array]]:
     """One transformer block over [R, T, D] new positions against a PAGED
@@ -399,11 +427,25 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
       observe another row's same-tick write on either path.
 
     ``start`` follows the dense contract: scalar (chunked prefill, R=1)
-    or i32[R] (fused decode, T=1)."""
+    or i32[R] (fused decode, T=1).
+
+    ``adapter_l`` is one layer's slice of the paged adapter pool plus
+    the per-slot page table: ``(a_l [P+1, 2, D, r], b_l [P+1, 2, r, D],
+    a_scale_l, b_scale_l, apages [R])``.  The page gather happens HERE,
+    inside the layer scan — exactly one layer's gathered pages are ever
+    live, mirroring the KV view discipline — and feeds both attention
+    paths through the shared ``_attn_mlp_tail``."""
+    adapter_s: Optional[tuple] = None
+    if adapter_l is not None:
+        a_l, b_l, as_l, bs_l, apages = adapter_l
+        adapter_s = (a_l[apages], b_l[apages],
+                     None if as_l is None else as_l[apages],
+                     None if bs_l is None else bs_l[apages])
     if attn_impl != "jnp":
         return _paged_block_kernel(block, x, pool_k_l, pool_v_l, table,
                                    start, cfg, pool_ks_l, pool_vs_l,
-                                   interpret=(attn_impl == "interpret"))
+                                   interpret=(attn_impl == "interpret"),
+                                   adapter=adapter_s)
     r, t, _ = x.shape
     nbps = table.shape[1]
     bsz = pool_k_l.shape[2]
@@ -424,7 +466,8 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
     view_vs = (_paged_gather(pool_vs_l, table_read)
                if pool_vs_l is not None else None)
     x, view_k, view_v, view_ks, view_vs = _block_with_cache(
-        block, x, view_k, view_v, start, cfg, view_ks, view_vs
+        block, x, view_k, view_v, start, cfg, view_ks, view_vs,
+        adapter=adapter_s
     )
     # Positions this call wrote into the view -> (physical block, offset).
     pos, phys, offs = _pool_write_coords(table_read, start, r, t, bsz,
@@ -453,6 +496,7 @@ def _paged_block_kernel(block: Params, x: jax.Array, pool_k_l: jax.Array,
                         pool_ks_l: Optional[jax.Array],
                         pool_vs_l: Optional[jax.Array],
                         interpret: bool,
+                        adapter: Optional[tuple] = None,
                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                    Optional[jax.Array],
                                    Optional[jax.Array]]:
@@ -498,7 +542,7 @@ def _paged_block_kernel(block: Params, x: jax.Array, pool_k_l: jax.Array,
         q, pool_k_l, pool_v_l, table, start,
         k_scale=pool_ks_l, v_scale=pool_vs_l, interpret=interpret,
     ).astype(cfg.dtype)                                    # [R, H, T, Dh]
-    x = _attn_mlp_tail(block, x, out, cfg)
+    x = _attn_mlp_tail(block, x, out, cfg, adapter=adapter)
     return x, pool_k_l, pool_v_l, pool_ks_l, pool_vs_l
 
 
@@ -511,6 +555,7 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
                             last_pos: Optional[jax.Array] = None,
                             all_logits: bool = False,
                             attn_impl: str = "jnp",
+                            adapter: Optional[tuple] = None,
                             ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                        Optional[jax.Array],
                                        Optional[jax.Array]]:
@@ -525,7 +570,16 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
     ``attn_impl`` (trace-time static, see :func:`_paged_block`) swaps the
     gathered-view attention for the ragged ``ops.paged_attention``
     kernel; tables/starts stay traced values either way, so the
-    compile-once pin holds on both paths."""
+    compile-once pin holds on both paths.
+
+    ``adapter`` is the paged adapter-pool pytree ``(a [L, P+1, 2, D,
+    r], b, a_scale, b_scale, apages [R])`` (serve/adapters.py): the
+    pool sides join the layer scan's xs (leading L axis, like the KV
+    pools) and the per-slot page table is closed over — both traced
+    values, so adapter churn and tenant-mix changes never recompile.
+    ``None`` (adapter_rank == 0) contributes zero pytree leaves: the
+    compiled program is structurally identical to the pre-adapter
+    one."""
     t = tokens.shape[-1]
     if jnp.ndim(start) == 0:
         pos = start + jnp.arange(t)                        # [T]
@@ -533,16 +587,25 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
         pos = start[:, None] + jnp.arange(t)[None, :]      # [R, T]
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(jnp.float32)
 
+    if adapter is not None:
+        ad_a, ad_b, ad_as, ad_bs, apages = adapter
+    else:
+        ad_a = ad_b = ad_as = ad_bs = apages = None
+
     def scan_fn(carry, layer):
         x = carry
-        block, pk, pv, pks, pvs = layer
+        block, pk, pv, pks, pvs, a_l, b_l, as_l, bs_l = layer
+        adapter_l = (None if a_l is None
+                     else (a_l, b_l, as_l, bs_l, apages))
         x, pk, pv, pks, pvs = _paged_block(block, x, pk, pv, table, start,
                                            cfg, pks, pvs,
-                                           attn_impl=attn_impl)
+                                           attn_impl=attn_impl,
+                                           adapter_l=adapter_l)
         return x, (pk, pv, pks, pvs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-        scan_fn, x, (params["blocks"], pool_k, pool_v, pool_ks, pool_vs),
+        scan_fn, x, (params["blocks"], pool_k, pool_v, pool_ks, pool_vs,
+                     ad_a, ad_b, ad_as, ad_bs),
     )
     if all_logits:
         return _all_logits(params, x, cfg), new_k, new_v, new_ks, new_vs

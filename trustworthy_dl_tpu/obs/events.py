@@ -91,6 +91,13 @@ class EventType(str, enum.Enum):
     # flooding tenant's own bucket refused).
     FLEET_SCALE = "fleet_scale"
     TENANT_THROTTLE = "tenant_throttle"
+    # Adapter tier (serve/adapters.py): every residency change of the
+    # paged adapter pool (a tenant's adapter uploaded into a pool page,
+    # evicting a cold tenant when the pool is full) and every fleet-wide
+    # adapter quarantine (the per-ADAPTER flag-rate window tripping —
+    # the trust verdict that blames the model delta, not the replica).
+    ADAPTER_SWAP = "adapter_swap"
+    ADAPTER_QUARANTINE = "adapter_quarantine"
     # Performance tier (obs/compilewatch.py, hbm.py, sentinel.py):
     # every XLA compilation, compile-once contract violations, live-HBM
     # sweeps/pressure denials, and perf-ledger regressions.
@@ -193,6 +200,18 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     EventType.TENANT_THROTTLE: {
         "requires": (),
         "fields": ("tenant", "tokens", "bucket_level"),
+    },
+    # Adapter pool residency: a swap names the adapter that moved in,
+    # the pool page it landed on, and the evicted adapter (None for a
+    # cold-start fill of a free page).  A quarantine names the adapter
+    # and the flag-rate evidence that tripped the per-adapter window.
+    EventType.ADAPTER_SWAP: {
+        "requires": (),
+        "fields": ("adapter", "page", "evicted"),
+    },
+    EventType.ADAPTER_QUARANTINE: {
+        "requires": (),
+        "fields": ("adapter", "reason"),
     },
     # Performance tier.  ``compile`` rows are per-XLA-compilation (key =
     # the jax.monitoring stage, seconds = backend compile wall time);

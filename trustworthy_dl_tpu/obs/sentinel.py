@@ -52,6 +52,14 @@ SENTINEL_METRICS: Dict[str, str] = {
     # perf regression even while tokens/s noise hides it, and the
     # tddl_serve_attn_kernel{path=} gauge names the culprit.
     "decode_tick_fraction": "lower",
+    # Adapter-pool locality (pool hits / lookups) and the equal-HBM
+    # personalisation cost (adapter-arm tokens/s over base-arm tokens/s
+    # at the SAME budget, TDDL_BENCH_ADAPTERS rounds).  A colder pool
+    # (eviction thrash after a Zipf-shape shift) or a pricier gathered
+    # low-rank path both band — and name their cause — before the
+    # headline tokens/s notices.
+    "adapter_hit_rate": "higher",
+    "adapter_tokens_ratio": "higher",
 }
 
 
@@ -64,6 +72,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                 hbm_watermark_bytes: Optional[int] = None,
                 accepted_rate: Optional[float] = None,
                 decode_tick_fraction: Optional[float] = None,
+                adapter_hit_rate: Optional[float] = None,
+                adapter_tokens_ratio: Optional[float] = None,
                 run_metadata: Optional[Dict[str, Any]] = None,
                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One compact perf fingerprint.  ``key`` scopes comparability:
@@ -90,7 +100,9 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                         ("compile_seconds", compile_seconds),
                         ("hbm_watermark_bytes", hbm_watermark_bytes),
                         ("accepted_rate", accepted_rate),
-                        ("decode_tick_fraction", decode_tick_fraction)):
+                        ("decode_tick_fraction", decode_tick_fraction),
+                        ("adapter_hit_rate", adapter_hit_rate),
+                        ("adapter_tokens_ratio", adapter_tokens_ratio)):
         if value is not None:
             fp[name] = float(value)
     if phase_fractions:

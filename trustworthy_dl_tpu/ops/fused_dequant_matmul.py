@@ -105,3 +105,33 @@ def dequant_matmul(x: jax.Array, w_q: jax.Array, scale: jax.Array,
         preferred_element_type=jnp.float32,
     )
     return acc * scale[None, :]
+
+
+def lowrank_delta(x: jax.Array, a: jax.Array, b: jax.Array,
+                  a_scale: Optional[jax.Array] = None,
+                  b_scale: Optional[jax.Array] = None) -> jax.Array:
+    """Batched gathered low-rank delta ``(x @ A) @ B`` for the paged
+    adapter tier (serve/adapters.py), one site at a time::
+
+        x [R, T, D] @ a [R, D, r] -> h [R, T, r] @ b [R, r, D]
+
+    ``a``/``b`` are each row's gathered pool page — R rows may point at
+    R different tenants' adapters in one contraction (the segmented
+    batched-matmul form of the per-slot page table).  On the int8 tier
+    the pages arrive int8 with per-row scales [R]: the upcast happens
+    in-register inside the f32-accumulating contraction and the scale
+    multiplies the accumulator — the same dequant-in-register discipline
+    as :func:`dequant_matmul`, never a materialised f32 pool copy.
+    Accumulation is f32 on every path; rank is tiny (r << D), so the
+    contraction is bandwidth-trivial next to the base matmuls and needs
+    no dedicated tile."""
+    h = jnp.einsum("rtd,rdk->rtk", x.astype(jnp.float32),
+                   a.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if a_scale is not None:
+        h = h * a_scale[:, None, None]
+    out = jnp.einsum("rtk,rkd->rtd", h, b.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    if b_scale is not None:
+        out = out * b_scale[:, None, None]
+    return out

@@ -81,6 +81,23 @@ class _NullMetric:
         return None
 
 
+class _BoundMetric:
+    """Binds an engine's fixed labels (replica=… in fleet mode) onto a
+    registry metric so components that don't know about fleet labelling
+    (the AdapterPool's gauge/counter handles) can call plain
+    ``set(v)`` / ``inc(tenant=…)``."""
+
+    def __init__(self, metric: Any, labels: Dict[str, str]):
+        self._metric = metric
+        self._labels = labels
+
+    def set(self, *a: Any, **kw: Any) -> None:
+        self._metric.set(*a, **{**kw, **self._labels})
+
+    def inc(self, *a: Any, **kw: Any) -> None:
+        self._metric.inc(*a, **{**kw, **self._labels})
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One generation request.  ``temperature<=0`` decodes greedily;
@@ -102,7 +119,10 @@ class ServeRequest:
     transient audits that must not perturb cache state.  ``tenant``
     is the end-to-end tenant identity: it rides the attribution-ledger
     record and the ``serve.request`` span, and the FLEET's per-tenant
-    token buckets meter admission by it (None = untagged)."""
+    token buckets meter admission by it (None = untagged).  ``adapter``
+    names the tenant's low-rank adapter (serve/adapters.py) — None
+    falls back to the engine's ``adapter_map`` lookup by tenant, and
+    the resolved id claims a pool page at admission."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -116,6 +136,7 @@ class ServeRequest:
     span_parent: Optional[int] = None
     publish_prefix: bool = True
     tenant: Optional[str] = None
+    adapter: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -131,6 +152,7 @@ class ServeResult:
     itl_s: List[float]             # inter-token latencies
     flagged: bool = False          # output monitor verdict
     monitor_z: float = 0.0
+    adapter: Optional[str] = None  # resolved adapter id (serve/adapters.py)
 
 
 class OutputMonitor:
@@ -207,7 +229,11 @@ class ServingEngine:
                  replica_id: Optional[int] = None,
                  retire_hook: Optional[Callable[..., None]] = None,
                  compilewatch: Any = None, hbm: Any = None,
-                 spec_k: int = 0, attn_impl: str = "auto"):
+                 spec_k: int = 0, attn_impl: str = "auto",
+                 adapter_rank: int = 0,
+                 adapter_pool_pages: Optional[int] = None,
+                 adapter_dtype: str = "model",
+                 adapter_map: Optional[Dict[str, str]] = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
@@ -276,9 +302,12 @@ class ServingEngine:
         # the same loud knob validation ServeConfig runs, so engines
         # built without a config fail identically (paged pool required,
         # weight_dtype must stay "model" — the int8 tier is the DRAFT).
-        from trustworthy_dl_tpu.core.config import validate_spec
+        from trustworthy_dl_tpu.core.config import (validate_adapters,
+                                                    validate_spec)
 
         validate_spec(spec_k, paged, weight_dtype)
+        validate_adapters(adapter_rank, adapter_pool_pages, adapter_dtype,
+                          paged, spec_k)
         self.spec_k = int(spec_k)
         self.kv_fallback_reason: Optional[str] = None
         # The decode view is built at most ONCE here and shared with the
@@ -346,6 +375,46 @@ class ServingEngine:
                     max_slots = fallback_slots
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        # Multi-tenant adapter tier (serve/adapters.py): the SECOND
+        # paged HBM resource, sized through the SAME headroom gate as
+        # the KV pool — and sized AFTER it, so the KV pool keeps its
+        # claim and the adapter pool shrinks into what remains (floor:
+        # one usable page).  ``adapter_map`` routes tenant → adapter id
+        # for requests that don't name one explicitly.
+        self.adapter_rank = int(adapter_rank)
+        self.adapter_dtype = adapter_dtype
+        self.adapter_map: Dict[str, str] = dict(adapter_map or {})
+        self.adapter_pool: Any = None
+        if adapter_rank > 0:
+            from trustworthy_dl_tpu.serve.adapters import (
+                AdapterPool,
+                adapter_bytes_per_page,
+                adapter_pool_bytes,
+            )
+
+            pages = (adapter_pool_pages if adapter_pool_pages is not None
+                     else max_slots)
+            if hbm is not None:
+                bpp = adapter_bytes_per_page(cfg, adapter_rank,
+                                             adapter_dtype)
+                requested = adapter_pool_bytes(cfg, pages, adapter_rank,
+                                               adapter_dtype)
+                if not hbm.admit(requested, what="serve_adapter_pool"):
+                    # Re-size from the SAME sweep that denied (the KV
+                    # template above): headroom // bytes-per-page, minus
+                    # the reserved zero page, floored at one usable page.
+                    headroom = max(hbm.last_headroom or 0, 0)
+                    allowed = max(int(headroom // bpp) - 1, 1)
+                    logger.warning(
+                        "HBM headroom gate: adapter pool shrunk %d -> %d "
+                        "pages (requested %d bytes, headroom %d)",
+                        pages, allowed, requested, headroom,
+                    )
+                    pages = allowed
+            self.adapter_pool = AdapterPool(
+                cfg, adapter_rank, pages, adapter_dtype=adapter_dtype,
+                trace=trace,
+            )
         if paged:
             # ``attn_impl`` selects the decode-attention read (README
             # §Serving/"Decode attention kernel"): "auto" resolves
@@ -360,7 +429,7 @@ class ServingEngine:
                 block_size=block_size, num_blocks=num_blocks,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                 spec_k=self.spec_k, draft_view=draft_view,
-                attn_impl=attn_impl,
+                attn_impl=attn_impl, adapters=self.adapter_pool,
             )
         else:
             if attn_impl not in ("auto", "jnp"):
@@ -485,6 +554,28 @@ class ServingEngine:
             labels=self._rlabel_names,
         )
         self._prefix_hits_seen = 0
+        # Adapter-pool residency surface (serve/adapters.py): pages
+        # resident (impounded included) and evictions by evicted tenant.
+        # Registered on every engine so the snapshot shape is uniform;
+        # an adapterless engine just exports 0.  The pool receives
+        # label-bound handles — it doesn't know about fleet labelling.
+        self._adapter_pages_gauge = _metric(
+            registry.gauge, "tddl_serve_adapter_pages_in_use",
+            "Adapter-pool pages resident (live + warm + impounded); 0 "
+            "when the adapter tier is off",
+            labels=self._rlabel_names,
+        )
+        self._adapter_pages_gauge.set(0.0, **self._rlabels)
+        self._adapter_evictions_counter = _metric(
+            registry.counter, "tddl_serve_adapter_evictions_total",
+            "Cold adapters LRU-evicted from the pool, by evicted tenant",
+            labels=("tenant",) + self._rlabel_names,
+        )
+        if self.adapter_pool is not None:
+            self.adapter_pool._pages_gauge = _BoundMetric(
+                self._adapter_pages_gauge, self._rlabels)
+            self.adapter_pool._evictions_counter = _BoundMetric(
+                self._adapter_evictions_counter, self._rlabels)
         # Decode-attention path gauge: one series per path, the active
         # one set to 1 — a silent fallback to the slow jnp gather (gate
         # off, untileable geometry, non-TPU backend) is visible in EVERY
@@ -620,6 +711,9 @@ class ServingEngine:
             prefill_chunk=serve_config.prefill_chunk,
             spec_k=serve_config.spec_k,
             attn_impl=serve_config.attn_impl,
+            adapter_rank=serve_config.adapter_rank,
+            adapter_pool_pages=serve_config.adapter_pool_pages,
+            adapter_dtype=serve_config.adapter_dtype,
             **kwargs,
         )
 
@@ -649,6 +743,20 @@ class ServingEngine:
                 f"prompt of {prompt.size} tokens exceeds the largest "
                 f"prefill bucket {largest_bucket}"
             )
+        # Tenant → adapter resolution: an explicit request.adapter wins,
+        # else the engine's adapter_map by tenant.  Loud when the tier
+        # is off — a silently dropped adapter would serve the BASE model
+        # under the tenant's name, the exact trust failure the paged
+        # adapter tier exists to prevent.
+        adapter = request.adapter
+        if adapter is None and request.tenant is not None:
+            adapter = self.adapter_map.get(request.tenant)
+        if adapter is not None and self.adapter_pool is None:
+            raise ValueError(
+                f"request names adapter {adapter!r} but the adapter tier "
+                "is off (adapter_rank=0); serving it on the base model "
+                "would silently misattribute the stream"
+            )
         if len(self._queue) >= self.queue_limit:
             self.rejected += 1
             self._req_counter.inc(status="rejected", **self._rlabels)
@@ -666,6 +774,7 @@ class ServingEngine:
             keys=request_key_stream(rng, int(request.max_new_tokens)),
             eos_id=request.eos_id,
             publish_prefix=bool(request.publish_prefix),
+            adapter=adapter,
         )
         self._queue.append((task, request))
         self._submit_t[request_id] = time.perf_counter()
@@ -1046,6 +1155,7 @@ class ServingEngine:
             request_id=request_id, tokens=list(task.emitted),
             status=status, ttft_s=ttft,
             itl_s=[b - a for a, b in zip(times, times[1:])],
+            adapter=task.adapter,
         ), placement=placement)
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=request_id,
@@ -1102,6 +1212,7 @@ class ServingEngine:
         self._record_result(ServeResult(
             request_id=rid, tokens=list(task.emitted), status=status,
             ttft_s=ttft, itl_s=itl, flagged=flagged, monitor_z=z,
+            adapter=task.adapter,
         ), placement=placement)
         if ttft is not None:
             self._ttft_hist.observe(ttft, **self._rlabels)
@@ -1142,7 +1253,10 @@ class ServingEngine:
                 self.trace.emit(EventType.ATTRIBUTION, request_id=rid,
                                 slot=int(task.slot),
                                 n_blocks=len(placement["block_ids"]),
-                                token_hash=thash, flagged=bool(flagged), **self._trace_tags)
+                                token_hash=thash, flagged=bool(flagged),
+                                adapter=placement.get("adapter"),
+                                adapter_page=placement.get(
+                                    "adapter_page", 0), **self._trace_tags)
         self._close_request_spans(rid, status, tokens=len(task.emitted),
                                   flagged=bool(flagged))
         self.metrics.collect_batch_metrics({
@@ -1212,6 +1326,25 @@ class ServingEngine:
         # blocks impounded with the slot, not just the decode row.
         self.scheduler.release_quarantine(slot)
 
+    def quarantine_adapter(self, name: str) -> None:
+        """Apply a fleet-level trust verdict against an ADAPTER to this
+        replica's pool: future resolves refuse, the page impounds when
+        its last in-flight request drains.  The replica itself stays in
+        service — adapter trust and replica trust are separate axes
+        (serve/fleet.py owns the verdict and the fleet-wide event)."""
+        if self.adapter_pool is not None:
+            self.adapter_pool.quarantine(name)
+
+    def unquarantine_adapter(self, name: str) -> None:
+        """Operator action: lift an adapter verdict on this replica."""
+        if self.adapter_pool is not None:
+            self.adapter_pool.unquarantine(name)
+
+    @property
+    def quarantined_adapters(self):
+        return (self.adapter_pool.quarantined
+                if self.adapter_pool is not None else set())
+
     def metrics_summary(self) -> Dict[str, Any]:
         """Serving-side rollup: throughput, latency percentiles, trust.
 
@@ -1262,6 +1395,12 @@ class ServingEngine:
                 out["spec_near_tie_flips"] = sched.spec_near_tie_flips
                 out["spec_ticks"] = sched.spec_ticks
                 out["spec_fallback_ticks"] = sched.spec_fallback_ticks
+        if self.adapter_pool is not None:
+            out["adapters"] = {
+                "rank": self.adapter_rank,
+                "dtype": self.adapter_dtype,
+                **self.adapter_pool.metrics(),
+            }
         for name, signal, est in (("itl", "itl_s", self._itl_est),
                                   ("ttft", "ttft_s", self._ttft_est)):
             if self.slo is not None:

@@ -216,6 +216,13 @@ class FleetConfig:
     #: no quotas.  Requests with tenant=None bypass quota (untagged
     #: traffic is the operator's own).
     tenant_quota: Optional[Any] = None
+    #: Per-ADAPTER token-bucket admission (control.TenantQuotaConfig,
+    #: keyed by adapter id): one tenant's fine-tune must not starve the
+    #: base-model traffic or another tenant's adapter — a submission
+    #: resolving to an adapter spends against BOTH its tenant bucket and
+    #: its adapter bucket.  None = no adapter quotas.  Requests that
+    #: resolve to no adapter bypass this bucket entirely.
+    adapter_quota: Optional[Any] = None
     #: Autoscaler (control.AutoscalerConfig): drives the replica count
     #: between min/max from queue depth, occupancy, ITL-p99, SLO burn
     #: and the predictive arm, with hysteresis + cool-downs.  Scale-up
@@ -291,6 +298,7 @@ class FleetResult:
     monitor_z: float = 0.0
     tenant: Optional[str] = None   # end-to-end tenant identity
     slo_class: Optional[str] = None  # class it was scheduled under
+    adapter: Optional[str] = None  # adapter the stream was served under
 
 
 @dataclasses.dataclass
@@ -340,6 +348,8 @@ class _FleetRequest:
     span_root: Optional[int] = None
     tenant: Optional[str] = None
     slo_class: Optional[str] = None
+    adapter: Optional[str] = None  # fleet-resolved adapter id (explicit
+    #                              # request.adapter, else adapter_map)
     cost: int = 0                  # prompt + max_new (bucket/DRR tokens)
 
 
@@ -501,6 +511,11 @@ class ServingFleet:
             "Submissions throttled by the per-tenant token bucket",
             labels=("tenant",),
         )
+        self._adapter_throttle_counter = registry.counter(
+            "tddl_fleet_adapter_throttled_total",
+            "Submissions throttled by the per-adapter token bucket",
+            labels=("adapter",),
+        )
         self._scale_counter = registry.counter(
             "tddl_fleet_scale_events_total",
             "Autoscaler replica-count changes, by direction",
@@ -517,6 +532,9 @@ class ServingFleet:
         self._max_seq: Optional[int] = None
         self._max_bucket: Optional[int] = None
         self.requests: Dict[int, _FleetRequest] = {}
+        # Fid whose terminal is mid-processing: an adapter conviction
+        # fired from inside its own retirement must not usurp it.
+        self._terminal_fid: Optional[int] = None
         self.results: Dict[int, FleetResult] = {}
         self._local2fleet: Dict[Tuple[int, int], int] = {}
         self._terminal: Deque[Tuple[int, ServeResult, Optional[dict]]] = \
@@ -536,6 +554,8 @@ class ServingFleet:
             "suspicions": 0, "votes": 0, "outvotes": 0,
             "tenant_floods": 0, "throttles": 0,
             "scale_ups": 0, "scale_downs": 0,
+            "adapter_poisons": 0, "adapter_quarantines": 0,
+            "adapter_throttles": 0,
         }
         # Verdict-vote working state: (voter replica, engine-local id)
         # -> the vote its replay ballots into.  Vote replays never enter
@@ -571,6 +591,31 @@ class ServingFleet:
                 for c in self._classes}
         self._buckets = (TenantBuckets(cfg.tenant_quota)
                          if cfg.tenant_quota is not None else None)
+        # -- adapter trust plane (serve/adapters.py) --
+        # The SAME TenantBuckets machinery, keyed by ADAPTER id: QoS
+        # follows the artifact being served, not just who asked.
+        self._adapter_buckets = (TenantBuckets(cfg.adapter_quota)
+                                 if cfg.adapter_quota is not None else None)
+        #: Fleet-resolved tenant -> adapter assignments, mirroring the
+        #: engines' own map (engine_kwargs["adapter_map"]) so submit()
+        #: can police quarantines/quotas BEFORE picking a replica.
+        self._adapter_map: Dict[str, str] = dict(
+            engine_kwargs.get("adapter_map") or {})
+        #: Fleet-wide per-ADAPTER flag-rate windows.  An adapter is one
+        #: artifact resident on MANY replicas: its evidence pools
+        #: fleet-wide (same window/thresholds as the replica ladder) and
+        #: a trip quarantines the ADAPTER everywhere while the replicas
+        #: that served it stay HEALTHY — trust follows attribution.
+        self._adapter_flags: Dict[str, Deque[int]] = {}
+        self.quarantined_adapters: Set[str] = set()
+        #: Engine-side slot impounds whose flags were ADAPTER-attributed
+        #: (adapter -> [(replica, gen, slot)]).  The engine impounds the
+        #: slot at retire time without knowing fleet policy; once the
+        #: fleet convicts the ADAPTER the evidence transfers to the
+        #: artifact and the slots release — otherwise a poisoned adapter
+        #: would exhaust a healthy replica's capacity and drag it down
+        #: the drain ladder by attrition.
+        self._adapter_impounds: Dict[str, List[Tuple[int, int, int]]] = {}
         self.autoscaler = (Autoscaler(cfg.autoscale)
                            if cfg.autoscale is not None else None)
         # Fleet-wide completed-request ITL sketch: the autoscaler's
@@ -612,6 +657,12 @@ class ServingFleet:
             # spec_k rides engine_kwargs, so the cool-off probe's
             # rebuilt engine drafts exactly like the one it replaces.
             spec_k=serve_config.spec_k,
+            # Adapter knobs ride engine_kwargs the same way: a replica
+            # rebuilt after a crash re-creates its pool with the exact
+            # geometry (and deterministic weights) of the one it lost.
+            adapter_rank=serve_config.adapter_rank,
+            adapter_pool_pages=serve_config.adapter_pool_pages,
+            adapter_dtype=serve_config.adapter_dtype,
             **kwargs,
         )
 
@@ -651,6 +702,12 @@ class ServingFleet:
             index, engine, self.config.flag_window)
         rep.engine = engine
         rep.reset_trust_window()
+        # A rebuilt replica must inherit the fleet's standing adapter
+        # verdicts: the quarantine is against the ARTIFACT, and a crash
+        # restart must not reopen a door the fleet already closed.
+        for name in self.quarantined_adapters:
+            if hasattr(engine, "quarantine_adapter"):
+                engine.quarantine_adapter(name)
         self.journals[rep.journal_key] = self._engine_journal(engine)
         # Geometry limits for submit-time validation, captured ONCE so
         # impossible requests fail in submit() even when every engine is
@@ -700,6 +757,19 @@ class ServingFleet:
                     f"prefill bucket {self._max_bucket}")
         cost = prompt_len + int(request.max_new_tokens)
         tenant = request.tenant
+        # Resolve the adapter at the FLEET boundary (explicit wins, else
+        # the tenant map), mirroring the engine's own resolution, so the
+        # quarantine/quota verdicts land before any replica is picked.
+        adapter = getattr(request, "adapter", None)
+        if adapter is None and tenant is not None:
+            adapter = self._adapter_map.get(tenant)
+        if adapter is not None and adapter in self.quarantined_adapters:
+            # Fleet-wide adapter quarantine: the refusal is loud and
+            # replica-independent — every replica would refuse it too.
+            logger.warning(
+                "fleet: adapter %r is quarantined fleet-wide; "
+                "submission for tenant %r refused", adapter, tenant)
+            return None
         # Per-tenant token-bucket admission: the flooding tenant's own
         # bucket refuses the submission — loudly — before any fleet
         # state is touched.  Untagged traffic (tenant None) bypasses
@@ -715,6 +785,26 @@ class ServingFleet:
                 if self.trace is not None:
                     self.trace.emit(EventType.TENANT_THROTTLE,
                                     tenant=tenant, tokens=cost,
+                                    bucket_level=round(level, 2),
+                                    tick=self.tick)
+                return None
+        # Per-ADAPTER bucket SECOND: a refusal here must hand back the
+        # tenant spend above (a throttled submission does no work).
+        if self._adapter_buckets is not None and adapter is not None:
+            if not self._adapter_buckets.try_spend(adapter, cost,
+                                                   self.tick):
+                if self._buckets is not None and tenant is not None:
+                    self._buckets.refund(tenant, cost, self.tick)
+                self.counters["adapter_throttles"] += 1
+                self._adapter_throttle_counter.inc(adapter=adapter)
+                level = self._adapter_buckets.level(adapter, self.tick)
+                logger.warning(
+                    "fleet: adapter %r throttled (%d tokens, bucket at "
+                    "%.1f)", adapter, cost, level)
+                if self.trace is not None:
+                    self.trace.emit(EventType.TENANT_THROTTLE,
+                                    tenant=tenant, adapter=adapter,
+                                    tokens=cost,
                                     bucket_level=round(level, 2),
                                     tick=self.tick)
                 return None
@@ -734,7 +824,7 @@ class ServingFleet:
             deadline_at=(now + request.deadline_s
                          if request.deadline_s is not None else None),
             submit_t=now,
-            tenant=tenant, cost=cost,
+            tenant=tenant, adapter=adapter, cost=cost,
         )
         if self._classes:
             rec.slo_class = self._class_for_priority(
@@ -787,6 +877,8 @@ class ServingFleet:
         must not drain the tenant's budget."""
         if self._buckets is not None and rec.tenant is not None:
             self._buckets.refund(rec.tenant, rec.cost, self.tick)
+        if self._adapter_buckets is not None and rec.adapter is not None:
+            self._adapter_buckets.refund(rec.adapter, rec.cost, self.tick)
 
     def _pick_replicas(self, rec: _FleetRequest,
                        exclude: Set[int] = frozenset()) -> List[_Replica]:
@@ -837,7 +929,7 @@ class ServingFleet:
             deadline_s=deadline_s, rng=rec.rng,
             on_token=self._token_forwarder(rec, rep.index),
             priority=rec.priority, first_submit_id=rec.fid,
-            span_parent=span, tenant=rec.tenant,
+            span_parent=span, tenant=rec.tenant, adapter=rec.adapter,
         ))
         if local is None:
             if span is not None:
@@ -928,6 +1020,14 @@ class ServingFleet:
             if event.kind is FaultKind.TENANT_FLOOD:
                 self.counters["tenant_floods"] += 1
                 self._run_flood(event)
+                continue
+            if event.kind is FaultKind.ADAPTER_POISON:
+                # The injector keeps the persistent per-adapter signal
+                # overwrite (the adapter id rides the event's ``tenant``
+                # field — there is no replica target: a poisoned
+                # artifact is everywhere its page is resident).  The
+                # per-adapter flag ladder does the catching.
+                self.counters["adapter_poisons"] += 1
                 continue
             target = event.target
             if not 0 <= target < len(self.replicas):
@@ -1296,7 +1396,28 @@ class ServingFleet:
             # queue-side deadline expiry has placement None and never
             # ran, so feeding it would dilute the flag rate and let a
             # poisoned replica hide behind tight-deadline sheds).
-            self.observe_retirement(replica, result.flagged)
+            adapter = getattr(result, "adapter", None)
+            if adapter is not None:
+                # Adapter-attributed stream: the flag indicts the
+                # ARTIFACT, not the replica that hosted it — the verdict
+                # pools into the fleet-wide per-adapter window and the
+                # replica's own window records a clean retirement (its
+                # base-model behaviour is not in evidence here).
+                if result.flagged:
+                    self._note_adapter_impound(adapter, replica, placement)
+                # This observation may CONVICT the adapter, and the
+                # conviction sweep fails every open request riding it —
+                # but this fid's real result is in hand, mid-flight:
+                # mark it so the sweep leaves it to finalize below.
+                self._terminal_fid = fid
+                try:
+                    self._observe_adapter_retirement(adapter,
+                                                     result.flagged)
+                finally:
+                    self._terminal_fid = None
+                self.observe_retirement(replica, False)
+            else:
+                self.observe_retirement(replica, result.flagged)
         if att.loser or (rec.done and status != "hedge_lost"):
             # A dedup loser we cancelled — or the race variant: both
             # attempts completed inside one tick and this one lost.
@@ -1377,6 +1498,7 @@ class ServingFleet:
             attempts=rec.submissions, ttft_s=ttft,
             flagged=result.flagged, monitor_z=result.monitor_z,
             tenant=rec.tenant, slo_class=rec.slo_class,
+            adapter=rec.adapter,
         )
         if result.status == "completed":
             for dt in result.itl_s:
@@ -1408,6 +1530,7 @@ class ServingFleet:
             request_id=rec.fid, tokens=[], status=status, replica=None,
             attempts=rec.submissions, ttft_s=None,
             tenant=rec.tenant, slo_class=rec.slo_class,
+            adapter=rec.adapter,
         )
         if self.ledger is not None:
             self.ledger.append({
@@ -1451,6 +1574,8 @@ class ServingFleet:
             "token_hash": attribution.token_hash(result.tokens),
             "ttft_s": ttft, "submissions": rec.submissions,
             "tenant": rec.tenant, "slo_class": rec.slo_class,
+            "adapter": rec.adapter,
+            "adapter_page": winner.get("adapter_page", 0),
         })
 
     def _ledger_loser(self, rec: _FleetRequest, att: _Attempt) -> None:
@@ -1683,6 +1808,108 @@ class ServingFleet:
         if self.chaos is not None and hasattr(self.chaos,
                                               "on_flag_observed"):
             self.chaos.on_flag_observed(replica, flagged, rep.flag_rate)
+
+    # -- adapter trust plane ----------------------------------------------
+
+    def _observe_adapter_retirement(self, adapter: str,
+                                    flagged: bool) -> None:
+        """Feed one adapter-attributed retirement's monitor verdict into
+        the ADAPTER's fleet-wide flag window.  Same window length and
+        trip predicate as the replica ladder (flag_min_count /
+        flag_rate_quarantine over flag_window) — but the evidence pools
+        across every replica serving the adapter, and the trip
+        quarantines the adapter EVERYWHERE in one step."""
+        cfg = self.config
+        win = self._adapter_flags.get(adapter)
+        if win is None:
+            win = self._adapter_flags[adapter] = deque(
+                maxlen=cfg.flag_window)
+        win.append(1 if flagged else 0)
+        if adapter in self.quarantined_adapters:
+            return  # already impounded; late stragglers add no verdict
+        count = sum(win)
+        rate = count / len(win)
+        if count >= cfg.flag_min_count and rate >= cfg.flag_rate_quarantine:
+            self._quarantine_adapter(adapter, "monitor_flag_rate", rate)
+
+    def _note_adapter_impound(self, adapter: str, replica: int,
+                              placement: Optional[dict]) -> None:
+        """Remember an engine-side slot impound whose flag was
+        ADAPTER-attributed.  The engine quarantines the slot at retire
+        time (defence in depth — it cannot know fleet policy); once the
+        fleet convicts the adapter the evidence belongs to the artifact
+        and the slot is released (an already-convicted adapter's
+        straggler releases immediately)."""
+        slot = (placement or {}).get("slot", -1)
+        if slot is None or slot < 0:
+            return
+        rep = self.replicas[replica]
+        if adapter in self.quarantined_adapters:
+            self._release_impound(rep, rep.gen, int(slot))
+        else:
+            self._adapter_impounds.setdefault(adapter, []).append(
+                (replica, rep.gen, int(slot)))
+
+    def _release_impound(self, rep: "_Replica", gen: int,
+                         slot: int) -> None:
+        if (rep.engine is not None and rep.gen == gen
+                and slot in rep.engine.quarantined_slots):
+            rep.engine.release_quarantine(slot)
+
+    def _quarantine_adapter(self, adapter: str, reason: str,
+                            flag_rate: float = 0.0) -> None:
+        """Fleet-wide adapter quarantine: refuse new submissions naming
+        the adapter, impound its pool page on EVERY replica (in-flight
+        requests finish; the page frees at the last release), emit the
+        typed event, bump the drill counter.  Replicas stay in service —
+        the artifact is the convict, not the host."""
+        if adapter in self.quarantined_adapters:
+            return
+        self.quarantined_adapters.add(adapter)
+        self.counters["adapter_quarantines"] += 1
+        for rep in self.replicas:
+            if rep.engine is not None and hasattr(rep.engine,
+                                                  "quarantine_adapter"):
+                rep.engine.quarantine_adapter(adapter)
+        # Conviction transfers the evidence: the slots the engines
+        # impounded for THIS adapter's flags go back in service (the
+        # replicas were never the suspects).
+        for replica, gen, slot in self._adapter_impounds.pop(adapter, []):
+            self._release_impound(self.replicas[replica], gen, slot)
+        # The verdict is fleet-wide and permanent until an operator
+        # readmits: every open request riding the adapter would sit in
+        # an engine queue forever (admission refuses a quarantined
+        # page's resolution) or keep streaming through the convicted
+        # artifact.  Fail them NOW, loudly, with their own terminal
+        # status — the fleet owns the verdict, so the fleet retires
+        # them.
+        for rec in list(self.requests.values()):
+            if (rec.adapter == adapter and not rec.done
+                    and rec.fid != self._terminal_fid):
+                self._finalize_unserved(rec, "adapter_quarantined")
+        logger.warning("fleet: adapter %r QUARANTINED fleet-wide "
+                       "(%s, flag rate %.3f)", adapter, reason, flag_rate)
+        if self.trace is not None:
+            self.trace.emit(EventType.ADAPTER_QUARANTINE, adapter=adapter,
+                            reason=reason,
+                            flag_rate=round(flag_rate, 4),
+                            tick=self.tick)
+
+    def release_adapter_quarantine(self, adapter: str) -> None:
+        """Operator-driven readmission of a quarantined adapter: clears
+        the fleet verdict AND the stale evidence window (re-conviction
+        must come from fresh behaviour), and lifts the refusal on every
+        live replica."""
+        self.quarantined_adapters.discard(adapter)
+        self._adapter_flags.pop(adapter, None)
+        for rep in self.replicas:
+            if rep.engine is not None and hasattr(rep.engine,
+                                                  "unquarantine_adapter"):
+                rep.engine.unquarantine_adapter(adapter)
+
+    def adapter_flag_rate(self, adapter: str) -> float:
+        win = self._adapter_flags.get(adapter)
+        return sum(win) / len(win) if win else 0.0
 
     def note_suspicion(self, replica: int, reason: str,
                        weight: float = 1.0) -> None:
@@ -2018,4 +2245,9 @@ class ServingFleet:
         if self.autoscaler is not None:
             out["replicas_in_service"] = len(self._in_service())
             out["replica_trace"] = list(self.replica_trace)
+        if self._adapter_flags or self.quarantined_adapters:
+            out["adapter_flag_rates"] = {
+                name: round(self.adapter_flag_rate(name), 4)
+                for name in sorted(self._adapter_flags)}
+            out["quarantined_adapters"] = sorted(self.quarantined_adapters)
         return out

@@ -42,6 +42,7 @@ from trustworthy_dl_tpu.obs.compilewatch import guarded
 from trustworthy_dl_tpu.models import generate as gen
 from trustworthy_dl_tpu.models import gpt2
 from trustworthy_dl_tpu.quant import int8 as q8
+from trustworthy_dl_tpu.serve.adapters import ZERO_PAGE, adapter_page_row
 from trustworthy_dl_tpu.serve.kv_slots import (
     BlockAllocator,
     PagedKV,
@@ -277,7 +278,10 @@ def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                       view: Any, tokens: jax.Array, table: jax.Array,
                       start: jax.Array, last_idx: jax.Array,
                       key: jax.Array, temp: jax.Array, greedy: jax.Array,
-                      attn_impl: str = "jnp"):
+                      attn_impl: str = "jnp",
+                      adapter_a: Any = None, adapter_b: Any = None,
+                      adapter_as: Any = None, adapter_bs: Any = None,
+                      apages: Any = None):
     """One CHUNK of a paged prefill: C prompt positions starting at
     ``start`` (block-aligned — a prefix-cache hit starts the suffix at a
     block boundary), attending to everything already in the slot's
@@ -285,10 +289,18 @@ def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     scattering its own K/V into the pool.  ``last_idx`` locates the
     prompt's last real position within this chunk; the sampled token is
     meaningful only on the final chunk (the host ignores it otherwise).
-    One compiled program serves every chunk of every prompt."""
+    One compiled program serves every chunk of every prompt.
+
+    The trailing adapter args are the paged adapter pool's device sides
+    plus the single-row page table ``apages`` i32[1] (serve/adapters.py)
+    — None on adapterless engines, where they contribute zero pytree
+    leaves and the trace is the pre-adapter one (bit-identity)."""
+    adapter = (None if adapter_a is None
+               else (adapter_a, adapter_b, adapter_as, adapter_bs, apages))
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[None, :], pool_k, pool_v, pool_ks, pool_vs,
         table, start, cfg, last_pos=last_idx, attn_impl=attn_impl,
+        adapter=adapter,
     )
     return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
                                                       greedy, attn_impl)
@@ -299,7 +311,10 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                        view: Any, tokens: jax.Array, tables: jax.Array,
                        lengths: jax.Array, keys: jax.Array,
                        temps: jax.Array, greedy: jax.Array,
-                       attn_impl: str = "jnp"):
+                       attn_impl: str = "jnp",
+                       adapter_a: Any = None, adapter_b: Any = None,
+                       adapter_as: Any = None, adapter_bs: Any = None,
+                       apages: Any = None):
     """THE fused paged decode step: one token for every slot, live or
     not.  ``tables`` i32[MAX_SLOTS, NBPS] are the per-slot block maps
     (inactive rows all-trash — their garbage writes land in block 0) and
@@ -307,10 +322,19 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     admission, retirement, block churn and prefix sharing never change
     the program.  The attention core is the same
     ``models/generate._block_with_cache`` the stripe engine and batch
-    generate run, over the gathered view — bit-identical streams."""
+    generate run, over the gathered view — bit-identical streams.
+
+    The trailing adapter args are the paged adapter pool's device sides
+    plus the per-slot page table ``apages`` i32[MAX_SLOTS]
+    (serve/adapters.py; ZERO_PAGE rows add an exact-zero delta).  All
+    traced values: adapter churn, eviction and tenant-mix changes never
+    change this program.  None (adapterless engine) contributes zero
+    pytree leaves — the compiled program IS the pre-adapter one."""
+    adapter = (None if adapter_a is None
+               else (adapter_a, adapter_b, adapter_as, adapter_bs, apages))
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
-        tables, lengths, cfg, attn_impl=attn_impl,
+        tables, lengths, cfg, attn_impl=attn_impl, adapter=adapter,
     )
     next_tok = _sample_tokens(logits, keys, temps, greedy)
     ent, margin = _logit_signals(logits, attn_impl)
@@ -461,6 +485,11 @@ class SlotTask:
     # transient audits: they may READ cached prefixes, but must leave
     # the cache exactly as they found it).
     publish_prefix: bool = True
+    # Adapter tier (serve/adapters.py): the tenant's adapter id (None =
+    # base model) and the pool page admit() claimed for it — ZERO_PAGE
+    # until admission, and again after retirement releases the claim.
+    adapter: Optional[str] = None
+    adapter_page: int = ZERO_PAGE
 
     @property
     def greedy(self) -> bool:
@@ -523,6 +552,10 @@ class ContinuousBatchingScheduler:
         # decode dispatch runs under its "serve_decode" guard, so a
         # post-warmup recompile storms at runtime, not just in pytest.
         self.compilewatch: Any = None
+        # The stripe pool has no adapter tier (validate_adapters pins
+        # adapter_rank > 0 to paged=True); the engine reads this
+        # uniformly across both scheduler classes.
+        self.adapters: Any = None
 
     def attribution_info(self, task: SlotTask) -> Dict[str, Any]:
         """What the attribution ledger records about THIS task's
@@ -530,7 +563,9 @@ class ContinuousBatchingScheduler:
         slot id is the whole story."""
         return {"layout": "stripe", "slot": int(task.slot),
                 "block_ids": [], "prefix_block_ids": [],
-                "prefix_publishers": {}}
+                "prefix_publishers": {},
+                "adapter": task.adapter,
+                "adapter_page": int(task.adapter_page)}
 
     # -- admission ---------------------------------------------------------
 
@@ -726,7 +761,8 @@ class PagedBatchingScheduler:
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = None,
                  spec_k: int = 0, draft_view: Any = None,
-                 attn_impl: str = "auto"):
+                 attn_impl: str = "auto",
+                 adapters: Any = None):
         q8.validate_dtypes(kv_dtype, weight_dtype)
         validate_paged_geometry(max_seq, block_size, num_blocks,
                                 prefill_chunk)
@@ -806,6 +842,15 @@ class PagedBatchingScheduler:
         # Optional obs.compilewatch.CompileWatcher (engine) — the fused
         # paged decode dispatch runs under its "serve_decode" guard.
         self.compilewatch: Any = None
+        # Optional serve.adapters.AdapterPool (engine-built, HBM-gated):
+        # the second paged resource.  admit() claims a page per
+        # adapter-carrying request with the SAME backpressure-and-unwind
+        # semantics as KV blocks; every decode tick threads the pool
+        # sides plus the per-slot page row into the fused programs as
+        # traced values.  None = adapterless engine: the device programs
+        # are called without adapter args and trace bit-identically to
+        # the pre-adapter ones.
+        self.adapters: Any = adapters
         # slot -> block ids the slot's request PUBLISHED to the prefix
         # cache (newly cached at its prefill completion) — what a
         # quarantine-retire must purge from the cache.
@@ -876,7 +921,9 @@ class PagedBatchingScheduler:
         if info is None or self.tasks.get(task.slot) is not task:
             return {"layout": "paged", "slot": int(task.slot),
                     "block_ids": [], "prefix_block_ids": [],
-                    "prefix_publishers": {}}
+                    "prefix_publishers": {},
+                    "adapter": task.adapter,
+                    "adapter_page": int(task.adapter_page)}
         return {**info, "prefix_publishers": dict(info["prefix_publishers"]),
                 "block_ids": list(info["block_ids"]),
                 "prefix_block_ids": list(info["prefix_block_ids"])}
@@ -924,6 +971,19 @@ class PagedBatchingScheduler:
                 self.blocks.release(b)
             self.allocator.free(slot)
             return False
+        if task.adapter is not None and self.adapters is not None:
+            # Second paged resource: claim the tenant's adapter page with
+            # the SAME backpressure-and-unwind semantics as the KV blocks
+            # above — a full pool (every resident page live) or a
+            # quarantined adapter refuses admission and the task stays
+            # queued, untouched.
+            page = self.adapters.acquire(task.adapter)
+            if page is None:
+                for b in shared + fresh:
+                    self.blocks.release(b)
+                self.allocator.free(slot)
+                return False
+            task.adapter_page = page
         if shared:
             self.prefix_hits += 1
             self.prefix_tokens_reused += len(shared) * self.block_size
@@ -937,6 +997,8 @@ class PagedBatchingScheduler:
             "prefix_block_ids": list(shared),
             "prefix_publishers": (self.prefix.publishers(shared)
                                   if self.prefix is not None else {}),
+            "adapter": task.adapter,
+            "adapter_page": int(task.adapter_page),
         }
         self._prefill[slot] = _PrefillProgress(
             task=task, pos=len(shared) * self.block_size, plen=p,
@@ -966,10 +1028,13 @@ class PagedBatchingScheduler:
         chunk[:n_real] = task.prompt[st.pos:st.pos + n_real]
         final = st.pos + n_real >= st.plen
         kv = self.kv
-        if st.pos == 0 and st.plen <= c:
+        if st.pos == 0 and st.plen <= c and task.adapter_page == ZERO_PAGE:
             # Whole prompt in one chunk, nothing shared: full-precision
             # local prefill (stripe-engine numerics, bit-for-bit — the
-            # int8 tier quantizes once at the block write).
+            # int8 tier quantizes once at the block write).  An
+            # adapter-carrying request takes the chunk path below
+            # instead: its prompt must run through the adapter-delta'd
+            # layers, and there is no stripe twin to hold parity with.
             ids = np.full(c // self.block_size, TRASH_BLOCK, np.int32)
             n_ids = min(len(self.tables[slot]), len(ids))
             ids[:n_ids] = self.tables[slot][:n_ids]
@@ -985,6 +1050,14 @@ class PagedBatchingScheduler:
             )
         else:
             last_idx = int(np.clip(st.plen - 1 - st.pos, 0, c - 1))
+            extra: Dict[str, Any] = {}
+            if self.adapters is not None:
+                a, b, a_s, b_s = self.adapters.device_args()
+                extra = dict(
+                    adapter_a=a, adapter_b=b, adapter_as=a_s,
+                    adapter_bs=b_s,
+                    apages=jnp.asarray([task.adapter_page], jnp.int32),
+                )
             new_k, new_v, new_ks, new_vs, packed = _programs()[
                 "paged_chunk"](
                 self.cfg, kv.k, kv.v, kv.k_scale, kv.v_scale, self.view,
@@ -995,6 +1068,7 @@ class PagedBatchingScheduler:
                 jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
                 jnp.asarray(task.greedy),
                 attn_impl=self.attn_impl,
+                **extra,
             )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         if self.spans is not None:
@@ -1066,6 +1140,18 @@ class PagedBatchingScheduler:
             greedy[slot] = task.greedy
             tables[slot] = self._table_row(slot)
         kv = self.kv
+        extra: Dict[str, Any] = {}
+        if self.adapters is not None:
+            # The adapter pool rides every tick: pool sides as traced
+            # arrays, per-slot pages as ONE traced i32[MAX_SLOTS] row
+            # (inactive and adapterless slots at ZERO_PAGE — an exact
+            # zero delta).  Residency churn changes buffer VALUES only;
+            # the program under the compile-once guard never changes.
+            a, b, a_s, b_s = self.adapters.device_args()
+            row = adapter_page_row(
+                {s: t.adapter_page for s, t in active.items()}, ms)
+            extra = dict(adapter_a=a, adapter_b=b, adapter_as=a_s,
+                         adapter_bs=b_s, apages=jnp.asarray(row))
         with guarded(self.compilewatch, "serve_decode"):
             packed, new_k, new_v, new_ks, new_vs = \
                 _programs()["paged_decode"](
@@ -1076,6 +1162,7 @@ class PagedBatchingScheduler:
                     jnp.asarray(keys), jnp.asarray(temps),
                     jnp.asarray(greedy),
                     attn_impl=self.attn_impl,
+                    **extra,
                 )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
         # tddl-lint: disable=host-sync — the tick's single intentional pull
@@ -1250,6 +1337,16 @@ class PagedBatchingScheduler:
         del self.tasks[slot]
         self._prefill.pop(slot, None)
         self._attrib.pop(slot, None)
+        if task.adapter is not None and self.adapters is not None:
+            # Drop the request's residency claim on its adapter page.
+            # The pool's OWN ref keeps the page resident (warm for the
+            # tenant's next request) unless the adapter was quarantined
+            # mid-flight — then this last release impounds it.  Replica
+            # ``quarantine`` does NOT quarantine the adapter: adapter
+            # trust is a fleet-level verdict (serve/fleet.py), scoped to
+            # the adapter across replicas, not to this replica's pool.
+            self.adapters.release(task.adapter)
+            task.adapter_page = ZERO_PAGE
         # Outstanding speculative claims MUST unwind before the table
         # release: a leftover claim would make the quarantine release
         # below see the block as "shared" and FREE it on the claim's

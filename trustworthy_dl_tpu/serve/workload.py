@@ -70,6 +70,16 @@ class WorkloadConfig:
     #: operator's stated per-request budget.
     max_output: Optional[int] = None
     tenants: Sequence[Tenant] = DEFAULT_TENANTS
+    #: Per-tenant adapter fleet (0 = base model only): tenants are
+    #: assigned to ``adapter-<k>`` ids Zipf-style — a few hot adapters
+    #: serve most tenants, a long tail serves one each.  This is the
+    #: population shape that makes an adapter POOL interesting: pool
+    #: pages << adapters forces real eviction traffic, while the hot
+    #: head keeps the hit rate meaningful.  Assignment is part of the
+    #: seeded workload contract (same config -> same tenant->adapter
+    #: map), so A/B bench arms replay identical adapter churn.
+    num_adapters: int = 0
+    adapter_zipf: float = 1.1       # Zipf exponent over adapter ranks
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.burstiness < 1.0:
@@ -78,6 +88,10 @@ class WorkloadConfig:
             raise ValueError("mean_rps and burst_period_s must be > 0")
         if not self.tenants:
             raise ValueError("need at least one tenant")
+        if self.num_adapters < 0:
+            raise ValueError("num_adapters must be >= 0")
+        if self.adapter_zipf <= 1.0:
+            raise ValueError("adapter_zipf must be > 1 (Zipf exponent)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,12 +105,48 @@ class WorkloadItem:
     priority: int
     tenant: str
     deadline_s: Optional[float]
+    adapter: Optional[str] = None  # tenant's assigned adapter (None = base)
 
 
 def _lognormal_len(rng: np.random.Generator, median: int, sigma: float,
                    lo: int, hi: int) -> int:
     val = int(round(float(rng.lognormal(math.log(max(median, 1)), sigma))))
     return int(np.clip(val, lo, hi))
+
+
+def zipf_adapter_assignments(tenant_names: Sequence[str],
+                             num_adapters: int,
+                             exponent: float = 1.1,
+                             seed: int = 0) -> dict:
+    """Seeded Zipf tenant -> adapter map: adapter ``adapter-<k>`` gets
+    probability ``∝ 1/(k+1)^exponent``, so a hot head of adapters serves
+    most tenants while the tail serves one each — the population shape
+    that exercises an adapter pool's LRU (pages << adapters) without
+    killing its hit rate.  The draw stream is its OWN generator (seeded
+    off ``seed``), so adding adapters to a workload config never
+    perturbs the arrival/length draws of the base traffic — the
+    adapter-off and adapter-on bench arms replay IDENTICAL request
+    schedules.  This is the one spelling of the assignment; the engine's
+    ``adapter_map`` kwarg consumes it verbatim."""
+    if num_adapters < 1:
+        return {}
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0xADA]))
+    ranks = np.arange(1, num_adapters + 1, dtype=np.float64)
+    probs = ranks ** -float(exponent)
+    probs /= probs.sum()
+    return {name: f"adapter-{int(rng.choice(num_adapters, p=probs))}"
+            for name in tenant_names}
+
+
+def make_tenant_population(n: int, base: str = "tenant",
+                           zipf: float = 1.2) -> Tuple[Tenant, ...]:
+    """``n`` tenants with Zipf arrival weights (rank-1 heaviest) — the
+    many-tenant population the adapter-pool bench arms drive, where
+    DEFAULT_TENANTS' three classes are too few to churn a pool."""
+    if n < 1:
+        raise ValueError("need n >= 1 tenants")
+    return tuple(Tenant(f"{base}-{k}", weight=float((k + 1) ** -zipf))
+                 for k in range(n))
 
 
 def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
@@ -112,6 +162,9 @@ def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
     rng = np.random.default_rng(cfg.seed)
     weights = np.asarray([t.weight for t in cfg.tenants], np.float64)
     weights = weights / weights.sum()
+    adapter_of = zipf_adapter_assignments(
+        [t.name for t in cfg.tenants], cfg.num_adapters,
+        exponent=cfg.adapter_zipf, seed=cfg.seed)
     items: List[WorkloadItem] = []
     t = 0.0
     for _ in range(cfg.num_requests):
@@ -137,6 +190,7 @@ def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
             priority=tenant.priority,
             tenant=tenant.name,
             deadline_s=tenant.deadline_s,
+            adapter=adapter_of.get(tenant.name),
         ))
     return items
 
