@@ -43,6 +43,11 @@ async_host_depth 0 vs default, tokens/sec + obs phase shares),
 TDDL_BENCH_QUANT=1 (int8 KV quantization A/B: model-dtype vs int8 KV
 pool at EQUAL HBM budget — slots, KV bytes and tokens/s per arm;
 TDDL_BENCH_QUANT_W8=1 adds weight-only int8 to the quantized arm),
+TDDL_BENCH_MIGRATE=1 (live KV-migration A/B: capacity loss as block
+copy vs prompt replay + unified vs disaggregated prefill/decode pools
+under a bimodal prompt mix, "migrate" record key whose
+migration_fraction feeds the sentinel fingerprint,
+TDDL_BENCH_MIGRATE_* knobs),
 TDDL_BENCH_FLEET=1 (serving-fleet goodput-under-SLO vs offered load,
 chaos OFF vs ON over identical seeded workloads — "fleet" record key,
 TDDL_BENCH_FLEET_* knobs), TDDL_BENCH_ADVERSARY=1 (goodput under an
@@ -302,6 +307,11 @@ def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
         adapter_hit_rate=(record.get("adapters") or {}).get("hit_rate"),
         adapter_tokens_ratio=(record.get("adapters")
                               or {}).get("tokens_per_s_ratio"),
+        # Live-migration success under capacity loss (TDDL_BENCH_MIGRATE
+        # rounds): higher-is-better — a silent fall-back to prompt
+        # replay (geometry drift, claim refusals) drops it.
+        migration_fraction=(record.get("migrate")
+                            or {}).get("migration_fraction"),
         run_metadata=record.get("run_metadata"),
         extra={"vs_baseline": record.get("vs_baseline")},
     )
@@ -1260,6 +1270,150 @@ def bench_fleet() -> "dict":
         "max_slots_per_replica": max_slots,
         "requests_per_arm": n_requests,
         "arms": arms,
+    }
+
+
+def bench_migrate() -> "dict":
+    """Live KV-migration A/B (TDDL_BENCH_MIGRATE=1): what a capacity
+    loss costs when in-flight work moves as a block copy vs replaying
+    from the prompt, plus what disaggregated prefill/decode pools buy
+    under a bimodal prompt mix.  Two pairs of arms, each pair on
+    IDENTICAL seeded traffic:
+
+    * **drain** — a scripted mid-run REPLICA_PREEMPT: the ``runout``
+      arm pins ``FleetConfig(live_migration=False)`` (the preempted
+      replica's accepted requests replay from scratch elsewhere — the
+      pre-PR arc), the ``migration`` arm leaves the default on (each
+      loss is a block-table copy).  The gap is recomputed tokens.
+    * **disagg** — a bimodal prompt workload (short chat head + a long
+      RAG tail): ``unified`` (pool_roles=None) vs ``disaggregated``
+      (one prefill specialist, the rest decode — requests hand off at
+      first decode token).
+
+    The migration arm's ``migration_fraction`` (migrations over
+    migrations + replay failovers) joins the sentinel fingerprint: a
+    structural regression that quietly degrades losses back to replay
+    bands before goodput noise shows it.
+
+    Env: TDDL_BENCH_MIGRATE_MODEL (gpt2), TDDL_BENCH_MIGRATE_REPLICAS
+    (3), TDDL_BENCH_MIGRATE_SLOTS (4), TDDL_BENCH_MIGRATE_SEQ (256),
+    TDDL_BENCH_MIGRATE_REQUESTS (24), TDDL_BENCH_MIGRATE_RATE (16),
+    TDDL_BENCH_MIGRATE_SEED (0), TDDL_BENCH_MIGRATE_BIMODAL (0.25),
+    TDDL_BENCH_MIGRATE_LONG_MEDIAN (seq/4)."""
+    import jax
+
+    from trustworthy_dl_tpu.chaos import FaultEvent, FaultInjector, \
+        FaultKind, FaultPlan
+    from trustworthy_dl_tpu.models import gpt2
+    from trustworthy_dl_tpu.serve import (
+        FleetConfig,
+        ServeRequest,
+        ServingFleet,
+        WorkloadConfig,
+        generate_workload,
+    )
+    from trustworthy_dl_tpu.serve.workload import replay_workload
+
+    cfg = gpt2.GPT2Config.from_name(
+        os.environ.get("TDDL_BENCH_MIGRATE_MODEL", "gpt2")
+    )
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    replicas = int(os.environ.get("TDDL_BENCH_MIGRATE_REPLICAS", "3"))
+    max_slots = int(os.environ.get("TDDL_BENCH_MIGRATE_SLOTS", "4"))
+    max_seq = int(os.environ.get("TDDL_BENCH_MIGRATE_SEQ", "256"))
+    n_requests = int(os.environ.get("TDDL_BENCH_MIGRATE_REQUESTS", "24"))
+    rate = float(os.environ.get("TDDL_BENCH_MIGRATE_RATE", "16"))
+    seed = int(os.environ.get("TDDL_BENCH_MIGRATE_SEED", "0"))
+    bimodal = float(os.environ.get("TDDL_BENCH_MIGRATE_BIMODAL", "0.25"))
+    long_median = int(os.environ.get("TDDL_BENCH_MIGRATE_LONG_MEDIAN",
+                                     str(max(max_seq // 4, 16))))
+
+    def run_arm(workload, fleet_cfg, chaos):
+        fleet = ServingFleet(
+            params, cfg, fleet_config=fleet_cfg, chaos=chaos,
+            rng=jax.random.PRNGKey(1), max_slots=max_slots,
+            max_seq=max_seq, queue_limit=n_requests,
+        )
+        t0 = time.perf_counter()
+        replay_workload(fleet, workload, lambda item: ServeRequest(
+            prompt=list(item.prompt),
+            max_new_tokens=item.max_new_tokens,
+            temperature=0.8, priority=item.priority,
+            deadline_s=item.deadline_s, tenant=item.tenant,
+        ))
+        wall = time.perf_counter() - t0
+        summary = fleet.metrics_summary()
+        statuses = summary["statuses"]
+        good_tokens = summary["completed_tokens"]
+        return {
+            "goodput_tokens_per_s": round(good_tokens / wall, 1)
+            if wall > 0 else 0.0,
+            "completed": statuses.get("completed", 0),
+            "deadline_exceeded": statuses.get("deadline_exceeded", 0),
+            "migrations": fleet.counters["migrations"],
+            "preempts": fleet.counters["preempts"],
+            "failovers": summary["fleet_failovers"],
+            "wall_s": round(wall, 2),
+        }
+
+    # -- drain pair: preempt mid-run, runout vs migration --------------
+    drain_workload = generate_workload(
+        WorkloadConfig(seed=seed, num_requests=n_requests, mean_rps=rate),
+        cfg.vocab_size, max_seq,
+    )
+
+    def preempt_plan() -> FaultInjector:
+        return FaultInjector(FaultPlan.scripted([
+            FaultEvent(step=6, kind=FaultKind.REPLICA_PREEMPT, target=0),
+        ], seed=seed))
+
+    drain = {}
+    for arm, live in (("runout", False), ("migration", True)):
+        drain[arm] = run_arm(
+            drain_workload,
+            FleetConfig(num_replicas=replicas, max_retries=6,
+                        live_migration=live),
+            preempt_plan(),
+        )
+        log(f"migrate drain {arm:9s}: goodput "
+            f"{drain[arm]['goodput_tokens_per_s']:8.1f} tok/s, "
+            f"migrations {drain[arm]['migrations']}, "
+            f"failovers {drain[arm]['failovers']}")
+
+    # -- disagg pair: bimodal prompts, unified vs split pools ----------
+    disagg_workload = generate_workload(
+        WorkloadConfig(seed=seed, num_requests=n_requests, mean_rps=rate,
+                       prompt_bimodal_frac=bimodal,
+                       prompt_long_median=long_median),
+        cfg.vocab_size, max_seq,
+    )
+    roles = ("prefill",) + ("decode",) * (replicas - 1)
+    disagg = {}
+    for arm, pool_roles in (("unified", None), ("disaggregated", roles)):
+        disagg[arm] = run_arm(
+            disagg_workload,
+            FleetConfig(num_replicas=replicas, max_retries=6,
+                        pool_roles=pool_roles),
+            None,
+        )
+        log(f"migrate disagg {arm:13s}: goodput "
+            f"{disagg[arm]['goodput_tokens_per_s']:8.1f} tok/s, "
+            f"migrations {disagg[arm]['migrations']}")
+
+    mig = drain["migration"]
+    frac = (mig["migrations"]
+            / max(mig["migrations"] + mig["failovers"], 1))
+    return {
+        "replicas": replicas,
+        "max_slots_per_replica": max_slots,
+        "requests_per_arm": n_requests,
+        "bimodal_frac": bimodal,
+        "prompt_long_median": long_median,
+        "drain": drain,
+        "disagg": disagg,
+        # The headline the sentinel fingerprint lifts: the share of
+        # capacity-loss recoveries that were block copies, not replays.
+        "migration_fraction": round(frac, 3),
     }
 
 
@@ -2317,6 +2471,9 @@ def _inner_main() -> None:
     fleet_record = None
     if os.environ.get("TDDL_BENCH_FLEET") == "1":
         fleet_record = bench_fleet()
+    migrate_record = None
+    if os.environ.get("TDDL_BENCH_MIGRATE") == "1":
+        migrate_record = bench_migrate()
     adversary_record = None
     if os.environ.get("TDDL_BENCH_ADVERSARY") == "1":
         adversary_record = bench_adversary()
@@ -2368,6 +2525,11 @@ def _inner_main() -> None:
         # rate and the equal-HBM tokens/s ratio, so pool-locality and
         # personalisation-cost regressions band (and page) like perf.
         record["adapters"] = adapters_record
+    if migrate_record is not None:
+        # Same contract: the fingerprint lifts migration_fraction, so a
+        # structural break that degrades capacity losses back to prompt
+        # replay bands (and pages) like a perf regression.
+        record["migrate"] = migrate_record
     _attach_perf_sections(record, compiles=compiles, hbm=hbm_monitor)
     if serve_records is not None:
         record["serve"] = serve_records

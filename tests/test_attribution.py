@@ -288,3 +288,69 @@ def test_verify_attribution_flags_double_retire():
                                       {"0:0": alloc})
     assert not ok
     assert any("double retire" in p for p in problems)
+
+
+@_pytest.mark.fleet
+def test_verify_attribution_migrated_record_spans_both_journals():
+    """A live-migrated request's destination attempt carries
+    ``migrated_from`` — the SOURCE replica's journal key plus the
+    physical blocks the stream decoded from before the hand-off.
+    Verification reconciles that provenance against the source journal
+    WITHOUT flagging the post-commit release (or a quarantine impound)
+    as an over-release — but stays loud about fabricated provenance."""
+    alloc_src, alloc_dst = BlockAllocator(8), BlockAllocator(8)
+    src_blocks = alloc_src.alloc(3)
+    dst_blocks = alloc_dst.alloc(3)
+    for b in src_blocks:        # released AFTER the destination commit
+        alloc_src.release(b)
+    rec = _fleet_record(0, [
+        {"replica": 1, "journal": "1:0", "layout": "paged", "slot": 0,
+         "block_ids": list(dst_blocks), "prefix_block_ids": [],
+         "migrated_from": {"replica": 0, "journal": "0:0",
+                           "block_ids": list(src_blocks)}},
+    ])
+    ok, problems = verify_attribution(
+        [rec], {"0:0": alloc_src, "1:0": alloc_dst})
+    assert ok, problems
+
+    # Quarantined source: the blocks were IMPOUNDED, not freed — still
+    # a clean hand-off from the ledger's point of view.
+    alloc_q = BlockAllocator(8)
+    q_blocks = alloc_q.alloc(2)
+    for b in q_blocks:
+        assert alloc_q.release(b, quarantine=True) == "quarantined"
+    rec_q = _fleet_record(1, [
+        {"replica": 1, "journal": "1:0", "layout": "paged", "slot": 1,
+         "block_ids": list(alloc_dst.alloc(1)), "prefix_block_ids": [],
+         "migrated_from": {"replica": 2, "journal": "2:0",
+                           "block_ids": list(q_blocks)}},
+    ])
+    ok, problems = verify_attribution(
+        [rec_q], {"1:0": alloc_dst, "2:0": alloc_q})
+    assert ok, problems
+
+    # Fabricated provenance is loud, not skipped: a source journal the
+    # fleet never had...
+    rec_ghost = _fleet_record(2, [
+        {"replica": 1, "journal": "1:0", "layout": "paged", "slot": 2,
+         "block_ids": list(alloc_dst.alloc(1)), "prefix_block_ids": [],
+         "migrated_from": {"replica": 9, "journal": "9:0",
+                           "block_ids": [1]}},
+    ])
+    ok, problems = verify_attribution(
+        [rec_ghost], {"1:0": alloc_dst})
+    assert not ok
+    assert any("no lifecycle journal" in p for p in problems)
+
+    # ...and source blocks that journal never allocated.
+    alloc_empty = BlockAllocator(8)
+    rec_bogus = _fleet_record(3, [
+        {"replica": 1, "journal": "1:0", "layout": "paged", "slot": 3,
+         "block_ids": list(alloc_dst.alloc(1)), "prefix_block_ids": [],
+         "migrated_from": {"replica": 0, "journal": "0:1",
+                           "block_ids": [3]}},
+    ])
+    ok, problems = verify_attribution(
+        [rec_bogus], {"1:0": alloc_dst, "0:1": alloc_empty})
+    assert not ok
+    assert any("never allocated" in p for p in problems)

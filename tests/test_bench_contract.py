@@ -493,6 +493,58 @@ def test_bench_fleet_records(monkeypatch, tmp_path):
     assert chaos_row["failovers"] + chaos_row["drains"] >= 1
 
 
+@pytest.mark.migrate
+def test_bench_migrate_records(monkeypatch, tmp_path):
+    """bench_migrate's two A/B pairs on a tiny model: drain-by-runout
+    vs drain-by-migration under an identical scripted REPLICA_PREEMPT,
+    and unified vs disaggregated pools under the same bimodal prompt
+    workload.  The migration arm's recoveries are block copies (the
+    runout arm's are replays — live_migration=False pins the pre-PR
+    arc), and the record's top-level migration_fraction is what the
+    sentinel fingerprint lifts."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_REPLICAS", "3")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_SLOTS", "2")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_SEQ", "48")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_REQUESTS", "6")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_RATE", "100")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_BIMODAL", "0.5")
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_LONG_MEDIAN", "16")
+    record = bench.bench_migrate()
+    assert record["replicas"] == 3
+    assert record["bimodal_frac"] == 0.5
+    assert set(record["drain"]) == {"runout", "migration"}
+    assert set(record["disagg"]) == {"unified", "disaggregated"}
+    row_keys = {"goodput_tokens_per_s", "completed", "deadline_exceeded",
+                "migrations", "preempts", "failovers", "wall_s"}
+    for pair in (record["drain"], record["disagg"]):
+        for arm, row in pair.items():
+            assert row_keys <= set(row), (arm, row)
+            assert row["completed"] + row["deadline_exceeded"] == 6, \
+                (arm, row)
+    # Both drain arms really lost the replica; they differ only in HOW
+    # the in-flight work came back.
+    assert record["drain"]["runout"]["preempts"] == 1
+    assert record["drain"]["migration"]["preempts"] == 1
+    assert record["drain"]["runout"]["migrations"] == 0
+    assert record["drain"]["migration"]["migrations"] >= 1
+    assert record["drain"]["migration"]["failovers"] == 0
+    # The disaggregated arm hands every served request off once.
+    assert record["disagg"]["unified"]["migrations"] == 0
+    assert record["disagg"]["disaggregated"]["migrations"] \
+        >= record["disagg"]["disaggregated"]["completed"]
+    assert record["migration_fraction"] == 1.0
+
+
 @pytest.mark.fleetctl
 def test_bench_autoscale_records(monkeypatch, tmp_path):
     """bench_autoscale's static-vs-autoscaled A/B on a tiny model:

@@ -388,6 +388,7 @@ def test_predict_fleet_counts_and_generate_targets():
         "scale_ups": 0, "scale_downs": 0,
         "adapter_poisons": 0, "adapter_quarantines": 0,
         "adapter_throttles": 0,
+        "preempts": 0,
     }
     # Seeded generation draws replica targets for fleet kinds...
     gen_plan = FaultPlan.generate(7, 50, {FaultKind.REPLICA_CRASH: 0.1},

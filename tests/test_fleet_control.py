@@ -671,6 +671,47 @@ def test_rejected_submission_refunds_the_tenant_bucket():
                                      tenant="acme")) is not None
 
 
+def test_unserved_death_refunds_the_tenant_bucket_once():
+    """Drain→resubmit reconciliation: the bucket spend lands ONCE at
+    submit() and rides through every migrate/resubmit hop un-recharged,
+    so a request that dies UNSERVED (retry budget exhausted after a
+    crash) must hand that one spend back — exactly once, and never for
+    work that actually served."""
+    plan = FaultPlan.scripted([
+        FaultEvent(step=1, kind=FaultKind.REPLICA_CRASH, target=0),
+    ])
+    fleet, fakes = ctl_fleet(
+        num_replicas=1, chaos=FaultInjector(plan), max_retries=0,
+        tenant_quota=TenantQuotaConfig(capacity_tokens=20.0,
+                                       refill_per_tick=0.0))
+    fid = fleet.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                    tenant="acme"))
+    assert fid is not None
+    assert fleet._buckets.level("acme", fleet.tick) == 20.0 - 4
+    for _ in range(4):
+        fleet.step()
+    assert fleet.results[fid].status == "failover_exhausted"
+    # The unserved death refunded the submit-time spend — once: extra
+    # ticks over the done record never refund again (zero refill, so
+    # any drift above capacity-minus-spends would be a double refund).
+    assert fleet._buckets.level("acme", fleet.tick) == 20.0
+    for _ in range(3):
+        fleet.step()
+    assert fleet._buckets.level("acme", fleet.tick) == 20.0
+    # A request that SERVES keeps its spend spent.
+    fleet2, fakes2 = ctl_fleet(
+        num_replicas=1,
+        tenant_quota=TenantQuotaConfig(capacity_tokens=20.0,
+                                       refill_per_tick=0.0))
+    fid2 = fleet2.submit(ServeRequest(prompt=[1, 2], max_new_tokens=2,
+                                      tenant="acme"))
+    for _ in range(3):
+        complete_all(fakes2)
+        fleet2.step()
+    assert fleet2.results[fid2].status == "completed"
+    assert fleet2._buckets.level("acme", fleet2.tick) == 20.0 - 4
+
+
 def test_dispatch_failure_requeues_the_whole_remaining_batch():
     """Review regression: when an engine refuses a submission mid-
     dispatch-batch, EVERY not-yet-placed entry returns to its class

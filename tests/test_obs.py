@@ -966,6 +966,36 @@ def test_paged_attn_surface_inside_the_lint_perimeter():
     assert SENTINEL_METRICS["decode_tick_fraction"] == "lower"
 
 
+def test_migration_surface_inside_the_lint_perimeter():
+    """Live-migration extension: the kv_migration / pool_rebalance
+    event types carry full schemas — the emit lint + validate_event
+    cover them like every other type — the migration counter and pool
+    gauge are literal ``tddl_`` names the metric-name lint scans, and
+    their ``reason`` / ``role`` labels are in the dashboard vocabulary
+    (contracts.KNOWN_METRIC_LABELS) deliberately, not by accident."""
+    from trustworthy_dl_tpu.analysis.contracts import KNOWN_METRIC_LABELS
+    from trustworthy_dl_tpu.obs.sentinel import SENTINEL_METRICS
+
+    assert EVENT_SCHEMAS[EventType.KV_MIGRATION]["requires"] == \
+        ("request_id",)
+    assert EVENT_SCHEMAS[EventType.KV_MIGRATION]["fields"] == \
+        ("from_replica", "to_replica", "blocks", "reason")
+    assert EVENT_SCHEMAS[EventType.POOL_REBALANCE]["requires"] == ()
+    assert EVENT_SCHEMAS[EventType.POOL_REBALANCE]["fields"] == \
+        ("role", "replicas", "moved")
+    src = (REPO / "trustworthy_dl_tpu" / "serve" / "fleet.py").read_text()
+    for name in ("tddl_fleet_migrations_total",
+                 "tddl_fleet_pool_replicas"):
+        assert f'"{name}"' in src, name
+    assert 'labels=("reason",)' in src
+    assert 'labels=("role",)' in src
+    assert "reason" in KNOWN_METRIC_LABELS
+    assert "role" in KNOWN_METRIC_LABELS
+    # The bench's migrated-vs-replayed fraction joins the perf
+    # fingerprint: losing migrations back to replays is a regression.
+    assert SENTINEL_METRICS["migration_fraction"] == "higher"
+
+
 def test_every_registered_metric_name_carries_the_tddl_prefix():
     """CONTRACT: every literal metric name registered on a registry
     (counter/gauge/histogram, plus serve/engine.py's ``_metric``

@@ -136,9 +136,9 @@ PREDICT_FUNCTION_PATTERNS = (
 #: added HERE (and to the dashboards) deliberately, not slipped in.
 KNOWN_METRIC_LABELS = frozenset({
     "action", "adapter", "device", "direction", "dtype", "kind", "metric",
-    "node", "outcome", "path", "phase", "replica", "scope", "signal",
-    "slo", "slo_class", "stage", "state", "status", "tenant", "to_state",
-    "type",
+    "node", "outcome", "path", "phase", "reason", "replica", "role",
+    "scope", "signal", "slo", "slo_class", "stage", "state", "status",
+    "tenant", "to_state", "type",
 })
 
 #: Metric-name prefix every registered literal must carry (the

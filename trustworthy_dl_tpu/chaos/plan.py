@@ -111,6 +111,17 @@ class FaultKind(str, enum.Enum):
     #: quarantines).  Persists until
     #: :meth:`FaultInjector.heal_adapter`.
     ADAPTER_POISON = "adapter_poison"
+    #: Preempt replica ``target`` at tick ``step`` — the serving twin of
+    #: the training-side ``PREEMPT``: the capacity is GOING AWAY (spot
+    #: reclaim, eviction) but the fleet gets one tick of warning, so
+    #: every in-flight request it holds must MIGRATE (live KV
+    #: block-table copy, ``serve/migrate.py``) to a surviving replica
+    #: instead of replaying from scratch; queued work re-queues.  The
+    #: replica then restarts like a crash (``restart_ticks`` warmup) but
+    #: with zero lost decode work and zero failover episodes.
+    #: Declared LAST so generated plans' seeded draw streams for the
+    #: older kinds are unchanged (``generate`` iterates in enum order).
+    REPLICA_PREEMPT = "replica_preempt"
 
 
 #: The serving-fleet kinds (consumed by ``FaultInjector.on_fleet_tick``
@@ -118,7 +129,8 @@ class FaultKind(str, enum.Enum):
 FLEET_KINDS = (FaultKind.REPLICA_CRASH, FaultKind.REPLICA_STALL,
                FaultKind.REPLICA_POISON, FaultKind.REPLICA_SLOWSTART,
                FaultKind.REPLICA_ADAPTIVE_POISON,
-               FaultKind.TENANT_FLOOD, FaultKind.ADAPTER_POISON)
+               FaultKind.TENANT_FLOOD, FaultKind.ADAPTER_POISON,
+               FaultKind.REPLICA_PREEMPT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,7 +250,8 @@ class FaultPlan:
                       cooloff_ticks: Optional[int] = None,
                       autoscale: bool = False,
                       quota_tokens: Optional[float] = None,
-                      flood_request_tokens: Optional[int] = None
+                      flood_request_tokens: Optional[int] = None,
+                      preempt_inflight: Optional[int] = None
                       ) -> Dict[str, int]:
         """Expected ``ServingFleet`` recovery counts for this plan's
         REPLICA_* events (the serving mirror of :meth:`predict`).
@@ -308,6 +321,16 @@ class FaultPlan:
           the artifact.  Valid when at least ``flag_min_count``
           adapter-attributed requests retire after the event and the
           adapter is not released before the drill ends.
+        * REPLICA_PREEMPT → 1 preempt + 1 restart, and with
+          ``preempt_inflight`` (the number of LIVE in-flight requests
+          each preempted replica holds when its event fires) exactly
+          ``preempt_inflight`` live KV migrations per event — the
+          ``migrations`` key is emitted ONLY when the caller pins that
+          number, since it is traffic-determined.  Valid when every
+          migration finds a destination (surviving admitting capacity
+          with pool headroom for every block table) — then the arc is
+          a block copy, not a recovery: zero failover episodes, zero
+          drains, zero lost accepted requests.
         """
         if vote_k == 1:
             raise ValueError(
@@ -316,6 +339,7 @@ class FaultPlan:
                 "vote_k >= 2 for verdict quarantines or 0 for off"
             )
         crashes = self.count(FaultKind.REPLICA_CRASH)
+        preempts = self.count(FaultKind.REPLICA_PREEMPT)
         stalls = self.count(FaultKind.REPLICA_STALL)
         poisons = self.count(FaultKind.REPLICA_POISON)
         adaptive = self.count(FaultKind.REPLICA_ADAPTIVE_POISON)
@@ -357,9 +381,10 @@ class FaultPlan:
                 n = max(int(event.severity), 1)
                 throttles += max(0, n - per_event)
         scale_events = len(floods) if autoscale else 0
-        return {
+        counts = {
             "crashes": crashes,
-            "restarts": crashes,
+            "preempts": preempts,
+            "restarts": crashes + preempts,
             "stalls": stalls,
             "poisons": poisons,
             "adaptive_poisons": adaptive,
@@ -378,3 +403,6 @@ class FaultPlan:
             "adapter_quarantines": self.count(FaultKind.ADAPTER_POISON),
             "adapter_throttles": 0,
         }
+        if preempt_inflight is not None:
+            counts["migrations"] = preempts * int(preempt_inflight)
+        return counts

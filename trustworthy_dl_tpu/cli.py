@@ -499,6 +499,24 @@ def build_serve_parser() -> argparse.ArgumentParser:
                              "with bounded retries, drain/quarantine; "
                              "README §Fleet).  1 = single engine "
                              "(default)")
+    parser.add_argument("--pool-roles", type=str, default=None,
+                        metavar="ROLE[,ROLE...]",
+                        help="fleet only: disaggregate the replicas "
+                             "into prefill/decode specialist pools — "
+                             "one comma-separated role per replica "
+                             "('prefill' or 'decode', at least one of "
+                             "each; e.g. 'prefill,decode,decode').  New "
+                             "requests prefill on a prefill specialist "
+                             "and hand off to a decode specialist at "
+                             "their first decode token as a LIVE KV "
+                             "block-table migration; the autoscaler "
+                             "(when on) scales each pool independently")
+    parser.add_argument("--no-live-migration", action="store_true",
+                        help="fleet only: disable live KV block-table "
+                             "migration everywhere (drains run out, "
+                             "failures replay from the prompt — the "
+                             "pre-migration arcs; escape hatch and "
+                             "bench A/B toggle)")
     parser.add_argument("--hedge-deadline-ms", type=float, default=None,
                         help="fleet only: launch a hedged duplicate on "
                              "a second replica when a request's "
@@ -891,6 +909,10 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
             tenant_quota = TenantQuotaConfig(
                 capacity_tokens=args.tenant_quota,
                 refill_per_tick=refill)
+        pool_roles = None
+        if args.pool_roles:
+            pool_roles = tuple(
+                r.strip() for r in args.pool_roles.split(","))
     except ValueError as exc:
         print(f"control plane: {exc}")
         return 2
@@ -921,6 +943,8 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
             slo_classes=slo_classes,
             tenant_quota=tenant_quota,
             autoscale=autoscale,
+            pool_roles=pool_roles,
+            live_migration=not args.no_live_migration,
         ),
         rng=jax.random.PRNGKey(args.seed),
         trace=obs_session.trace if obs_session else None,
@@ -960,7 +984,8 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
     print(f"fleet served {submitted} request(s) on "
           f"{args.fleet_replicas} replica(s) x {args.max_slots} slot(s)")
     for key in ("statuses", "completed_tokens", "replica_states", "ticks",
-                "fleet_failovers", "fleet_hedges", "fleet_drains",
+                "fleet_failovers", "fleet_migrations", "fleet_preempts",
+                "fleet_hedges", "fleet_drains",
                 "fleet_quarantines", "fleet_restarts",
                 "fleet_suspicions", "fleet_votes", "fleet_outvotes",
                 "fleet_tenant_floods", "fleet_throttles",

@@ -246,6 +246,24 @@ def verify_attribution(records: Iterable[Dict[str, Any]],
                 if alloc is None:
                     continue
                 _check_placement(rid, att, alloc, digest, problems, where)
+                src = att.get("migrated_from")
+                if fleet and src and src.get("journal") is not None:
+                    # A live-migrated attempt carries its SOURCE block
+                    # table: those blocks were alloc'd on the source
+                    # journal and released when the migration committed
+                    # (or impounded, which the releases<=refs bound also
+                    # admits) — reconcile them there, so a block the
+                    # source never journalled, or released twice, still
+                    # surfaces even though the attempt retired elsewhere.
+                    swhere = (f" migration source on replica "
+                              f"{src.get('replica')}")
+                    salloc, sdigest = _resolve(src["journal"], rid, swhere)
+                    if salloc is not None:
+                        _check_placement(
+                            rid,
+                            {"layout": "paged",
+                             "block_ids": src.get("block_ids") or []},
+                            salloc, sdigest, problems, swhere)
         else:
             key = rec.get("journal", rec.get("replica"))
             alloc, digest = _resolve(key, rid, "")

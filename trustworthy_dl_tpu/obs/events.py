@@ -98,6 +98,13 @@ class EventType(str, enum.Enum):
     # the trust verdict that blames the model delta, not the replica).
     ADAPTER_SWAP = "adapter_swap"
     ADAPTER_QUARANTINE = "adapter_quarantine"
+    # Live migration tier (serve/migrate.py wired into serve/fleet.py):
+    # every live KV block-table hand-off of an in-flight request between
+    # replicas (drain, heartbeat, scale-in, preemption, disaggregation)
+    # and every pool-role rebalance sweep that moved decode-ready work
+    # off a prefill-specialist replica.
+    KV_MIGRATION = "kv_migration"
+    POOL_REBALANCE = "pool_rebalance"
     # Performance tier (obs/compilewatch.py, hbm.py, sentinel.py):
     # every XLA compilation, compile-once contract violations, live-HBM
     # sweeps/pressure denials, and perf-ledger regressions.
@@ -212,6 +219,19 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     EventType.ADAPTER_QUARANTINE: {
         "requires": (),
         "fields": ("adapter", "reason"),
+    },
+    # Live migration: a kv_migration correlates on the FLEET request id
+    # and names both replicas, the number of physical blocks copied and
+    # the reason ("trust_drain"/"heartbeat"/"scale_down"/"preempt"/
+    # "disagg"); a pool_rebalance is role-keyed (a sweep, not a request)
+    # and counts what the sweep moved off the prefill pool.
+    EventType.KV_MIGRATION: {
+        "requires": ("request_id",),
+        "fields": ("from_replica", "to_replica", "blocks", "reason"),
+    },
+    EventType.POOL_REBALANCE: {
+        "requires": (),
+        "fields": ("role", "replicas", "moved"),
     },
     # Performance tier.  ``compile`` rows are per-XLA-compilation (key =
     # the jax.monitoring stage, seconds = backend compile wall time);

@@ -60,6 +60,13 @@ SENTINEL_METRICS: Dict[str, str] = {
     # headline tokens/s notices.
     "adapter_hit_rate": "higher",
     "adapter_tokens_ratio": "higher",
+    # Live-migration success under capacity loss (migrations over
+    # migrations + replay failovers in the TDDL_BENCH_MIGRATE drain
+    # arm).  A structural regression — pool-geometry drift breaking
+    # ``can_migrate``, a claim path that starts refusing — silently
+    # degrades every capacity loss back to prompt replay; the fraction
+    # bands (and names the cause) before goodput noise shows it.
+    "migration_fraction": "higher",
 }
 
 
@@ -74,6 +81,7 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                 decode_tick_fraction: Optional[float] = None,
                 adapter_hit_rate: Optional[float] = None,
                 adapter_tokens_ratio: Optional[float] = None,
+                migration_fraction: Optional[float] = None,
                 run_metadata: Optional[Dict[str, Any]] = None,
                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One compact perf fingerprint.  ``key`` scopes comparability:
@@ -102,7 +110,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                         ("accepted_rate", accepted_rate),
                         ("decode_tick_fraction", decode_tick_fraction),
                         ("adapter_hit_rate", adapter_hit_rate),
-                        ("adapter_tokens_ratio", adapter_tokens_ratio)):
+                        ("adapter_tokens_ratio", adapter_tokens_ratio),
+                        ("migration_fraction", migration_fraction)):
         if value is not None:
             fp[name] = float(value)
     if phase_fractions:

@@ -1115,13 +1115,20 @@ class ServingEngine:
                 keep.append((task, request))
         self._queue = keep
 
-    def cancel(self, request_id: int, status: str = "cancelled") -> bool:
+    def cancel(self, request_id: int, status: str = "cancelled",
+               quarantine: bool = False) -> bool:
         """Terminate a queued or in-flight request NOW with ``status``
-        (no monitor scoring, no quarantine): the fleet's migrate/hedge
-        hook — a draining replica's queue moves elsewhere, a lost
-        hedge's duplicate stream stops burning decode slots.  Resources
-        (slot, blocks) free immediately; partial tokens ride the result.
-        Returns False when the id is unknown/already terminal."""
+        (no monitor scoring): the fleet's migrate/hedge hook — a
+        draining replica's queue moves elsewhere, a lost hedge's
+        duplicate stream stops burning decode slots, a live migration
+        releases its source half after the destination commits.
+        Resources (slot, blocks) free immediately; partial tokens ride
+        the result.  ``quarantine=True`` IMPOUNDS instead of freeing
+        (scheduler.retire's quarantine path: row + unshared blocks leave
+        the pool) — the source side of a migration OFF a quarantined/
+        trust-draining replica must not return suspect blocks to
+        service.  Returns False when the id is unknown/already
+        terminal."""
         for i in range(len(self._queue)):
             task, _request = self._queue[i]
             if task.request_id != request_id:
@@ -1147,7 +1154,7 @@ class ServingEngine:
         placement = (self.scheduler.attribution_info(task)
                      if self.ledger is not None
                      or self.retire_hook is not None else None)
-        self.scheduler.retire(task, quarantine=False)
+        self.scheduler.retire(task, quarantine=quarantine)
         times = self._timing.pop(request_id, [])
         t0 = self._submit_t.pop(request_id, None)
         ttft = (times[0] - t0) if times and t0 is not None else None
@@ -1160,6 +1167,10 @@ class ServingEngine:
         if self.trace is not None:
             self.trace.emit(EventType.SERVE_RETIRE, request_id=request_id,
                             status=status, tokens=len(task.emitted), **self._trace_tags)
+            if quarantine:
+                self.trace.emit(EventType.SERVE_QUARANTINE,
+                                request_id=request_id,
+                                slot=int(task.slot), **self._trace_tags)
         if self.ledger is not None:
             self.ledger.append({
                 "request_id": request_id, "status": status,
@@ -1177,6 +1188,85 @@ class ServingEngine:
                                   tokens=len(task.emitted))
         self._inflight.pop(request_id, None)
         return True
+
+    # -- live migration (serve/migrate.py orchestrates) --------------------
+
+    def export_request(self, request_id: int) -> Optional[Dict[str, Any]]:
+        """Source half of a live migration: the scheduler's block-table
+        snapshot (decode-phase only — mid-prefill and unknown ids
+        refuse with None, nothing touched) plus the engine-level timing
+        state that must travel for TTFT/ITL and deadline math to stay
+        exact across the hand-off.  Read-only: the request keeps
+        decoding here until ``cancel(..., status="migrated")`` releases
+        it AFTER the destination commits."""
+        pair = self._inflight.get(request_id)
+        if pair is None:
+            return None
+        exporter = getattr(self.scheduler, "export_migration", None)
+        if exporter is None:          # stripe pool: no block table
+            return None
+        task, request = pair
+        snap = exporter(task)
+        if snap is None:
+            return None
+        snap["request"] = request
+        snap["submit_t"] = self._submit_t.get(request_id)
+        snap["times"] = list(self._timing.get(request_id, []))
+        snap["replica"] = self.replica_id
+        return snap
+
+    def adopt_request(self, snapshot: Dict[str, Any],
+                      claim: Dict[str, Any], *,
+                      on_token: Optional[Callable[[int, int], None]] = None,
+                      migrated_from: Optional[Dict[str, Any]] = None
+                      ) -> int:
+        """Destination COMMIT half of a live migration: register the
+        migrated stream under a fresh LOCAL id on the claimed row —
+        pure host bookkeeping (the physical block copy already landed),
+        so it cannot fail after the claim.  The continuation task
+        copies the source's emitted stream, trust signals and the WHOLE
+        sampling key stream (the next key index is ``len(emitted)`` —
+        rng position travels by construction); ``publish_prefix`` is
+        forced off (the destination never prefilled these blocks — the
+        prompt was published, if at all, by the source).  Source-side
+        ``submit_t``/token times carry over verbatim (same process
+        clock), so deadlines, TTFT and ITL read as one request, not
+        two."""
+        src_task: SlotTask = snapshot["task"]
+        src_request: ServeRequest = snapshot["request"]
+        rid = self._next_id
+        self._next_id += 1
+        task = SlotTask(
+            request_id=rid,
+            prompt=np.asarray(src_task.prompt, np.int32),
+            max_new_tokens=int(src_task.max_new_tokens),
+            temperature=float(src_task.temperature),
+            keys=src_task.keys,
+            eos_id=src_task.eos_id,
+            publish_prefix=False,
+            adapter=src_task.adapter,
+        )
+        task.emitted = list(src_task.emitted)
+        task.next_token = src_task.next_token
+        task.entropies = list(src_task.entropies)
+        task.margins = list(src_task.margins)
+        request = dataclasses.replace(
+            src_request,
+            on_token=(on_token if on_token is not None
+                      else src_request.on_token),
+        )
+        self.scheduler.commit_migration(task, claim, snapshot["length"],
+                                        migrated_from=migrated_from)
+        self._inflight[rid] = (task, request)
+        t0 = snapshot.get("submit_t")
+        self._submit_t[rid] = (t0 if t0 is not None
+                               else time.perf_counter())
+        self._timing[rid] = list(snapshot.get("times", []))
+        if self.trace is not None:
+            self.trace.emit(EventType.SERVE_ADMIT, request_id=rid,
+                            slot=int(task.slot), migrated=True,
+                            **self._trace_tags)
+        return rid
 
     def _finish(self, task: SlotTask, request: ServeRequest,
                 status: str) -> None:
@@ -1284,6 +1374,16 @@ class ServingEngine:
     def inflight_ids(self) -> List[int]:
         """Local request ids holding a slot (fleet fail-over hook)."""
         return list(self._inflight)
+
+    @property
+    def decode_ready_ids(self) -> List[int]:
+        """In-flight ids past prefill with tokens emitted — the set a
+        disaggregated fleet moves off a prefill-specialist replica (a
+        migration snapshot exists exactly for these)."""
+        prefilling = getattr(self.scheduler, "_prefill", {})
+        return [rid for rid, (task, _) in self._inflight.items()
+                if task.emitted and not task.done
+                and task.slot not in prefilling]
 
     @property
     def load(self) -> int:

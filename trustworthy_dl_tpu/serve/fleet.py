@@ -236,6 +236,21 @@ class FleetConfig:
     #: tokens (predict_fleet's flood_request_tokens).
     flood_prompt_len: int = 4
     flood_new_tokens: int = 4
+    #: Disaggregated prefill/decode pools (None = unified fleet,
+    #: byte-identical to the defaults): one role per INITIAL replica
+    #: index, each "prefill" or "decode", at least one of each.  New
+    #: submissions route to prefill-specialist replicas; once a request
+    #: emits its first decode token the per-tick rebalance sweep moves
+    #: it to a decode-specialist as a LIVE block-table migration
+    #: (serve/migrate.py) — prefill capacity is never held hostage by
+    #: long decodes, and the autoscaler (when configured) scales each
+    #: pool INDEPENDENTLY from its own pool-local signals.
+    pool_roles: Optional[Tuple[str, ...]] = None
+    #: Operator escape hatch (and the bench A/B toggle): ``False``
+    #: restores the pre-migration arcs everywhere — drains run out or
+    #: replay, preemptions replay, disaggregated rebalance is inert —
+    #: without touching any other knob.
+    live_migration: bool = True
 
     def __post_init__(self) -> None:
         if self.num_replicas < 1:
@@ -274,6 +289,19 @@ class FleetConfig:
                 f"num_replicas={self.num_replicas} must start inside "
                 f"the autoscale bounds [{self.autoscale.min_replicas}, "
                 f"{self.autoscale.max_replicas}]")
+        if self.pool_roles is not None:
+            roles = tuple(self.pool_roles)
+            if len(roles) != self.num_replicas:
+                raise ValueError(
+                    f"pool_roles needs one role per replica: got "
+                    f"{len(roles)} for num_replicas={self.num_replicas}")
+            bad = sorted(set(roles) - {"prefill", "decode"})
+            if bad:
+                raise ValueError(f"pool_roles must be 'prefill' or "
+                                 f"'decode', got {bad}")
+            if not ({"prefill", "decode"} <= set(roles)):
+                raise ValueError("pool_roles needs at least one prefill "
+                                 "AND one decode replica")
 
 
 def backoff_ticks(cfg: FleetConfig, attempt: int) -> int:
@@ -360,6 +388,7 @@ class _Replica:
         self.index = index
         self.engine = engine
         self.gen = 0
+        self.role = "mixed"         # pool role; "mixed" = unified fleet
         self.state = ReplicaState.HEALTHY
         self.last_progress_tick = 0
         self.stalled_until = -1     # chaos wedge: step() suspended until
@@ -521,6 +550,20 @@ class ServingFleet:
             "Autoscaler replica-count changes, by direction",
             labels=("direction",),
         )
+        # Live migration tier (serve/migrate.py): in-flight requests
+        # moved between replicas as block copies, by the capacity-loss
+        # reason that moved them; replicas per pool role when the
+        # disaggregated prefill/decode split is on.
+        self._migration_counter = registry.counter(
+            "tddl_fleet_migrations_total",
+            "In-flight requests live-migrated as KV block copies",
+            labels=("reason",),
+        )
+        self._pool_gauge = registry.gauge(
+            "tddl_fleet_pool_replicas",
+            "In-service replicas per disaggregated pool role",
+            labels=("role",),
+        )
         self._classq_gauge = registry.gauge(
             "tddl_fleet_class_queue_depth",
             "Fleet admission-queue depth, by SLO class",
@@ -556,6 +599,7 @@ class ServingFleet:
             "scale_ups": 0, "scale_downs": 0,
             "adapter_poisons": 0, "adapter_quarantines": 0,
             "adapter_throttles": 0,
+            "preempts": 0, "migrations": 0,
         }
         # Verdict-vote working state: (voter replica, engine-local id)
         # -> the vote its replay ballots into.  Vote replays never enter
@@ -618,6 +662,17 @@ class ServingFleet:
         self._adapter_impounds: Dict[str, List[Tuple[int, int, int]]] = {}
         self.autoscaler = (Autoscaler(cfg.autoscale)
                            if cfg.autoscale is not None else None)
+        # -- disaggregated prefill/decode pools (opt-in) --
+        self._roles_active = cfg.pool_roles is not None
+        #: role -> Autoscaler: each pool's hysteresis/cool-down state is
+        #: its own — a decode-pool scale-up must not eat the prefill
+        #: pool's cool-down (and vice versa).  The shared AutoscalerConfig
+        #: bounds apply PER POOL when roles are active.
+        self._pool_scalers: Dict[str, Any] = {}
+        if self._roles_active and cfg.autoscale is not None:
+            self._pool_scalers = {
+                role: Autoscaler(cfg.autoscale)
+                for role in ("prefill", "decode")}
         # Fleet-wide completed-request ITL sketch: the autoscaler's
         # latency signal (per-class sketches serve the shed predicate).
         from trustworthy_dl_tpu.obs.slo import StreamingPercentiles
@@ -696,11 +751,20 @@ class ServingFleet:
         return kwargs
 
     def _build_replica(self, index: int,
-                       prev: Optional[_Replica] = None) -> _Replica:
+                       prev: Optional[_Replica] = None,
+                       role: Optional[str] = None) -> _Replica:
         engine = self._factory(index, **self._engine_build_kwargs(index))
         rep = prev if prev is not None else _Replica(
             index, engine, self.config.flag_window)
         rep.engine = engine
+        # Pool role is a property of the INDEX (initial assignment) or
+        # of the scale-up that created the replica — a rebuild/restart
+        # keeps the role it had; chaos must not reshuffle the pools.
+        if role is not None:
+            rep.role = role
+        elif prev is None and self._roles_active \
+                and index < len(self.config.pool_roles):
+            rep.role = self.config.pool_roles[index]
         rep.reset_trust_window()
         # A rebuilt replica must inherit the fleet's standing adapter
         # verdicts: the quarantine is against the ARTIFACT, and a crash
@@ -895,8 +959,14 @@ class ServingFleet:
         picked = [r for r in candidates if r.index not in avoid]
         if not picked:
             picked = [r for r in candidates if r.index not in live_on]
+        # Disaggregated pools: submissions (and resubmissions — every
+        # resubmission replays from the prompt) PREFER prefill
+        # specialists; decode replicas stay in the order as a fallback
+        # because availability beats specialization.
+        roles = self._roles_active
         return sorted(picked,
-                      key=lambda r: (r.state is not ReplicaState.HEALTHY,
+                      key=lambda r: (roles and r.role == "decode",
+                                     r.state is not ReplicaState.HEALTHY,
                                      r.engine.load, r.index))
 
     def _try_submit(self, rec: _FleetRequest,
@@ -980,6 +1050,7 @@ class ServingFleet:
                 continue  # chaos wedge: no progress, heartbeat will see
             emitted += rep.engine.step()
             rep.last_progress_tick = self.tick
+        self._rebalance_pools()
         self._process_terminals()
         self._supervise()
         self._autoscale()
@@ -1069,6 +1140,8 @@ class ServingFleet:
                         "chaos: slowstart on replica %d ignored in "
                         "state %s (ladder state preserved)",
                         rep.index, rep.state.value)
+            elif event.kind is FaultKind.REPLICA_PREEMPT:
+                self._preempt_replica(rep)
 
     def _crash_replica(self, rep: _Replica) -> None:
         """Kill the engine outright: every fleet request it held fails
@@ -1136,6 +1209,55 @@ class ServingFleet:
         else:
             rep.warm_until = self.tick + self.config.restart_ticks
             self._transition(rep, ReplicaState.RESTARTING, "crash")
+
+    def _preempt_replica(self, rep: _Replica) -> None:
+        """Preemptible capacity loss WITH notice — the serving twin of
+        the training-side PREEMPT.  Unlike a crash the fleet gets to
+        move the replica's state before the instance disappears: the
+        queue re-queues elsewhere (no device state to move) and every
+        in-flight request LIVE-migrates as a KV block copy
+        (serve/migrate.py); only what cannot move (no capacity, no
+        migration surface) falls back to the replay fail-over.  A
+        preemption that migrates everything is therefore NOT a failover
+        episode and NOT a drain — the capacity leaves, the work does
+        not — and the replica warms back through RESTARTING exactly
+        like a crash restart (``predict_fleet``: 1 preempt +
+        1 restart)."""
+        if rep.state is ReplicaState.RETIRED or rep.engine is None:
+            logger.warning("chaos: preempt on replica %d ignored "
+                           "(no engine)", rep.index)
+            return
+        self.counters["preempts"] += 1
+        if rep.state is ReplicaState.QUARANTINED:
+            # Quarantined = already drained empty: nothing to move, and
+            # preemption must not launder the cool-off (crash parity).
+            rep.engine = None
+            return
+        self._migrate(rep, rep.engine.queued_ids,
+                      status="migrated", reason="preempt")
+        for local in list(rep.engine.inflight_ids):
+            fid = self._local2fleet.get((rep.index, local))
+            if fid is None or not self._live_migrate(rep, fid, "preempt"):
+                self._migrate(rep, [local],
+                              status="failover", reason="preempt")
+        # Settle the cancels NOW: ballots seated here abstain, and the
+        # moved attempts close before the engine is torn down.
+        self._process_terminals()
+        self._abandon_votes_targeting(rep.index)
+        rep.retire_pending = False
+        if rep.quarantine_pending:
+            # Preempted mid-trust-drain: impound — same
+            # no-escape-from-the-ladder rule as a crash.
+            rep.quarantine_pending = False
+            rep.cooloff_ticks = max(rep.cooloff_ticks * 2,
+                                    self.config.quarantine_cooloff_ticks)
+            rep.cooloff_until = self.tick + rep.cooloff_ticks
+            rep.engine = None
+            self._transition(rep, ReplicaState.QUARANTINED, "preempt")
+            return
+        rep.engine = None
+        rep.warm_until = self.tick + self.config.restart_ticks
+        self._transition(rep, ReplicaState.RESTARTING, "preempt")
 
     # -- control plane: floods, class dispatch, autoscaling ----------------
 
@@ -1220,6 +1342,34 @@ class ServingFleet:
                     self._classq.push_front(name2, fid2, cost2)
                 break
 
+    def _rebalance_pools(self) -> None:
+        """Disaggregated-pool sweep (no-op without ``pool_roles``): a
+        request that just produced its first decode token on a
+        prefill-specialist replica moves to a decode specialist as a
+        live block copy — the hand-off the split exists for.  A refusal
+        (full decode pool) leaves it decoding where it is; the sweep
+        retries next tick, because availability beats specialization."""
+        if not self._roles_active:
+            return
+        moved = 0
+        for rep in self.replicas:
+            if (rep.role != "prefill" or rep.engine is None
+                    or rep.state not in ADMITTING):
+                continue
+            for local in list(getattr(rep.engine, "decode_ready_ids",
+                                      ())):
+                fid = self._local2fleet.get((rep.index, local))
+                if fid is None:
+                    continue  # vote replay: audits never rebalance
+                if self._live_migrate(rep, fid, "disagg"):
+                    moved += 1
+        if moved and self.trace is not None:
+            self.trace.emit(
+                EventType.POOL_REBALANCE, role="prefill", moved=moved,
+                replicas=sum(1 for r in self.replicas
+                             if r.role == "decode"
+                             and r.engine is not None))
+
     def _in_service(self) -> List[_Replica]:
         """Replicas that exist as capacity (everything but RETIRED) —
         the count the autoscaler's [min, max] bounds govern."""
@@ -1240,6 +1390,31 @@ class ServingFleet:
         action."""
         if self.autoscaler is None:
             return
+        if self._pool_scalers:
+            # Disaggregated pools scale INDEPENDENTLY: each pool reads
+            # only its own replicas' signals and holds its own
+            # hysteresis/cool-down state, so decode-pool pressure (long
+            # generations) grows decode capacity without touching the
+            # prefill pool and vice versa.  The [min, max] bounds apply
+            # per pool.
+            for role in ("prefill", "decode"):
+                sig = self._scale_signals(role)
+                decision = self._pool_scalers[role].observe(sig)
+                if decision > 0:
+                    self._scale_up(sig, role=role)
+                elif decision < 0:
+                    self._scale_down(sig, role=role)
+            return
+        sig = self._scale_signals(None)
+        decision = self.autoscaler.observe(sig)
+        if decision > 0:
+            self._scale_up(sig)
+        elif decision < 0:
+            self._scale_down(sig)
+
+    def _scale_signals(self, role: Optional[str]) -> Any:
+        """One tick's autoscaler inputs, fleet-wide (``role=None``) or
+        restricted to one disaggregated pool."""
         from trustworthy_dl_tpu.serve.control import ScaleSignals, \
             predicted_replicas
 
@@ -1260,10 +1435,14 @@ class ServingFleet:
         staying = [r for r in self._in_service()
                    if r.state is not ReplicaState.QUARANTINED
                    and not (r.state is ReplicaState.DRAINING
-                            and r.retire_pending)]
+                            and r.retire_pending)
+                   and (role is None or r.role == role)]
         engines = [r.engine for r in staying if r.engine is not None]
         queue = sum(e.load for e in engines)
-        if self._classq is not None:
+        if self._classq is not None and role in (None, "prefill"):
+            # Class-queued work dispatches to the PREFILL pool when the
+            # split is on (routing prefers prefill specialists), so the
+            # backlog is that pool's pressure, counted once.
             queue += self._classq.depth()
         occ = 0.0
         pools = [getattr(e, "scheduler", None) for e in engines]
@@ -1276,22 +1455,21 @@ class ServingFleet:
         itl = (self._itl_est.quantile(0.99)
                if self._itl_est.count else None)
         cfg = self.autoscaler.cfg
+        # The predictive arm models FLEET-wide demand: applying it to
+        # each pool separately would double-provision, so it only
+        # steers the unified fleet.
         pred = (predicted_replicas(cfg.predictive, self.tick)
-                if cfg.predictive is not None else None)
-        sig = ScaleSignals(
+                if cfg.predictive is not None and role is None else None)
+        return ScaleSignals(
             tick=self.tick, in_service=len(staying),
             queue_per_replica=queue / max(len(staying), 1),
             occupancy=occ, itl_p99=itl, slo_burning=burning,
             predicted_replicas=pred,
             down_candidates=any(r.state in ADMITTING
                                 and r.engine is not None
+                                and (role is None or r.role == role)
                                 for r in self.replicas),
         )
-        decision = self.autoscaler.observe(sig)
-        if decision > 0:
-            self._scale_up(sig)
-        elif decision < 0:
-            self._scale_down(sig)
 
     def _emit_scale(self, direction: str, frm: int, to: int,
                     reason: str) -> None:
@@ -1303,22 +1481,31 @@ class ServingFleet:
                             from_replicas=frm, to_replicas=to,
                             reason=reason, tick=self.tick)
 
-    def _scale_up(self, sig: Any) -> None:
+    def _scale_up(self, sig: Any, role: Optional[str] = None) -> None:
         """Add capacity: revive a RETIRED index (fresh generation —
         journals retained) or append a new replica.  Either way the
         engine build goes through the existing HBM headroom gate
         (``hbm`` rides engine_kwargs), and the replica warms up through
         RESTARTING like any rebuild — scale-up is never instant
-        admission."""
+        admission.  ``role`` pins the new capacity to one disaggregated
+        pool: the revived/appended replica joins THAT pool (a decode
+        scale-up must never come back as a prefill specialist)."""
         frm = len(self._in_service())
         cfgc = self.config
         rep = next((r for r in self.replicas
-                    if r.state is ReplicaState.RETIRED), None)
+                    if r.state is ReplicaState.RETIRED
+                    and (role is None or r.role == role)), None)
+        if rep is None and role is not None:
+            # No retired index from this pool — a retired replica from
+            # the OTHER pool is still cheaper than a fresh index (its
+            # journal survives); it changes pools on revival.
+            rep = next((r for r in self.replicas
+                        if r.state is ReplicaState.RETIRED), None)
         if rep is not None:
             rep.gen += 1
-            self._build_replica(rep.index, prev=rep)
+            self._build_replica(rep.index, prev=rep, role=role)
         else:
-            rep = self._build_replica(len(self.replicas))
+            rep = self._build_replica(len(self.replicas), role=role)
             self.replicas.append(rep)
         rep.warm_until = self.tick + cfgc.restart_ticks
         rep.last_progress_tick = self.tick
@@ -1328,15 +1515,18 @@ class ServingFleet:
                        sig.queue_per_replica, sig.occupancy)
         self._emit_scale("up", frm, len(self._in_service()), "scale_up")
 
-    def _scale_down(self, sig: Any) -> None:
+    def _scale_down(self, sig: Any, role: Optional[str] = None) -> None:
         """Shed capacity WITHOUT shedding work: pick the least-loaded
         admitting replica (ties: newest index), migrate its queue now,
         and let in-flight run out — a scale-down drain never
         force-migrates at the grace deadline and never kills accepted
         requests.  The drain completes into RETIRED: pool released,
-        journal retained, index reusable by the next scale-up."""
+        journal retained, index reusable by the next scale-up.
+        ``role`` restricts the pick to one disaggregated pool so the
+        decode scaler can never drain a prefill specialist."""
         cands = [r for r in self.replicas
-                 if r.state in ADMITTING and r.engine is not None]
+                 if r.state in ADMITTING and r.engine is not None
+                 and (role is None or r.role == role)]
         if not cands:
             return  # nothing safely removable this tick
         frm = len(self._in_service())
@@ -1346,6 +1536,14 @@ class ServingFleet:
         self._transition(rep, ReplicaState.DRAINING, "scale_down")
         self._migrate(rep, rep.engine.queued_ids,
                       status="migrated", reason="scale_down")
+        # In-flight moves immediately as live block copies (the retiring
+        # pool's capacity frees NOW, not after the longest decode); what
+        # cannot move keeps the pre-existing run-out — a scale-in drain
+        # still never kills accepted work.
+        for local in list(rep.engine.inflight_ids):
+            fid = self._local2fleet.get((rep.index, local))
+            if fid is not None:
+                self._live_migrate(rep, fid, "scale_down")
         logger.warning("fleet: scale-down draining replica %d "
                        "(queue/replica %.1f, occupancy %.2f)",
                        rep.index, sig.queue_per_replica, sig.occupancy)
@@ -1525,6 +1723,13 @@ class ServingFleet:
             return
         rec.done = True
         rec.retry_due = None
+        # Token-bucket reconciliation: the spend landed ONCE at submit()
+        # and rode through every drain→migrate→resubmit hop without a
+        # re-charge; a request that dies UNSERVED (deadline between
+        # attempts, retry budget, starvation) produced no tokens, so the
+        # tenant gets that one spend back — never refunded twice
+        # (rec.done guards above) and never refunded for served work.
+        self._refund_bucket(rec)
         self._cancel_siblings(rec, status="hedge_lost")
         self.results[rec.fid] = FleetResult(
             request_id=rec.fid, tokens=[], status=status, replica=None,
@@ -1633,14 +1838,90 @@ class ServingFleet:
                 # resubmission carries the drain reason.
                 self._drain_moves.append((fid, rep.index, reason))
 
+    def _live_migrate(self, rep: _Replica, fid: int, reason: str) -> bool:
+        """Move fleet request ``fid`` off ``rep`` as a LIVE KV
+        block-table migration (serve/migrate.py): the destination's
+        admission rides the normal allocator path, the fleet re-points
+        its attempt table in the commit hook BEFORE the source attempt
+        closes, and the source's blocks release — or impound, when the
+        source is bound for quarantine — only after that.  Returns False
+        (source untouched, caller falls back to the replay path or the
+        drain grace window) when no destination can take the copy:
+        structural gate failure, full pools, or a mid-prefill request
+        with nothing migratable yet."""
+        from trustworthy_dl_tpu.serve.migrate import can_migrate, \
+            migrate_request
+
+        if not self.config.live_migration:
+            return False
+        rec = self.requests.get(fid)
+        if rec is None or rec.done:
+            return False
+        att = rec.live.get(rep.index)
+        if att is None:
+            return False
+        cands = [r for r in self.replicas
+                 if r.index != rep.index and r.state in ADMITTING
+                 and r.engine is not None and r.index not in rec.live]
+        if self._roles_active:
+            decode = [r for r in cands if r.role == "decode"]
+            if decode:
+                cands = decode
+        cands.sort(key=lambda r: (r.state is not ReplicaState.HEALTHY,
+                                  r.engine.load, r.index))
+        for dst in cands:
+            if not can_migrate(rep.engine, dst.engine):
+                continue
+
+            def commit(new_local: int, _dst: _Replica = dst) -> None:
+                # The destination attempt inherits the SOURCE attempt's
+                # submit_t: the fleet's TTFT math must read the stream
+                # as one request, not restart the clock mid-flight.
+                rec.live[_dst.index] = _Attempt(
+                    replica=_dst.index, gen=_dst.gen,
+                    local_id=new_local, submit_t=att.submit_t)
+                self._local2fleet[(_dst.index, new_local)] = rec.fid
+
+            moved = migrate_request(
+                rep.engine, dst.engine, att.local_id,
+                quarantine_src=rep.quarantine_pending,
+                on_token=self._token_forwarder(rec, dst.index),
+                src_journal=f"{rep.index}:{att.gen}",
+                on_commit=commit,
+            )
+            if moved is None:
+                continue
+            self.counters["migrations"] += 1
+            self._migration_counter.inc(reason=reason)
+            if self.trace is not None:
+                self.trace.emit(EventType.KV_MIGRATION, request_id=fid,
+                                from_replica=rep.index,
+                                to_replica=dst.index,
+                                blocks=moved["blocks"], reason=reason)
+            # Settle the source cancel NOW: until its terminal record
+            # pops the source attempt from rec.live, both attempts
+            # share a submit_t and the streaming tie-break would
+            # suppress the destination's next token.
+            self._process_terminals()
+            return True
+        return False
+
     def _start_trust_drain(self, rep: _Replica, reason: str) -> None:
         """ONE spelling of the trust-driven drain entry (flag-rate trip
         AND verdict outvote): transition, arm the quarantine, migrate
-        the queue now — in-flight gets the grace window."""
+        the queue now — and move in-flight work IMMEDIATELY as live
+        block copies with the source blocks impounded (the suspect's
+        bytes leave its pool with the evidence held, instead of the
+        suspect serving user tokens for a whole grace window).  What
+        cannot move keeps the pre-existing grace-window run-out."""
         self._transition(rep, ReplicaState.DRAINING, reason)
         rep.quarantine_pending = True
         self._migrate(rep, rep.engine.queued_ids,
                       status="migrated", reason="drain")
+        for local in list(rep.engine.inflight_ids):
+            fid = self._local2fleet.get((rep.index, local))
+            if fid is not None:
+                self._live_migrate(rep, fid, "drain")
 
     def _supervise(self) -> None:
         cfg = self.config
@@ -1713,11 +1994,19 @@ class ServingFleet:
                     rep.quarantine_pending = False
                     self.counters["failover_episodes"] += 1
                     # No progress = nothing to wait for: migrate queue
-                    # AND in-flight immediately.
+                    # AND in-flight immediately.  A wedged engine's
+                    # pool is still readable, so in-flight state moves
+                    # as a live block copy — every accepted token
+                    # travels — and only what cannot move replays.
                     self._migrate(rep, rep.engine.queued_ids,
                                   status="migrated", reason="drain")
-                    self._migrate(rep, rep.engine.inflight_ids,
-                                  status="failover", reason="heartbeat")
+                    for local in list(rep.engine.inflight_ids):
+                        fid = self._local2fleet.get((rep.index, local))
+                        if fid is None or not self._live_migrate(
+                                rep, fid, "heartbeat"):
+                            self._migrate(rep, [local],
+                                          status="failover",
+                                          reason="heartbeat")
                 elif rep.state is ReplicaState.HEALTHY and (
                         rep.flag_count >= 1
                         or missed >= cfg.heartbeat_miss_degraded
@@ -1748,13 +2037,16 @@ class ServingFleet:
                 if stalled_retire or (
                         not rep.retire_pending and rep.engine.load
                         and self.tick >= rep.drain_deadline):
+                    why = ("scale_down_stall" if stalled_retire
+                           else "drain_grace")
                     self._migrate(rep, rep.engine.queued_ids,
                                   status="migrated", reason="drain")
-                    self._migrate(rep, rep.engine.inflight_ids,
-                                  status="failover",
-                                  reason=("scale_down_stall"
-                                          if stalled_retire
-                                          else "drain_grace"))
+                    for local in list(rep.engine.inflight_ids):
+                        fid = self._local2fleet.get((rep.index, local))
+                        if fid is None or not self._live_migrate(
+                                rep, fid, why):
+                            self._migrate(rep, [local],
+                                          status="failover", reason=why)
                 if rep.engine.load == 0:
                     if rep.retire_pending:
                         # Scale-in complete: release the pool, keep the
@@ -2169,6 +2461,11 @@ class ServingFleet:
                     tif += sched.tokens_in_flight
         for state, n in by_state.items():
             self._replicas_gauge.set(float(n), state=state.value)
+        if self._roles_active:
+            for role in ("prefill", "decode"):
+                n = sum(1 for r in self.replicas if r.role == role
+                        and r.state is not ReplicaState.RETIRED)
+                self._pool_gauge.set(float(n), role=role)
         self._tif_gauge.set(float(tif))
         self._queue_gauge.set(float(load))
         if self._classq is not None:

@@ -1390,6 +1390,103 @@ class PagedBatchingScheduler:
         for b in self._q_blocks_by_slot.pop(slot, []):
             self.blocks.unquarantine(b)
 
+    # -- live migration (serve/migrate.py) ---------------------------------
+
+    def export_migration(self, task: SlotTask) -> Optional[Dict[str, Any]]:
+        """Source-side snapshot of a DECODE-PHASE task for a live
+        hand-off: the physical block table, the committed length and the
+        admission-time placement (the destination's provenance record).
+        Refuses (None, nothing touched) mid-prefill — chunk progress is
+        not block state, the destination would have to re-prefill anyway
+        — and unknown/stale tasks.  Outstanding speculative claims
+        unwind FIRST (abort semantics, same ordering rule as retire):
+        a migration never travels with un-verified draft claims, and
+        the accepted ``lengths`` already exclude rejected draft KV."""
+        slot = task.slot
+        if slot < 0 or self.tasks.get(slot) is not task:
+            return None
+        if slot in self._prefill or not task.emitted:
+            return None
+        self.blocks.release_speculative(self._spec_claims.pop(slot, []))
+        return {
+            "task": task,
+            "length": int(self.lengths[slot]),
+            "block_ids": list(self.tables[slot]),
+            "placement": self.attribution_info(task),
+        }
+
+    def claim_migration(self, n_blocks: int, adapter: Optional[str]
+                        ) -> Optional[Dict[str, Any]]:
+        """Destination-side CLAIM phase: reserve a decode row,
+        ``n_blocks`` fresh physical blocks (prefix-evict retry — the
+        same out-of-blocks backpressure as ``admit``) and, for an
+        adapter-carrying request, the tenant's adapter page.  Returns
+        None with NOTHING claimed on any shortage — a refusal here must
+        leave both replicas exactly as they were.  No prefill and no
+        prefix sharing: the blocks' contents arrive by device copy."""
+        slot = self.allocator.alloc()
+        if slot is None:
+            return None
+        fresh = self.blocks.alloc(n_blocks)
+        if fresh is None and self.prefix is not None:
+            self.prefix.evict(n_blocks - self.blocks.free_count)
+            fresh = self.blocks.alloc(n_blocks)
+        if fresh is None:
+            self.allocator.free(slot)
+            return None
+        page = ZERO_PAGE
+        if adapter is not None:
+            page = (self.adapters.acquire(adapter)
+                    if self.adapters is not None else None)
+            if page is None:
+                # Adapterless destination, full pool, or quarantined
+                # adapter: full unwind, refusal leaves the source alone.
+                for b in fresh:
+                    self.blocks.release(b)
+                self.allocator.free(slot)
+                return None
+        return {"slot": slot, "block_ids": list(fresh),
+                "adapter": adapter, "adapter_page": int(page)}
+
+    def abort_migration(self, claim: Dict[str, Any]) -> None:
+        """Unwind a CLAIM that never committed (copy failed upstream or
+        the orchestrator gave up): releases the blocks, the row and the
+        adapter page — the exact inverse of ``claim_migration``."""
+        if claim.get("adapter") is not None and self.adapters is not None:
+            self.adapters.release(claim["adapter"])
+        for b in claim["block_ids"]:
+            self.blocks.release(b)
+        self.allocator.free(claim["slot"])
+
+    def commit_migration(self, task: SlotTask, claim: Dict[str, Any],
+                         length: int,
+                         migrated_from: Optional[Dict[str, Any]] = None
+                         ) -> None:
+        """COMMIT phase: register the migrated task on the claimed row.
+        Pure host bookkeeping — the physical block copy already happened
+        (serve/migrate.py) — so commit cannot fail.  The attribution
+        snapshot names only the DESTINATION's fresh blocks as owned;
+        ``migrated_from`` carries the source journal key + source block
+        ids so ``verify_attribution`` reconciles the hand-off across
+        both allocators' journals."""
+        slot = claim["slot"]
+        task.slot = slot
+        task.adapter_page = int(claim["adapter_page"])
+        task.tick_tokens = None
+        self.tables[slot] = list(claim["block_ids"])
+        self.lengths[slot] = int(length)
+        self.tasks[slot] = task
+        info: Dict[str, Any] = {
+            "layout": "paged", "slot": slot,
+            "block_ids": list(claim["block_ids"]),
+            "prefix_block_ids": [], "prefix_publishers": {},
+            "adapter": task.adapter,
+            "adapter_page": int(claim["adapter_page"]),
+        }
+        if migrated_from is not None:
+            info["migrated_from"] = dict(migrated_from)
+        self._attrib[slot] = info
+
     def decode_cache_size(self) -> int:
         """Number of compiled paged-decode programs (the compile-once
         pin: block-table churn must keep this at 1)."""

@@ -80,6 +80,15 @@ class WorkloadConfig:
     #: map), so A/B bench arms replay identical adapter churn.
     num_adapters: int = 0
     adapter_zipf: float = 1.1       # Zipf exponent over adapter ranks
+    #: Bimodal prompt mixture (0 = off, the lognormal above unchanged):
+    #: with this probability a request's prompt is drawn from a SECOND
+    #: lognormal mode at ``prompt_long_median`` — the RAG/summarise mix
+    #: (short chat prompts + occasional huge contexts) whose prefill
+    #: cost variance is what disaggregated prefill/decode pools exist
+    #: to absorb.  Off means zero extra RNG draws, so every pre-existing
+    #: workload config replays a byte-identical schedule.
+    prompt_bimodal_frac: float = 0.0
+    prompt_long_median: int = 0
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.burstiness < 1.0:
@@ -92,6 +101,11 @@ class WorkloadConfig:
             raise ValueError("num_adapters must be >= 0")
         if self.adapter_zipf <= 1.0:
             raise ValueError("adapter_zipf must be > 1 (Zipf exponent)")
+        if not 0.0 <= self.prompt_bimodal_frac <= 1.0:
+            raise ValueError("prompt_bimodal_frac must be in [0, 1]")
+        if self.prompt_bimodal_frac > 0.0 and self.prompt_long_median < 1:
+            raise ValueError("prompt_long_median must be >= 1 when the "
+                             "bimodal mix is on")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,7 +194,13 @@ def generate_workload(cfg: WorkloadConfig, vocab_size: int, max_seq: int
             out_hi = max(min(out_hi, cfg.max_output), 1)
         new = _lognormal_len(rng, cfg.output_median, cfg.output_sigma,
                              cfg.min_output, out_hi)
-        plen = _lognormal_len(rng, cfg.prompt_median, cfg.prompt_sigma,
+        # The bimodal mode-pick draw happens ONLY when the mix is on, so
+        # frac=0 configs replay their pre-existing schedules unchanged.
+        p_median = cfg.prompt_median
+        if (cfg.prompt_bimodal_frac > 0.0
+                and float(rng.random()) < cfg.prompt_bimodal_frac):
+            p_median = cfg.prompt_long_median
+        plen = _lognormal_len(rng, p_median, cfg.prompt_sigma,
                               cfg.min_prompt, max(max_seq - new - 1, 1))
         items.append(WorkloadItem(
             t_arrive=t,
