@@ -1195,6 +1195,10 @@ def bench_fleet() -> "dict":
         ], seed=seed)
 
     arms: "dict[str, list]" = {"baseline": [], "chaos": []}
+    # Forensic incident counts, by reason, summed over every chaos arm
+    # (the baseline arms have no faults to assemble incidents for).
+    # In-memory assembler: directory=None counts without writing files.
+    incident_counts: "dict[str, int]" = {}
     for rate in rates:
         workload = generate_workload(
             WorkloadConfig(seed=seed, num_requests=n_requests,
@@ -1204,6 +1208,12 @@ def bench_fleet() -> "dict":
         for arm in ("baseline", "chaos"):
             chaos = (FaultInjector(fault_plan()) if arm == "chaos"
                      else None)
+            forensics = None
+            if chaos is not None:
+                from trustworthy_dl_tpu.obs.forensics import \
+                    IncidentAssembler
+
+                forensics = IncidentAssembler()
             fleet = ServingFleet(
                 params, cfg,
                 # Cool-off pinned past the run: an unhealed poisoned
@@ -1216,7 +1226,7 @@ def bench_fleet() -> "dict":
                                          slo_classes=DEFAULT_SLO_CLASSES),
                 chaos=chaos, rng=jax.random.PRNGKey(1),
                 max_slots=max_slots, max_seq=max_seq,
-                queue_limit=n_requests,
+                queue_limit=n_requests, forensics=forensics,
             )
             t0 = time.perf_counter()
             replay_workload(fleet, workload, lambda item: ServeRequest(
@@ -1260,6 +1270,10 @@ def bench_fleet() -> "dict":
                 },
             }
             arms[arm].append(row)
+            if forensics is not None:
+                for why, n in forensics.counts_by_reason().items():
+                    incident_counts[why] = (
+                        incident_counts.get(why, 0) + n)
             log(f"fleet {arm:8s} offered={rate:6.1f} req/s: "
                 f"goodput {row['goodput_tokens_per_s']:8.1f} tok/s, "
                 f"completed {row['completed']}/{n_requests}, "
@@ -1270,6 +1284,7 @@ def bench_fleet() -> "dict":
         "max_slots_per_replica": max_slots,
         "requests_per_arm": n_requests,
         "arms": arms,
+        "incidents": dict(sorted(incident_counts.items())),
     }
 
 

@@ -491,6 +491,19 @@ def test_bench_fleet_records(monkeypatch, tmp_path):
     # The chaos arm really injected: recovery machinery engaged.
     assert chaos_row["restarts"] >= 1
     assert chaos_row["failovers"] + chaos_row["drains"] >= 1
+    # PR 18: the chaos arms run under an in-memory IncidentAssembler,
+    # and the record publishes what the forensics engine counted —
+    # every reason from the registered vocabulary, every count a
+    # positive int, and the arm's quarantines mirrored exactly.
+    from trustworthy_dl_tpu.analysis.contracts import ARTIFACT_REASONS
+    incidents = record["incidents"]
+    assert isinstance(incidents, dict)
+    assert set(incidents) <= ARTIFACT_REASONS, incidents
+    assert all(isinstance(n, int) and n > 0
+               for n in incidents.values()), incidents
+    if chaos_row["quarantines"]:
+        assert incidents.get("replica_quarantine", 0) \
+            >= chaos_row["quarantines"], incidents
 
 
 @pytest.mark.migrate

@@ -1108,7 +1108,8 @@ class PoisonSignatureMonitor:
 
 
 @pytest.mark.slow
-def test_fleet_chaos_drill_matches_predict_and_reference_streams():
+@pytest.mark.forensics
+def test_fleet_chaos_drill_matches_predict_and_reference_streams(tmp_path):
     """THE acceptance drill: REPLICA_POISON + REPLICA_CRASH +
     REPLICA_STALL in one seeded plan over 3 real engines.  Recovery
     counts match ``predict_fleet()`` exactly, every accepted request
@@ -1116,7 +1117,18 @@ def test_fleet_chaos_drill_matches_predict_and_reference_streams():
     surviving streams are bit-identical to single-engine generate(),
     and the fleet attribution ledger reconciles against every replica
     generation's block journal — including records whose attempts span
-    two replicas' allocators."""
+    two replicas' allocators.
+
+    Re-run with forensics attached (PR 18): the poison's quarantine
+    assembles exactly one ``replica_quarantine`` incident whose trigger
+    is the quarantine transition, whose action counts reconcile with
+    ``predict_fleet()``, and whose blast radius names EXACTLY the
+    requests whose ledger attempts touched the poisoned generation's
+    blocks — no over-, no under-attribution."""
+    from trustworthy_dl_tpu.obs.forensics import IncidentAssembler, \
+        load_incidents
+    from trustworthy_dl_tpu.obs.verdicts import VerdictStore
+
     params = gpt2.init_params(jax.random.PRNGKey(0), CFG)
     plan = FaultPlan.scripted([
         FaultEvent(step=1, kind=FaultKind.REPLICA_POISON, target=2),
@@ -1126,6 +1138,10 @@ def test_fleet_chaos_drill_matches_predict_and_reference_streams():
     ])
     inj = FaultInjector(plan)
     ledger = AttributionLedger(None)
+    trace = RecordingTrace()
+    verdicts = VerdictStore(str(tmp_path / "VERDICTS.jsonl"))
+    forensics = IncidentAssembler(str(tmp_path), trace=trace,
+                                  ledger=ledger, verdicts=verdicts)
     fleet = ServingFleet(
         params, CFG,
         fleet_config=FleetConfig(
@@ -1136,7 +1152,9 @@ def test_fleet_chaos_drill_matches_predict_and_reference_streams():
         chaos=inj, ledger=ledger,
         max_slots=2, max_seq=48, queue_limit=32,
         monitor=PoisonSignatureMonitor(),
+        forensics=forensics,
     )
+    fleet.trace = trace
     rng = np.random.default_rng(1)
     reqs = []
     for _ in range(12):
@@ -1183,6 +1201,60 @@ def test_fleet_chaos_drill_matches_predict_and_reference_streams():
     assert spanning, "no record spans two replicas' journals"
     # The crash retained its generation's journal alongside the new one.
     assert "0:0" in fleet.journals and "0:1" in fleet.journals
+
+    # -- forensics: the quarantine episode's incident report ---------------
+    # Exactly one replica_quarantine incident — one per predicted
+    # quarantine — written next to where the flight dump would land.
+    counts = forensics.counts_by_reason()
+    assert counts.get("replica_quarantine") == predicted["quarantines"]
+    incidents = load_incidents(str(tmp_path))
+    quar = [i for i in incidents if i["reason"] == "replica_quarantine"]
+    assert len(quar) == predicted["quarantines"] == 1
+    inc = quar[0]
+    assert inc["schema_version"] == 1
+    assert inc["suspect_replicas"] == [2]
+    assert inc["suspect_journals"] == ["2:0"]
+    # Trigger = the quarantine transition itself, with its trace seq.
+    trig = inc["trigger"]
+    assert trig["type"] == "replica_transition"
+    assert trig["replica"] == 2 and trig["to_state"] == "quarantined"
+    assert not trig.get("synthetic") and trig["seq"] is not None
+    # Every contributing signal precedes the trigger and names the
+    # suspect; the action count reconciles with predict_fleet(): the
+    # suspect's quarantine transition appears exactly once.
+    assert all(e["seq"] <= trig["seq"] for e in inc["contributing"])
+    q_actions = [e for e in inc["actions"]
+                 if e["type"] == "replica_transition"
+                 and e["to_state"] == "quarantined"]
+    assert len(q_actions) == predicted["quarantines"]
+    # The counters snapshot at assembly already carried the quarantine.
+    assert inc["counters"]["quarantines"] == predicted["quarantines"]
+    assert inc["counters"]["poisons"] == predicted["poisons"]
+
+    # Blast radius: EXACTLY the requests whose ledger attempts touched
+    # the poisoned generation's blocks (directly or as migrated_from
+    # provenance) — recomputed here by an independent walk.
+    touched = set()
+    for rec in admitted:
+        for att in rec.get("attempts") or []:
+            placed = bool(att.get("block_ids")) or (
+                att.get("layout") == "stripe"
+                and att.get("slot", -1) >= 0)
+            if att.get("journal") == "2:0" and placed:
+                touched.add(rec["request_id"])
+            if (att.get("migrated_from") or {}).get("journal") == "2:0":
+                touched.add(rec["request_id"])
+    assert touched, "drill routed nothing through the poisoned replica"
+    assert inc["blast_radius"]["requests"] == sorted(touched)
+    assert set(inc["blast_radius"]["suspect_blocks"]) == {"2:0"}
+
+    # The durable verdict history recorded the episode end-to-end:
+    # suspicion opened, quarantine verdict, incident row — and the
+    # priors aggregation pins replica 2 as the suspect.
+    priors = verdicts.priors()
+    rep2 = priors["replicas"]["2"]
+    assert rep2["counts"].get("quarantine:quarantined") == 1
+    assert inc["incident_id"] in rep2["incidents"]
 
 
 @pytest.mark.slow

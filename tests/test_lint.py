@@ -436,6 +436,32 @@ def test_artifact_metadata_rule(tmp_path):
     ]
 
 
+def test_artifact_reason_vocab_rule(tmp_path):
+    # The vocabulary applies at dump/assemble surfaces only, in every
+    # literal position those surfaces accept: first positional, the
+    # ``reason=`` kwarg, and ``dump()``'s second slot (``FlightRecorder
+    # .dump(directory, reason)``).  Dynamic reasons and other callables'
+    # ``reason=`` namespaces pass through.
+    result = _run(tmp_path, {
+        "trustworthy_dl_tpu/serve/mod.py": '''\
+            import json
+
+            def episode(session, recorder, fleet, payload, why, obs_dir):
+                session.dump_flight("slo_breech", step=3)      # typo
+                fleet._forensic_incident(reason="preemption ")  # typo
+                recorder.dump(obs_dir, "guard_tripp")          # typo
+                session.dump_flight("guard_trip", step=3)      # vocab
+                session.dump_flight(why, step=3)          # dynamic: ok
+                json.dump(payload, open("/dev/null", "w"))  # not ours
+                fleet.schedule(reason="retry_budget")     # other ns
+                recorder.dump("smoke_drill")  # tddl-lint: disable=artifact-reason-vocab
+            ''',
+    }, rules=["artifact-reason-vocab"])
+    assert _rules_of(result) == ["artifact-reason-vocab"]
+    assert sorted(f.line for f in result.findings) == [4, 5, 6]
+    assert "slo_breech" in result.findings[0].message
+
+
 def test_atomic_write_rule(tmp_path):
     result = _run(tmp_path, {
         "trustworthy_dl_tpu/obs/mod.py": '''\

@@ -262,25 +262,37 @@ class RecordingTrace:
 
 
 @pytest.mark.slow
-def test_fleet_preempt_drill_matches_predict_and_reference_streams(params):
+@pytest.mark.forensics
+def test_fleet_preempt_drill_matches_predict_and_reference_streams(
+        params, tmp_path):
     """REPLICA_PREEMPT mid-decode: every in-flight request on the
     preempted replica moves as a block copy (not a replay), the
     migration/preempt/fail-over counters match ``predict_fleet()``
     EXACTLY, zero accepted requests are lost, every stream is
     bit-identical to ``generate()``, the ledger reconciles the
     migrated records across BOTH replicas' journals, and the drill
-    compiles zero new decode programs."""
+    compiles zero new decode programs.
+
+    Re-run with forensics attached (PR 18): the preemption assembles
+    one ``replica_preempt`` incident whose kv_migration action count
+    reconciles EXACTLY with ``predict_fleet()`` and whose blast radius
+    names the migrated requests via their ``migrated_from``
+    provenance."""
     from trustworthy_dl_tpu.chaos import (FaultEvent, FaultInjector,
                                           FaultKind, FaultPlan)
     from trustworthy_dl_tpu.obs.attribution import AttributionLedger
     from trustworthy_dl_tpu.obs.compilewatch import (CompileRegistry,
                                                      CompileWatcher)
+    from trustworthy_dl_tpu.obs.forensics import (IncidentAssembler,
+                                                  load_incidents)
 
     plan = FaultPlan.scripted([
         FaultEvent(step=3, kind=FaultKind.REPLICA_PREEMPT, target=0),
     ])
     ledger = AttributionLedger(None)
     trace = RecordingTrace()
+    forensics = IncidentAssembler(str(tmp_path), trace=trace,
+                                  ledger=ledger)
     compiles = CompileRegistry().install()
     try:
         watcher = CompileWatcher(compiles)
@@ -292,7 +304,7 @@ def test_fleet_preempt_drill_matches_predict_and_reference_streams(params):
                                      drain_grace_ticks=4),
             chaos=FaultInjector(plan), ledger=ledger,
             max_slots=2, max_seq=48, queue_limit=32,
-            compilewatch=watcher,
+            compilewatch=watcher, forensics=forensics,
         )
         fleet.trace = trace
         # 4 requests over 3 replicas × 2 slots: the round-robin router
@@ -348,6 +360,38 @@ def test_fleet_preempt_drill_matches_predict_and_reference_streams(params):
 
         # The block copy never compiled a fresh decode program.
         assert watcher.storm_total == 0
+
+        # -- forensics: the preemption's incident report -------------------
+        assert forensics.counts_by_reason() == {
+            "replica_preempt": predicted["preempts"]}
+        incidents = load_incidents(str(tmp_path))
+        assert len(incidents) == 1
+        inc = incidents[0]
+        assert inc["reason"] == "replica_preempt"
+        assert inc["suspect_replicas"] == [0]
+        assert inc["suspect_journals"] == ["0:0"]
+        # Trigger = the preempted replica's restart transition; the
+        # kv_migration actions reconcile EXACTLY with predict_fleet().
+        trig = inc["trigger"]
+        assert trig["type"] == "replica_transition"
+        assert trig["replica"] == 0 and trig["reason"] == "preempt"
+        inc_migs = [e for e in inc["actions"]
+                    if e["type"] == "kv_migration"]
+        assert len(inc_migs) == predicted["migrations"] == 2
+        # Counters snapshot at assembly carried the full episode.
+        assert inc["counters"]["preempts"] == predicted["preempts"]
+        assert inc["counters"]["migrations"] == predicted["migrations"]
+        # Blast radius: the requests still in flight at assembly time
+        # are visible through their provisional closed-attempt history
+        # — the two migrated streams' source placements on the
+        # preempted generation — and they are EXACTLY the spanning
+        # records the ledger later reconciled across both journals.
+        assert inc["blast_radius"]["requests"] == sorted(
+            r["request_id"] for r in spanning)
+        for rid in inc["blast_radius"]["requests"]:
+            hows = inc["blast_radius"]["via"][str(rid)]
+            assert any(h.get("journal") == "0:0" for h in hows)
+        assert "0:0" in inc["blast_radius"]["suspect_blocks"]
     finally:
         compiles.uninstall()
 

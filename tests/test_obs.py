@@ -11,6 +11,7 @@ permanently.
 
 import json
 import os
+import re
 import time
 from pathlib import Path
 from types import SimpleNamespace
@@ -1045,3 +1046,85 @@ def test_obs_session_active_plane_artifacts(tmp_path):
     session2.step_timer.finish_step(step=1)
     session2.on_step(1)
     assert session2.anomaly._dets["step_time"].count == 1
+
+
+# ---------------------------------------------------------------------------
+# Incident forensics surface (PR 18)
+# ---------------------------------------------------------------------------
+
+
+def test_forensics_surface_inside_the_lint_perimeter():
+    """Forensics extension: the incident / verdict event types carry
+    full schemas — the emit lint + validate_event cover them like every
+    other type — the ``tddl_incidents_total{reason=}`` /
+    ``tddl_verdicts_total{outcome=}`` counters are literal names the
+    metric-name lint scans with labels from the dashboard vocabulary,
+    and the flight-dump/incident reason strings themselves are pinned
+    to ``contracts.ARTIFACT_REASONS`` by the ``artifact-reason-vocab``
+    rule — repo-wide, no baseline."""
+    from trustworthy_dl_tpu.analysis.contracts import (ARTIFACT_REASONS,
+                                                       KNOWN_METRIC_LABELS)
+
+    assert EVENT_SCHEMAS[EventType.INCIDENT]["fields"] == \
+        ("incident_id", "reason", "path")
+    assert EVENT_SCHEMAS[EventType.VERDICT]["fields"] == \
+        ("kind", "outcome")
+    obs = REPO / "trustworthy_dl_tpu" / "obs"
+    forensics_src = (obs / "forensics.py").read_text()
+    assert '"tddl_incidents_total"' in forensics_src
+    assert 'labels=("reason",)' in forensics_src
+    verdicts_src = (obs / "verdicts.py").read_text()
+    assert '"tddl_verdicts_total"' in verdicts_src
+    assert 'labels=("outcome",)' in verdicts_src
+    assert "reason" in KNOWN_METRIC_LABELS
+    assert "outcome" in KNOWN_METRIC_LABELS
+    # Every reason a producer uses today is registered — and the lint
+    # rule holds the whole perimeter to the vocabulary.
+    assert {"guard_trip", "rollback", "preemption", "slo_breach",
+            "anomaly", "compile_storm", "replica_quarantine",
+            "replica_preempt", "adapter_quarantine",
+            "migration_refused", "drill", "manual"} <= ARTIFACT_REASONS
+    assert _lint_package("artifact-reason-vocab") == []
+
+
+@obswatch
+def test_obs_session_pairs_incident_with_flight_dump(tmp_path):
+    """``enable_forensics()``: every flight dump gets a paired
+    ``incident_NNN_<reason>.json`` under the SAME index, assembled from
+    the session's own trace, and the durable VERDICTS.jsonl records the
+    episode — the full cross-plane loop in one session."""
+    from trustworthy_dl_tpu.obs.forensics import load_incidents
+    from trustworthy_dl_tpu.obs.verdicts import VerdictStore
+
+    session = ObsSession(str(tmp_path), registry=MetricsRegistry())
+    forensics = session.enable_forensics()
+    assert session.enable_forensics() is forensics      # idempotent
+    session.open_ledger()                 # order-free: rebinds ledger
+    assert forensics.ledger is session.ledger
+    session.trace.emit(EventType.GUARD_TRIP, step=3, loss=0.0,
+                       grad_norm=0.0, finite_nodes=0)
+    path = session.dump_flight("guard_trip", step=3)
+    m = re.match(r"flight_(\d+)_guard_trip", Path(path).name)
+    assert m, path
+    incidents = load_incidents(str(tmp_path))
+    assert len(incidents) == 1
+    inc = incidents[0]
+    # Paired under the SAME index as the flight dump.
+    assert inc["incident_id"] == f"incident_{m.group(1)}_guard_trip"
+    assert inc["flight_dump"] == path
+    # The trigger resolved from the session's own trace file (the
+    # guard_trip event precedes the dump), not synthetically.
+    assert inc["trigger"]["type"] == "guard_trip"
+    assert not inc["trigger"].get("synthetic")
+    # The incident landed in the durable verdict history with its id,
+    # and the counters registered under the session's registry.
+    store = VerdictStore(str(tmp_path / "VERDICTS.jsonl"))
+    rows = store.read()
+    assert rows and rows[-1]["kind"] == "incident"
+    assert rows[-1]["incident_id"] == inc["incident_id"]
+    reg = session.registry
+    assert reg.counter("tddl_incidents_total", "",
+                       labels=("reason",)).value(reason="guard_trip") == 1
+    assert reg.counter("tddl_verdicts_total", "",
+                       labels=("outcome",)).value(outcome="recorded") == 1
+    session.finalize()

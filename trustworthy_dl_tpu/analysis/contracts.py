@@ -40,6 +40,8 @@ HOST_ONLY_MODULES = (
     "trustworthy_dl_tpu/obs/meta.py",
     "trustworthy_dl_tpu/obs/recorder.py",
     "trustworthy_dl_tpu/obs/registry.py",
+    "trustworthy_dl_tpu/obs/forensics.py",
+    "trustworthy_dl_tpu/obs/verdicts.py",
     "trustworthy_dl_tpu/serve/control.py",
     "trustworthy_dl_tpu/cli.py",
     "trustworthy_dl_tpu/utils/io.py",
@@ -144,6 +146,25 @@ KNOWN_METRIC_LABELS = frozenset({
 #: Metric-name prefix every registered literal must carry (the
 #: Prometheus surface's naming promise).
 METRIC_PREFIX = "tddl_"
+
+#: The flight-dump / incident reason vocabulary.  Incident artifacts
+#: pair with their flight dump and their trigger events BY reason
+#: string — a typo'd reason silently orphans the incident from its
+#: trigger (the timeline renders empty) — so every literal ``reason``
+#: passed to ``dump_flight``/``recorder.dump``/``assemble`` must come
+#: from this registered set.  New episode classes add their reason HERE
+#: first (and to the README catalog), not inline.
+ARTIFACT_REASONS = frozenset({
+    # training supervisor ladder (engine/supervisor.py)
+    "guard_trip", "rollback", "preemption",
+    # watcher-driven dumps (obs/slo.py, anomaly.py, compilewatch.py)
+    "slo_breach", "anomaly", "compile_storm",
+    # fleet forensic episodes (serve/fleet.py)
+    "replica_quarantine", "replica_preempt", "adapter_quarantine",
+    "migration_refused",
+    # operator-initiated artifacts (examples, tests, CLI)
+    "drill", "manual",
+})
 
 #: The adapter-resource locality contract (PR 16): the per-slot adapter
 #: page-table row and the pool's PartitionSpecs each have exactly ONE

@@ -80,6 +80,7 @@ class LintConfig:
         contracts.PREDICT_FUNCTION_PATTERNS
     known_metric_labels: frozenset = contracts.KNOWN_METRIC_LABELS
     metric_prefix: str = contracts.METRIC_PREFIX
+    artifact_reasons: frozenset = contracts.ARTIFACT_REASONS
     adapter_home_module: str = contracts.ADAPTER_HOME_MODULE
     adapter_locality_names: Sequence[str] = contracts.ADAPTER_LOCALITY_NAMES
     package_name: str = "trustworthy_dl_tpu"

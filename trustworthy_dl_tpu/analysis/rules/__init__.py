@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import List
 
 from trustworthy_dl_tpu.analysis.engine import Rule
+from trustworthy_dl_tpu.analysis.rules.artifact import ArtifactReasonRule
 from trustworthy_dl_tpu.analysis.rules.determinism import (
     PredictPurityRule, TickDeterminismRule)
 from trustworthy_dl_tpu.analysis.rules.hygiene import (
@@ -34,6 +35,8 @@ def all_rules() -> List[Rule]:
         ObsEmitRule(),
         MetricPrefixRule(),
         MetricLabelRule(),
+        # artifact contracts
+        ArtifactReasonRule(),
         # determinism
         TickDeterminismRule(),
         PredictPurityRule(),

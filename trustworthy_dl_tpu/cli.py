@@ -152,6 +152,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # percentile sketch still lands in slo_status.json.
         obs_session.enable_spans()
         obs_session.install_watchers(slo_rules=())
+        # Forensics: the supervisor's guard-trip/rollback/preemption
+        # dumps each get a paired incident with the causal ladder.
+        obs_session.enable_forensics()
         # Performance tier: every XLA compile metered + the train-step
         # compile-once contract enforced at runtime, live-HBM watermark
         # gauges, and the perf fingerprint appended at finalize.
@@ -679,6 +682,11 @@ def serve_main(argv: Optional[List[str]] = None,
                                  trace_max_bytes=args.trace_max_bytes)
         obs_session.enable_spans()
         obs_session.open_ledger()
+        # Forensics: every flight-dump-grade episode gets a paired
+        # incident_NNN_<reason>.json (causal timeline + blast radius)
+        # and a durable VERDICTS.jsonl trust-history row — what the
+        # 'trustworthy-dl-obs incident' subcommands render offline.
+        obs_session.enable_forensics()
         # Performance tier: compile watcher (the decode loop's
         # compile-once pin enforced live), HBM watermark gauges + the
         # pool headroom gate, cost ledger + perf fingerprint at exit.
@@ -951,6 +959,7 @@ def _serve_fleet(args, trainer, cfg, serve_config, obs_session) -> int:
         registry=obs_session.registry if obs_session else None,
         spans=obs_session.spans if obs_session else None,
         ledger=obs_session.ledger if obs_session else None,
+        forensics=obs_session.forensics if obs_session else None,
         slo_rules=slo_rules,
         enable_monitor=not args.no_monitor,
         # Performance tier rides every replica build (and rebuild): the
@@ -1068,7 +1077,9 @@ def build_obs_parser() -> argparse.ArgumentParser:
                     "summary of everything the directory holds.  The "
                     "'diff' subcommand (trustworthy-dl-obs diff A B) "
                     "renders two obs_report/perf-ledger artifacts side "
-                    "by side with deltas.",
+                    "by side with deltas; the 'incident' subcommand "
+                    "(trustworthy-dl-obs incident list|show|blast) "
+                    "renders assembled incident forensics.",
     )
     parser.add_argument("obs_dir", type=str,
                         help="directory a run wrote with --obs-dir")
@@ -1109,6 +1120,8 @@ def obs_main(argv: Optional[List[str]] = None) -> int:
         argv = _sys.argv[1:]
     if argv and argv[0] == "diff":
         return _obs_diff(argv[1:])
+    if argv and argv[0] == "incident":
+        return _obs_incident(argv[1:])
     args = build_obs_parser().parse_args(argv)
     if not os.path.isdir(args.obs_dir):
         print(f"no such obs directory: {args.obs_dir}")
@@ -1188,6 +1201,65 @@ def _obs_diff(argv: List[str]) -> int:
     return 0
 
 
+def _obs_incident(argv: List[str]) -> int:
+    """``trustworthy-dl-obs incident list|show|blast`` — render the
+    forensic incident artifacts a run assembled next to its flight
+    dumps (obs/forensics.py; host-only, imports no jax)."""
+    import argparse as _argparse
+
+    from trustworthy_dl_tpu.obs.forensics import (
+        find_incident,
+        load_incidents,
+        render_blast,
+        render_incident,
+    )
+
+    parser = _argparse.ArgumentParser(
+        prog="trustworthy-dl-obs incident",
+        description="Offline incident forensics: 'list' the assembled "
+                    "incident_NNN_<reason>.json reports in a directory, "
+                    "'show' one causal timeline (trigger event -> "
+                    "contributing signals -> actions taken, each with "
+                    "trace seq ids), or 'blast' one blast radius (every "
+                    "request that decoded off the suspect's KV blocks "
+                    "or adapter page, with per-journal block sets).",
+    )
+    parser.add_argument("action", choices=("list", "show", "blast"))
+    parser.add_argument("ident", nargs="?", default=None,
+                        help="incident id, bare index, or reason "
+                             "substring (show/blast)")
+    parser.add_argument("--dir", dest="directory", default=".",
+                        help="directory holding the incident artifacts "
+                             "(an obs dir or a checkpoint dir; "
+                             "default: cwd)")
+    args = parser.parse_args(argv)
+    if args.action == "list":
+        incidents = load_incidents(args.directory)
+        if not incidents:
+            print(f"no incident artifacts under {args.directory}")
+            return 0
+        for inc in incidents:
+            radius = inc.get("blast_radius") or {}
+            print(f"{inc.get('incident_id'):<40} "
+                  f"tick={str(inc.get('tick')):<6} "
+                  f"suspects={inc.get('suspect_replicas')} "
+                  f"actions={len(inc.get('actions') or [])} "
+                  f"blast={len(radius.get('requests') or [])}")
+        return 0
+    if args.ident is None:
+        print(f"incident {args.action}: an incident id (or index, or "
+              f"reason substring) is required")
+        return 2
+    inc = find_incident(args.directory, args.ident)
+    if inc is None:
+        print(f"no incident matching {args.ident!r} under "
+              f"{args.directory}")
+        return 2
+    print(render_incident(inc) if args.action == "show"
+          else render_blast(inc))
+    return 0
+
+
 def _print_slo_status(obs_dir: str) -> None:
     import json
     import os
@@ -1258,6 +1330,26 @@ def _print_obs_summary(obs_dir: str, events: list) -> None:
                    if p.startswith("flight_") and p.endswith(".json"))
     if dumps:
         print(f"flight dumps: {', '.join(dumps)}")
+    incidents = sorted(p for p in os.listdir(obs_dir)
+                       if p.startswith("incident_")
+                       and p.endswith(".json"))
+    if incidents:
+        print(f"incidents: {', '.join(incidents)} "
+              f"(render with 'trustworthy-dl-obs incident "
+              f"list --dir {obs_dir}')")
+    verdicts_path = os.path.join(obs_dir, "VERDICTS.jsonl")
+    if os.path.exists(verdicts_path):
+        from trustworthy_dl_tpu.obs.verdicts import VerdictStore
+
+        rows = VerdictStore(verdicts_path).read()
+        kinds: dict = {}
+        for row in rows:
+            key = f"{row.get('kind')}:{row.get('outcome')}"
+            kinds[key] = kinds.get(key, 0) + 1
+        print(f"VERDICTS.jsonl: {len(rows)} row(s)"
+              + (" — " + ", ".join(f"{k}={n}" for k, n in
+                                   sorted(kinds.items()))
+                 if kinds else ""))
 
 
 if __name__ == "__main__":

@@ -301,8 +301,14 @@ class TrainingSupervisor:
             self.obs.dump_flight(
                 "rollback", step=trainer.global_step,
                 directory=trainer.config.checkpoint_dir,
+                # The ladder's position travels with the artifact: the
+                # paired forensic incident reconciles these against the
+                # supervisor_* events without re-deriving the streak.
                 extra={"bad_step": bad_step,
-                       "restored_step": trainer.global_step},
+                       "restored_step": trainer.global_step,
+                       "rollbacks": self.rollbacks,
+                       "retries": self.retries,
+                       "bad_steps": self.bad_steps},
             )
 
     # -- restart loop ------------------------------------------------------

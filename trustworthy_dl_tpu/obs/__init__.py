@@ -60,6 +60,11 @@ from trustworthy_dl_tpu.obs.events import (
     TraceBus,
     read_jsonl_rotated,
 )
+from trustworthy_dl_tpu.obs.forensics import (
+    IncidentAssembler,
+    blast_radius,
+    load_incidents,
+)
 from trustworthy_dl_tpu.obs.hbm import (
     CostLedger,
     HbmMonitor,
@@ -91,6 +96,7 @@ from trustworthy_dl_tpu.obs.spans import (
     SpanTracker,
     chrome_trace_from_events,
 )
+from trustworthy_dl_tpu.obs.verdicts import VERDICT_OUTCOMES, VerdictStore
 
 __all__ = [
     "AnomalyWatcher",
@@ -103,6 +109,7 @@ __all__ = [
     "EwmaDetector",
     "FlightRecorder",
     "HbmMonitor",
+    "IncidentAssembler",
     "MetricsRegistry",
     "ObsSession",
     "P2Quantile",
@@ -115,11 +122,15 @@ __all__ = [
     "StepTimeReporter",
     "StreamingPercentiles",
     "TraceBus",
+    "VERDICT_OUTCOMES",
+    "VerdictStore",
     "analyze_program",
+    "blast_radius",
     "chrome_trace_from_events",
     "default_serve_rules",
     "get_registry",
     "live_buffer_bytes",
+    "load_incidents",
     "mfu_from_throughput",
     "peak_flops_per_chip",
     "perf_fingerprint",

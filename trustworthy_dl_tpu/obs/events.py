@@ -116,6 +116,12 @@ class EventType(str, enum.Enum):
     # Trace-bus housekeeping: the first event of a fresh segment after a
     # size-based rotation names the segment the bus just sealed.
     TRACE_ROTATE = "trace_rotate"
+    # Forensics tier (obs/forensics.py, obs/verdicts.py): an ``incident``
+    # announces the assembled post-mortem artifact for a flight-dump-
+    # grade episode; a ``verdict`` announces each durable trust-history
+    # row appended to the VerdictStore.
+    INCIDENT = "incident"
+    VERDICT = "verdict"
 
 
 #: type -> {"requires": base correlation keys, "fields": required extras}.
@@ -253,6 +259,14 @@ EVENT_SCHEMAS: Dict[EventType, Dict[str, tuple]] = {
     },
     EventType.TRACE_ROTATE: {"requires": (),
                              "fields": ("path", "segment")},
+    # Forensics: an incident names the artifact it wrote (path is None
+    # in the in-memory bench mode) and the registered reason that
+    # triggered assembly; a verdict names the (kind, outcome) pair the
+    # VerdictStore recorded — same label the tddl_verdicts_total
+    # counter pages on.
+    EventType.INCIDENT: {"requires": (),
+                         "fields": ("incident_id", "reason", "path")},
+    EventType.VERDICT: {"requires": (), "fields": ("kind", "outcome")},
 }
 
 

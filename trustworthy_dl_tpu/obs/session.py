@@ -101,6 +101,11 @@ class ObsSession:
         self.compiles: Any = None         # obs.compilewatch.CompileRegistry
         self.compilewatch: Any = None     # obs.compilewatch.CompileWatcher
         self.hbm: Any = None              # obs.hbm.HbmMonitor
+        # Forensics tier (None until enabled): the incident assembler
+        # pairs a structured post-mortem with every flight dump; the
+        # verdict store is the durable cross-run trust history.
+        self.forensics: Any = None        # obs.forensics.IncidentAssembler
+        self.verdicts: Any = None         # obs.verdicts.VerdictStore
         if cost_analysis is None:
             cost_analysis = self.obs_dir is not None
         self.cost_ledger: Any = None
@@ -191,6 +196,35 @@ class ObsSession:
             )
         return self.hbm
 
+    def enable_forensics(self, verdict_path: Optional[str] = None,
+                         directory: Optional[str] = None) -> Any:
+        """Attach the incident assembler + durable verdict store.  Each
+        flight dump then gets a paired ``incident_NNN_<reason>.json``
+        assembled from this session's trace/ledger artifacts.  Verdict
+        path resolution mirrors the perf ledger: explicit arg, else
+        ``TDDL_VERDICT_STORE`` (the cross-run trust-history file), else
+        a run-local ``VERDICTS.jsonl`` beside the other artifacts (None
+        ⇒ in-memory incidents only).  Idempotent."""
+        if self.forensics is None:
+            from trustworthy_dl_tpu.obs.forensics import IncidentAssembler
+            from trustworthy_dl_tpu.obs.verdicts import VerdictStore
+
+            if verdict_path is None:
+                verdict_path = os.environ.get("TDDL_VERDICT_STORE") or (
+                    os.path.join(self.obs_dir, "VERDICTS.jsonl")
+                    if self.obs_dir else None
+                )
+            if verdict_path:
+                self.verdicts = VerdictStore(
+                    verdict_path, registry=self.registry, trace=self.trace)
+            self.forensics = IncidentAssembler(
+                directory or self.obs_dir, trace=self.trace,
+                trace_path=self.trace.jsonl_path,
+                ledger=self.ledger, perf_ledger=None,
+                verdicts=self.verdicts, registry=self.registry,
+            )
+        return self.forensics
+
     def open_ledger(self, keep: int = 4096) -> Any:
         """Open the per-request attribution ledger (JSONL beside the
         trace when ``obs_dir`` is set; in-memory ring otherwise)."""
@@ -201,6 +235,10 @@ class ObsSession:
                 os.path.join(self.obs_dir, "attribution.jsonl")
                 if self.obs_dir else None, keep=keep,
             )
+            if self.forensics is not None:
+                # Enable order is free: a ledger opened after forensics
+                # still feeds blast-radius computation.
+                self.forensics.ledger = self.ledger
         return self.ledger
 
     # -- cadence hooks -----------------------------------------------------
@@ -247,6 +285,11 @@ class ObsSession:
         # announcement but the trace records where it went.
         self.trace.emit(EventType.FLIGHT_DUMP, step=step, path=path,
                         reason=reason)
+        if self.forensics is not None:
+            # The paired post-mortem: same index as the flight dump,
+            # assembled from whatever the trace has recorded so far.
+            self.forensics.assemble(reason, step=step, flight_path=path,
+                                    directory=directory, extra=extra)
         return path
 
     def write_report(self) -> Optional[Dict[str, Any]]:

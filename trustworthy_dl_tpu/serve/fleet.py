@@ -459,6 +459,7 @@ class ServingFleet:
                  rng: Optional[jax.Array] = None,
                  engine_factory: Optional[Callable[..., Any]] = None,
                  slo_rules: Any = None,
+                 forensics: Any = None,
                  **engine_kwargs: Any):
         self.config = fleet_config or FleetConfig(
             num_replicas=num_replicas or 2)
@@ -469,6 +470,16 @@ class ServingFleet:
         self.trace = trace
         self.spans = spans
         self.ledger = ledger
+        # Forensics (obs/forensics.py): quarantines, adapter impounds,
+        # preemptions and full-walk migration refusals each assemble an
+        # incident; the assembler's VerdictStore (when it has one) gets
+        # the durable suspicion/vote/quarantine history rows.
+        self.forensics = forensics
+        self.verdicts = getattr(forensics, "verdicts", None) \
+            if forensics is not None else None
+        #: Per-destination refusals of the LAST failed _live_migrate
+        #: walk (diagnostics + the migration_refused incident payload).
+        self._last_migration_refusals: List[Dict[str, Any]] = []
         self._params = params
         self._cfg = cfg
         self._engine_kwargs = dict(engine_kwargs)
@@ -1232,6 +1243,8 @@ class ServingFleet:
             # Quarantined = already drained empty: nothing to move, and
             # preemption must not launder the cool-off (crash parity).
             rep.engine = None
+            self._forensic_incident("replica_preempt", rep=rep,
+                                    trigger_type="replica_transition")
             return
         self._migrate(rep, rep.engine.queued_ids,
                       status="migrated", reason="preempt")
@@ -1254,10 +1267,17 @@ class ServingFleet:
             rep.cooloff_until = self.tick + rep.cooloff_ticks
             rep.engine = None
             self._transition(rep, ReplicaState.QUARANTINED, "preempt")
+            self._forensic_incident("replica_preempt", rep=rep,
+                                    trigger_type="replica_transition")
             return
         rep.engine = None
         rep.warm_until = self.tick + self.config.restart_ticks
         self._transition(rep, ReplicaState.RESTARTING, "preempt")
+        # Assembled AFTER the transition so the incident's counters
+        # snapshot carries the full episode (preempt + migrations) and
+        # its actions include every kv_migration just emitted.
+        self._forensic_incident("replica_preempt", rep=rep,
+                                trigger_type="replica_transition")
 
     # -- control plane: floods, class dispatch, autoscaling ----------------
 
@@ -1799,6 +1819,45 @@ class ServingFleet:
 
     # -- supervision -------------------------------------------------------
 
+    def _forensic_incident(self, reason: str, *,
+                           rep: Optional[_Replica] = None,
+                           adapter: Optional[str] = None,
+                           tenant: Optional[str] = None,
+                           trigger_type: Optional[str] = None,
+                           refusals: Optional[List[Dict[str, Any]]] = None,
+                           extra: Optional[Dict[str, Any]] = None) -> None:
+        """Assemble one forensic incident for a fleet episode (no-op
+        without an attached assembler).  The counters snapshot is taken
+        HERE — after every counter the episode bumped — so drill
+        assertions can reconcile the incident against
+        ``predict_fleet()`` exactly."""
+        if self.forensics is None:
+            return
+        records = list(self.ledger.records()) \
+            if self.ledger is not None else []
+        # Ledger records land at RETIREMENT — a mid-episode blast
+        # radius must also see the requests still in flight (a
+        # preemption's migrated streams, a drain's survivors), so open
+        # requests contribute a provisional record built from their
+        # closed-attempt history.  The journal/block placements in
+        # ``rec.closed`` are the same dicts the final ledger record
+        # will carry.
+        for fid, rec in self.requests.items():
+            if not rec.done and rec.closed:
+                records.append({"request_id": fid, "admitted": True,
+                                "status": "in_flight",
+                                "attempts": list(rec.closed),
+                                "provisional": True})
+        self.forensics.assemble(
+            reason, tick=self.tick,
+            suspects=[rep.index] if rep is not None else None,
+            suspect_journals=[rep.journal_key] if rep is not None else (),
+            adapter=adapter, tenant=tenant, trigger_type=trigger_type,
+            counters=dict(self.counters),
+            records=records,
+            refusals=refusals, extra=extra,
+        )
+
     def _transition(self, rep: _Replica, to: ReplicaState,
                     reason: str) -> None:
         if rep.state is to:
@@ -1819,6 +1878,18 @@ class ServingFleet:
                             replica=rep.index, from_state=frm.value,
                             to_state=to.value, reason=reason,
                             tick=self.tick)
+        if to is ReplicaState.QUARANTINED:
+            # The quarantine is the flight-dump-grade verdict: durable
+            # history row + full forensic incident (trigger = the
+            # transition just emitted; blast radius = every request
+            # that decoded off this generation's blocks).
+            if self.verdicts is not None:
+                self.verdicts.append("quarantine", "quarantined",
+                                     replica=rep.index, reason=reason,
+                                     tick=self.tick)
+            self._forensic_incident("replica_quarantine", rep=rep,
+                                    trigger_type="replica_transition",
+                                    extra={"transition_reason": reason})
 
     def _migrate(self, rep: _Replica, ids: List[int], status: str,
                  reason: str) -> None:
@@ -1869,8 +1940,11 @@ class ServingFleet:
                 cands = decode
         cands.sort(key=lambda r: (r.state is not ReplicaState.HEALTHY,
                                   r.engine.load, r.index))
+        refusals: List[Dict[str, Any]] = []
         for dst in cands:
             if not can_migrate(rep.engine, dst.engine):
+                refusals.append({"replica": dst.index,
+                                 "reason": "structural_gate"})
                 continue
 
             def commit(new_local: int, _dst: _Replica = dst) -> None:
@@ -1888,6 +1962,8 @@ class ServingFleet:
                 on_token=self._token_forwarder(rec, dst.index),
                 src_journal=f"{rep.index}:{att.gen}",
                 on_commit=commit,
+                on_refuse=lambda why, _d=dst: refusals.append(
+                    {"replica": _d.index, "reason": why}),
             )
             if moved is None:
                 continue
@@ -1904,6 +1980,16 @@ class ServingFleet:
             # suppress the destination's next token.
             self._process_terminals()
             return True
+        # Full walk refused: every ranked destination either failed the
+        # structural gate or refused the claim (or the source had
+        # nothing migratable).  The caller falls back to replay; the
+        # incident records WHO refused and WHY, per destination.
+        self._last_migration_refusals = refusals
+        if refusals:
+            self._forensic_incident(
+                "migration_refused", rep=rep, refusals=refusals,
+                trigger_type="replica_transition",
+                extra={"request_id": fid, "migrate_reason": reason})
         return False
 
     def _start_trust_drain(self, rep: _Replica, reason: str) -> None:
@@ -1949,6 +2035,11 @@ class ServingFleet:
                     # a still-poisoned replica re-flags and goes back
                     # with a doubled cool-off.
                     self.counters["readmissions"] += 1
+                    if self.verdicts is not None:
+                        self.verdicts.append(
+                            "quarantine", "readmitted",
+                            replica=rep.index,
+                            reason="readmission_probe", tick=self.tick)
                     # Any vote straggler from the PRE-quarantine
                     # generation dies with the evidence window: the
                     # probe must be judged on fresh behaviour only.
@@ -2186,6 +2277,15 @@ class ServingFleet:
                             reason=reason,
                             flag_rate=round(flag_rate, 4),
                             tick=self.tick)
+        if self.verdicts is not None:
+            self.verdicts.append("adapter_quarantine", "quarantined",
+                                 adapter=adapter, reason=reason,
+                                 tick=self.tick)
+        # The blast radius is adapter-keyed: every request that decoded
+        # through the convicted artifact's page, on any replica.
+        self._forensic_incident("adapter_quarantine", adapter=adapter,
+                                trigger_type="adapter_quarantine",
+                                extra={"flag_rate": round(flag_rate, 4)})
 
     def release_adapter_quarantine(self, adapter: str) -> None:
         """Operator-driven readmission of a quarantined adapter: clears
@@ -2241,6 +2341,10 @@ class ServingFleet:
                                 reason=reason,
                                 flag_rate=round(rep.flag_rate, 4),
                                 tick=self.tick)
+            if self.verdicts is not None:
+                self.verdicts.append("suspicion", "opened",
+                                     replica=rep.index, reason=reason,
+                                     tick=self.tick)
         elif (rep.suspicion_episode
               and rep.suspicion < cfg.suspicion_threshold / 2.0
               and rep.outvotes == 0):
@@ -2254,6 +2358,10 @@ class ServingFleet:
             # signal-quiet while still corrupting tokens, wait out the
             # EWMA decay, and never face the deciding vote.
             rep.suspicion_episode = False
+            if self.verdicts is not None:
+                self.verdicts.append("suspicion", "closed",
+                                     replica=rep.index, reason=reason,
+                                     tick=self.tick)
 
     # -- cross-replica verdict voting --------------------------------------
 
@@ -2397,6 +2505,9 @@ class ServingFleet:
                             replica=vote.target, outcome=outcome,
                             agree=len(agree), dissent=len(top_dissent),
                             outvotes=rep.outvotes, tick=self.tick)
+        if self.verdicts is not None:
+            self.verdicts.append("vote", outcome, replica=vote.target,
+                                 request_id=vote.fid, tick=self.tick)
 
     # -- retries + hedges --------------------------------------------------
 

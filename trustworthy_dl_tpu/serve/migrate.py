@@ -137,6 +137,7 @@ def migrate_request(src_engine: Any, dst_engine: Any, local_id: int, *,
                     on_token: Optional[Callable[[int, int], None]] = None,
                     src_journal: Optional[str] = None,
                     on_commit: Optional[Callable[[int], None]] = None,
+                    on_refuse: Optional[Callable[[str], None]] = None,
                     ) -> Optional[Dict[str, Any]]:
     """Two-phase live migration of one in-flight request.
 
@@ -155,15 +156,24 @@ def migrate_request(src_engine: Any, dst_engine: Any, local_id: int, *,
     is threaded into the destination's attribution record as
     ``migrated_from`` so ``verify_attribution`` can reconcile the
     source-side block provenance without flagging the release.
+
+    ``on_refuse`` is invoked with the refusal class
+    (``"src_not_migratable"`` / ``"claim_refused"``) just before each
+    ``None`` return — the fleet's forensic incident records capture
+    per-destination refusals through it.
     """
     snap = src_engine.export_request(local_id)
     if snap is None:
+        if on_refuse is not None:
+            on_refuse("src_not_migratable")
         return None
     task = snap["task"]
     src_ids = list(snap["block_ids"])
     claim = dst_engine.scheduler.claim_migration(len(src_ids),
                                                 task.adapter)
     if claim is None:
+        if on_refuse is not None:
+            on_refuse("claim_refused")
         return None
     _copy_pools(src_engine.scheduler, dst_engine.scheduler,
                 src_ids, claim["block_ids"])
