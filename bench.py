@@ -48,6 +48,9 @@ copy vs prompt replay + unified vs disaggregated prefill/decode pools
 under a bimodal prompt mix, "migrate" record key whose
 migration_fraction feeds the sentinel fingerprint,
 TDDL_BENCH_MIGRATE_* knobs),
+TDDL_BENCH_SHARD=1 (equal-chip replicated vs FSDP train state:
+tokens/s, per-device HBM watermark, params/opt bytes per device from
+the placed shardings — ratio near 1/shards; TDDL_BENCH_SHARD_* knobs),
 TDDL_BENCH_FLEET=1 (serving-fleet goodput-under-SLO vs offered load,
 chaos OFF vs ON over identical seeded workloads — "fleet" record key,
 TDDL_BENCH_FLEET_* knobs), TDDL_BENCH_ADVERSARY=1 (goodput under an
@@ -1432,6 +1435,122 @@ def bench_migrate() -> "dict":
     }
 
 
+def bench_shard() -> "dict":
+    """Equal-chip sharded-train-state A/B (TDDL_BENCH_SHARD=1):
+    replicated vs FSDP train state on the SAME chips and the same
+    seeded batch.  Both arms run the identical jitted step; the FSDP
+    arm turns on ``TrainingConfig.shard_params`` (+ opt-state
+    sharding), so params and optimizer moments live ZeRO-sharded over
+    the data axis via the core/sharding registry and GSPMD gathers per
+    layer.  Reported per arm: tokens/s, the per-device HBM watermark
+    (obs/hbm.py live-buffer sweep while the arm's state is still
+    resident), and ``params_bytes_per_device``/``opt_bytes_per_device``
+    measured from the placed shardings (core/sharding.
+    tree_bytes_per_device) — bytes the registry actually returned to
+    the budget, not an estimate.  The headline ``params_bytes_ratio``
+    (fsdp / replicated) must sit near 1/shards.
+
+    Env: TDDL_BENCH_SHARD_MODEL (gpt2), TDDL_BENCH_SHARD_NODES (device
+    count), TDDL_BENCH_SHARD_BATCH (per-node, 4), TDDL_BENCH_SHARD_SEQ
+    (256), TDDL_BENCH_SHARD_STEPS (8), TDDL_BENCH_SHARD_WARMUP (2)."""
+    import jax
+    import numpy as np
+
+    from trustworthy_dl_tpu.core import sharding as shreg
+    from trustworthy_dl_tpu.core.config import TrainingConfig
+    from trustworthy_dl_tpu.engine import DistributedTrainer
+    from trustworthy_dl_tpu.obs.hbm import HbmMonitor
+
+    model = os.environ.get("TDDL_BENCH_SHARD_MODEL", "gpt2")
+    num_nodes = int(os.environ.get("TDDL_BENCH_SHARD_NODES",
+                                   str(jax.device_count())))
+    per_node_batch = int(os.environ.get("TDDL_BENCH_SHARD_BATCH", "4"))
+    seq_len = int(os.environ.get("TDDL_BENCH_SHARD_SEQ", "256"))
+    steps = int(os.environ.get("TDDL_BENCH_SHARD_STEPS", "8"))
+    warmup = int(os.environ.get("TDDL_BENCH_SHARD_WARMUP", "2"))
+    tokens_per_step = num_nodes * per_node_batch * seq_len
+
+    def run_arm(shard: bool) -> "dict":
+        config = TrainingConfig(
+            model_name=model,
+            dataset_name="openwebtext",
+            batch_size=num_nodes * per_node_batch,
+            num_nodes=num_nodes,
+            learning_rate=1e-4,
+            checkpoint_interval=10 ** 9,
+            attack_detection_enabled=False,
+            gradient_verification_enabled=False,
+            parallelism="data",
+            shard_params=shard,
+            shard_opt_state=shard,
+        )
+        overrides: dict = {}
+        if model.startswith("gpt"):
+            overrides["seq_len"] = seq_len
+            if seq_len > 1024:
+                overrides["n_positions"] = seq_len
+        trainer = DistributedTrainer(config, model_overrides=overrides)
+        trainer.initialize()
+        state = trainer.state
+        batch = trainer._node_batch(jax.tree_util.tree_map(
+            np.asarray,
+            trainer.model.example_batch(num_nodes * per_node_batch,
+                                        jax.random.PRNGKey(0)),
+        ))
+        plan = trainer.attack_plan
+        for _ in range(max(warmup, 1)):
+            state, metrics = trainer._train_step(state, batch, plan)
+        jax.block_until_ready(metrics.loss)
+        monitor = HbmMonitor()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer._train_step(state, batch, plan)
+        jax.block_until_ready(metrics.loss)
+        elapsed = time.perf_counter() - t0
+        assert np.isfinite(float(metrics.loss)), "shard arm NaN loss"
+        # Sweep while the arm's state is still resident — the watermark
+        # is the arm's true peak, not a post-teardown floor.
+        monitor.sweep()
+        return {
+            "tokens_per_s": round(steps * tokens_per_step / elapsed, 1)
+            if elapsed > 0 else 0.0,
+            "hbm_watermark_bytes": monitor.watermark_bytes,
+            "params_bytes_per_device":
+                shreg.tree_bytes_per_device(state.params),
+            "opt_bytes_per_device":
+                shreg.tree_bytes_per_device(state.opt_state),
+            "final_loss": round(float(metrics.loss), 4),
+        }
+
+    arms = {}
+    for name, shard in (("replicated", False), ("fsdp", True)):
+        arms[name] = run_arm(shard)
+        log(f"shard {name:10s}: {arms[name]['tokens_per_s']:10.1f} tok/s,"
+            f" params "
+            f"{arms[name]['params_bytes_per_device'] / 2 ** 20:8.1f} "
+            f"MiB/dev, opt "
+            f"{arms[name]['opt_bytes_per_device'] / 2 ** 20:8.1f} MiB/dev")
+
+    repl, fsdp = arms["replicated"], arms["fsdp"]
+    params_ratio = (fsdp["params_bytes_per_device"]
+                    / max(repl["params_bytes_per_device"], 1))
+    opt_ratio = (fsdp["opt_bytes_per_device"]
+                 / max(repl["opt_bytes_per_device"], 1))
+    log(f"shard ratios: params {params_ratio:.3f}, opt {opt_ratio:.3f} "
+        f"(ideal {1.0 / num_nodes:.3f} over {num_nodes} shards)")
+    return {
+        "model": model,
+        "shards": num_nodes,
+        "tokens_per_step": tokens_per_step,
+        "replicated": repl,
+        "fsdp": fsdp,
+        # The headline the A/B exists for: the per-device param bytes
+        # the registry's ZeRO placement returned (ideal = 1/shards).
+        "params_bytes_ratio": round(params_ratio, 4),
+        "opt_bytes_ratio": round(opt_ratio, 4),
+    }
+
+
 def bench_adversary() -> "dict":
     """Goodput-under-attack leg (TDDL_BENCH_ADVERSARY=1): an adaptive
     poisoned replica that corrupts served streams while holding its
@@ -2489,6 +2608,9 @@ def _inner_main() -> None:
     migrate_record = None
     if os.environ.get("TDDL_BENCH_MIGRATE") == "1":
         migrate_record = bench_migrate()
+    shard_record = None
+    if os.environ.get("TDDL_BENCH_SHARD") == "1":
+        shard_record = bench_shard()
     adversary_record = None
     if os.environ.get("TDDL_BENCH_ADVERSARY") == "1":
         adversary_record = bench_adversary()
@@ -2552,6 +2674,8 @@ def _inner_main() -> None:
         record["serve_paged"] = paged_record
     if fleet_record is not None:
         record["fleet"] = fleet_record
+    if shard_record is not None:
+        record["shard"] = shard_record
     if adversary_record is not None:
         record["adversary"] = adversary_record
     if autoscale_record is not None:

@@ -529,7 +529,13 @@ def test_bench_migrate_records(monkeypatch, tmp_path):
     monkeypatch.setenv("TDDL_BENCH_MIGRATE_SLOTS", "2")
     monkeypatch.setenv("TDDL_BENCH_MIGRATE_SEQ", "48")
     monkeypatch.setenv("TDDL_BENCH_MIGRATE_REQUESTS", "6")
-    monkeypatch.setenv("TDDL_BENCH_MIGRATE_RATE", "100")
+    # Effectively-instant arrivals: the replay driver is wall-clock
+    # paced, so at a modest rate the scripted tick-6 preempt races the
+    # arrival schedule (warm jit caches tick faster than requests land
+    # and the preempted replica can be caught mid-prefill, where export
+    # refuses and the loss degrades to a replay failover).  Submitting
+    # everything up front pins the in-flight set the fault hits.
+    monkeypatch.setenv("TDDL_BENCH_MIGRATE_RATE", "100000")
     monkeypatch.setenv("TDDL_BENCH_MIGRATE_BIMODAL", "0.5")
     monkeypatch.setenv("TDDL_BENCH_MIGRATE_LONG_MEDIAN", "16")
     record = bench.bench_migrate()
@@ -556,6 +562,48 @@ def test_bench_migrate_records(monkeypatch, tmp_path):
     assert record["disagg"]["disaggregated"]["migrations"] \
         >= record["disagg"]["disaggregated"]["completed"]
     assert record["migration_fraction"] == 1.0
+
+
+@pytest.mark.shard
+def test_bench_shard_ab_records(monkeypatch):
+    """bench_shard's equal-chip A/B on a tiny model: the FSDP arm's
+    params+opt bytes per device must actually shrink toward 1/shards
+    (measured from the placed shardings, not estimated), both arms must
+    train to a finite loss, and the record carries the HBM watermark
+    keys the perf artifact publishes."""
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(REPO))
+    import bench
+    from trustworthy_dl_tpu.models import gpt2
+
+    tiny = gpt2.GPT2Config(vocab_size=97, n_positions=64, n_layer=2,
+                           n_embd=32, n_head=4, dtype=jnp.float32)
+    monkeypatch.setattr(gpt2.GPT2Config, "from_name",
+                        staticmethod(lambda name, **kw: tiny))
+    monkeypatch.setenv("TDDL_BENCH_SHARD_NODES", "8")
+    monkeypatch.setenv("TDDL_BENCH_SHARD_BATCH", "1")
+    monkeypatch.setenv("TDDL_BENCH_SHARD_SEQ", "32")
+    monkeypatch.setenv("TDDL_BENCH_SHARD_STEPS", "2")
+    monkeypatch.setenv("TDDL_BENCH_SHARD_WARMUP", "1")
+    record = bench.bench_shard()
+    assert record["shards"] == 8
+    assert record["tokens_per_step"] == 8 * 32
+    row_keys = {"tokens_per_s", "hbm_watermark_bytes",
+                "params_bytes_per_device", "opt_bytes_per_device",
+                "final_loss"}
+    for arm in ("replicated", "fsdp"):
+        row = record[arm]
+        assert row_keys <= set(row), (arm, row)
+        assert row["tokens_per_s"] > 0
+        assert row["params_bytes_per_device"] > 0
+        assert row["hbm_watermark_bytes"] > 0
+    # The headline: FSDP's per-device param/opt bytes near 1/shards of
+    # the replicated arm's.  Not every leaf divides by 8 (biases,
+    # layernorm scales stay replicated), so allow the small remainder.
+    assert record["params_bytes_ratio"] <= 1.0 / 8 + 0.15, record
+    assert record["opt_bytes_ratio"] <= 1.0 / 8 + 0.15, record
+    assert record["params_bytes_ratio"] >= 1.0 / 8 - 0.01, record
 
 
 @pytest.mark.fleetctl

@@ -164,6 +164,62 @@ def test_adapter_locality_rule_home_module_and_suppression_clean(tmp_path):
     assert result.findings == []
 
 
+def test_sharding_registry_rule_flags_specs_outside_home(tmp_path):
+    # PR 19: PartitionSpec has ONE spelling site — the logical-axis
+    # registry (core/sharding.py).  Direct calls, ``as P`` aliases, and
+    # attribute spellings elsewhere are all findings; calling the
+    # registry's helpers is the sanctioned path.
+    result = _run(tmp_path, {
+        "trustworthy_dl_tpu/engine/trainer.py": '''\
+            import jax.sharding
+            from jax.sharding import PartitionSpec as P
+            from trustworthy_dl_tpu.core import sharding as shreg
+
+            def place(mesh):
+                a = P("data")                            # aliased ctor
+                b = jax.sharding.PartitionSpec("model")  # attr spelling
+                c = shreg.replicated_spec()              # registry: fine
+                return a, b, c
+            ''',
+    }, rules=["sharding-registry-only"])
+    assert sorted(f.line for f in result.findings) == [6, 7]
+    assert "logical-axis registry" in result.findings[0].message
+
+
+def test_sharding_registry_rule_home_whitelist_and_suppression(tmp_path):
+    # The registry itself and whitelisted modules (adapter home) are
+    # exempt; elsewhere a justified inline suppression still works, and
+    # test trees outside the package are out of scope entirely.
+    result = _run(tmp_path, {
+        "trustworthy_dl_tpu/core/sharding.py": '''\
+            from jax.sharding import PartitionSpec
+
+            def replicated_spec():
+                return PartitionSpec()
+            ''',
+        "trustworthy_dl_tpu/serve/adapters.py": '''\
+            from jax.sharding import PartitionSpec
+
+            def adapter_partition_specs():
+                return PartitionSpec(), PartitionSpec()
+            ''',
+        "trustworthy_dl_tpu/serve/engine.py": '''\
+            from jax.sharding import PartitionSpec as P
+
+            def special_case():
+                # tddl-lint: disable=sharding-registry-only — fixture
+                return P()
+            ''',
+        "tests/test_something.py": '''\
+            from jax.sharding import PartitionSpec
+
+            def test_spec():
+                assert PartitionSpec() is not None
+            ''',
+    }, rules=["sharding-registry-only"])
+    assert result.findings == []
+
+
 # ---------------------------------------------------------------------------
 # determinism
 # ---------------------------------------------------------------------------

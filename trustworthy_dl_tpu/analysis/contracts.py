@@ -177,6 +177,22 @@ ARTIFACT_REASONS = frozenset({
 ADAPTER_HOME_MODULE = "trustworthy_dl_tpu/serve/adapters.py"
 ADAPTER_LOCALITY_NAMES = ("adapter_page_row", "adapter_partition_specs")
 
+#: The sharding-registry locality contract (PR 19): EVERY
+#: ``PartitionSpec(...)`` in the package resolves through the
+#: logical-axis rule table in core/sharding.py — the one place the
+#: logical->mesh axis mapping is spelled.  A PartitionSpec constructed
+#: anywhere else (including under a ``... as P`` alias) bypasses the
+#: registry: it hard-codes a mesh-axis name that the rule table can no
+#: longer retarget, and it forks the layout the compile-once pins and
+#: the elastic migrations key on.  Modules with a sanctioned reason to
+#: spell specs directly are whitelisted HERE, deliberately.
+SHARDING_HOME_MODULE = "trustworthy_dl_tpu/core/sharding.py"
+SHARDING_SPEC_WHITELIST = (
+    # The adapter pool's home module: its spec spellings are already
+    # governed (and scoped) by the adapter-locality rule above.
+    ADAPTER_HOME_MODULE,
+)
+
 #: Default committed baseline of grandfathered findings (repo-relative).
 DEFAULT_BASELINE = "tddl_lint_baseline.json"
 

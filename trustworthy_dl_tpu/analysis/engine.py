@@ -83,6 +83,8 @@ class LintConfig:
     artifact_reasons: frozenset = contracts.ARTIFACT_REASONS
     adapter_home_module: str = contracts.ADAPTER_HOME_MODULE
     adapter_locality_names: Sequence[str] = contracts.ADAPTER_LOCALITY_NAMES
+    sharding_home_module: str = contracts.SHARDING_HOME_MODULE
+    sharding_spec_whitelist: Sequence[str] = contracts.SHARDING_SPEC_WHITELIST
     package_name: str = "trustworthy_dl_tpu"
     #: EventType member names; ``None`` = resolve from the real enum.
     event_members: Optional[frozenset] = None

@@ -21,7 +21,8 @@ from trustworthy_dl_tpu.analysis.rules.hygiene import (
     MutableDefaultRule)
 from trustworthy_dl_tpu.analysis.rules.jit import (HostSyncRule,
                                                    RecompileHazardRule)
-from trustworthy_dl_tpu.analysis.rules.locality import AdapterLocalityRule
+from trustworthy_dl_tpu.analysis.rules.locality import (
+    AdapterLocalityRule, ShardingRegistryRule)
 from trustworthy_dl_tpu.analysis.rules.obs import (MetricLabelRule,
                                                    MetricPrefixRule,
                                                    ObsEmitRule)
@@ -47,6 +48,7 @@ def all_rules() -> List[Rule]:
         HostSyncRule(),
         # resource locality
         AdapterLocalityRule(),
+        ShardingRegistryRule(),
         # hygiene
         MutableDefaultRule(),
         BareExceptRule(),

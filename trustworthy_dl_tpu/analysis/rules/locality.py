@@ -16,7 +16,8 @@ import ast
 from typing import Iterable, Set
 
 from trustworthy_dl_tpu.analysis.engine import (Finding, LintConfig,
-                                                ModuleInfo, Project, Rule)
+                                                ModuleInfo, Project, Rule,
+                                                match_any)
 
 
 def _partition_spec_aliases(module: ModuleInfo) -> Set[str]:
@@ -75,3 +76,44 @@ class AdapterLocalityRule(Rule):
                             f"{node.name}() — adapter sharding is "
                             f"spelled only in "
                             f"{config.adapter_home_module}")
+
+
+class ShardingRegistryRule(Rule):
+    """Every ``PartitionSpec(...)`` resolves through the logical-axis
+    rule table in core/sharding.py (contracts.SHARDING_HOME_MODULE) —
+    a spec constructed anywhere else hard-codes a mesh-axis name the
+    registry can no longer retarget, and forks the layout the
+    compile-once pins and elastic migrations key on.  Catches direct
+    calls, ``... as P`` aliases, and attribute spellings
+    (``jax.sharding.PartitionSpec(...)``); importing the registry's
+    helpers is the sanctioned path and is not flagged."""
+
+    name = "sharding-registry-only"
+    description = ("PartitionSpec construction lives only in "
+                   "core/sharding.py (the logical-axis registry)")
+
+    def applies(self, rel: str, config: LintConfig) -> bool:
+        if rel == config.sharding_home_module:
+            return False
+        if match_any(rel, config.sharding_spec_whitelist):
+            return False
+        return rel.startswith(config.package_name + "/") \
+            or rel == "bench.py"
+
+    def check(self, module: ModuleInfo, project: Project,
+              config: LintConfig) -> Iterable[Finding]:
+        spec_names = _partition_spec_aliases(module)
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            direct = isinstance(node.func, ast.Name) \
+                and node.func.id in spec_names
+            attr = isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "PartitionSpec"
+            if direct or attr:
+                yield self.finding(
+                    module, node,
+                    f"PartitionSpec constructed outside "
+                    f"{config.sharding_home_module} — shardings "
+                    f"resolve through the logical-axis registry "
+                    f"(core.sharding helpers), not ad-hoc specs")

@@ -201,6 +201,13 @@ class TrainingConfig:
     # gathers the params — identical numerics, ~(1 - 1/n_data) of the
     # moment memory reclaimed per chip.
     shard_opt_state: bool = False
+    # FSDP/ZeRO-3-style PARAMETER sharding over the data axis (data
+    # parallelism only), via the same registry rule as shard_opt_state
+    # (core/sharding.py:place_zero_sharded): each weight's first evenly-
+    # divisible dim shards across the data devices and GSPMD gathers it
+    # where the forward needs it — ~1/n_data of the param bytes resident
+    # per chip.  Composes with shard_opt_state; identical numerics.
+    shard_params: bool = False
     # Storage dtype for the optimizer's FIRST moment (optax mu_dtype;
     # SGD's momentum accumulator).  None keeps the parameter dtype (f32);
     # "bfloat16" frees 2 bytes/param.  The second moment stays f32; for
@@ -468,12 +475,20 @@ class ServeConfig:
     adapter_rank: int = 0
     adapter_pool_pages: Optional[int] = None
     adapter_dtype: str = "model"
+    # Tensor-parallel replica width: the engine owns a tp_size-device
+    # submesh over the 'model' axis and the weights carry the model's
+    # registry-declared TP layout (core/sharding.py) — the KV pool's
+    # heads shard with them, so the HBM headroom gate sizes the pool
+    # per SHARD.  1 (default) is byte-for-byte the single-chip engine.
+    tp_size: int = 1
 
     def __post_init__(self) -> None:
         from trustworthy_dl_tpu.quant import validate_dtypes
         from trustworthy_dl_tpu.serve.kv_slots import validate_paged_geometry
 
         validate_dtypes(self.kv_dtype, self.weight_dtype)
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
         if self.attn_impl not in ("auto", "pallas", "interpret", "jnp"):
             # Mirrors ops.paged_attention.ATTN_IMPLS — checked here with
             # a literal so a bad knob fails without touching jax.

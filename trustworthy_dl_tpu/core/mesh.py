@@ -15,7 +15,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 logger = logging.getLogger(__name__)
 
@@ -208,12 +208,18 @@ def bind_mode_mesh(mesh: Mesh, parallelism: str) -> None:
 
 
 def node_sharding(mesh: Mesh, axis: str) -> NamedSharding:
-    """Sharding for a per-node leading-axis array (e.g. [num_nodes, ...])."""
-    return NamedSharding(mesh, P(axis))
+    """Sharding for a per-node leading-axis array (e.g. [num_nodes, ...]).
+    Spec resolution lives in the registry (core/sharding.py — lazy import:
+    the registry imports this module's axis names)."""
+    from trustworthy_dl_tpu.core import sharding as shreg
+
+    return shreg.row_sharding(mesh, axis)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    from trustworthy_dl_tpu.core import sharding as shreg
+
+    return shreg.replicated_sharding(mesh)
 
 
 def local_device_count() -> int:

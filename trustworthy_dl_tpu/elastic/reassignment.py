@@ -31,8 +31,9 @@ from typing import Any, Dict, List, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
+from trustworthy_dl_tpu.core import sharding as shreg
 from trustworthy_dl_tpu.core.mesh import DATA_AXIS, build_mesh
 from trustworthy_dl_tpu.engine.state import MonitorState, TrainState, \
     fleet_scalar_fields
@@ -55,28 +56,22 @@ PER_NODE_FIELDS = ("trust", "out_baseline", "grad_baseline", "verifier",
 
 def row_placer(mesh: jax.sharding.Mesh, axis: str, n: int):
     """The ONE per-node placement rule shared by eviction, readmission and
-    stage restaff: a leaf whose leading axis is the node count shards over
-    ``axis`` (when the mesh carries it evenly), everything else
-    replicates.  Returns (place_row, replicated_sharding)."""
-    axis_size = mesh.shape.get(axis, 1)
-    repl = NamedSharding(mesh, P())
-
-    def place_row(leaf):
-        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n and \
-                axis_size > 1 and n % axis_size == 0:
-            spec = P(axis, *([None] * (leaf.ndim - 1)))
-            return jax.device_put(leaf, NamedSharding(mesh, spec))
-        return jax.device_put(leaf, repl)
-
-    return place_row, repl
+    stage restaff — a thin wrapper over the registry's
+    :func:`core.sharding.row_placer` (the trainer's ``_place_on_mesh``
+    calls the same helper, so evict/readmit reproduces exactly the
+    shardings a fresh trainer would choose).  Returns
+    (place_row, replicated_sharding)."""
+    return shreg.row_placer(mesh, axis, n), shreg.replicated_sharding(mesh)
 
 
 def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
                   n: int, shard_opt: bool,
-                  place_params: bool = True) -> TrainState:
+                  place_params: bool = True,
+                  shard_params: bool = False) -> TrainState:
     """Place a (compacted or expanded) TrainState onto ``mesh``: per-node
     rows shard over ``axis``, params/opt/scalars replicate (opt optionally
-    ZeRO-1-sharded over the data axis).
+    ZeRO-1-sharded, params optionally FSDP-sharded, both over the data
+    axis via the registry's shared ``place_zero_sharded`` rule).
 
     ``place_params=False`` skips the params/opt placement entirely —
     tensor mode passes it because _reapply_mode_shardings immediately
@@ -96,13 +91,20 @@ def migrate_state(state: TrainState, mesh: jax.sharding.Mesh, axis: str,
     )
     if not place_params:
         return state._replace(**per_node, **shared)
-    shared["params"] = jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, repl), state.params
-    )
-    if shard_opt:
-        from trustworthy_dl_tpu.engine.state import zero1_place_opt_state
-
-        shared["opt_state"] = zero1_place_opt_state(state.opt_state, mesh)
+    if shard_params:
+        shared["params"] = shreg.place_zero_sharded(
+            state.params, mesh, DATA_AXIS
+        )
+    else:
+        shared["params"] = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, repl), state.params
+        )
+    if shard_opt or shard_params:
+        # Same registry helper the trainer's _place_on_mesh uses — the
+        # dedupe that guarantees identical shardings after evict/readmit.
+        shared["opt_state"] = shreg.place_zero_sharded(
+            state.opt_state, mesh, DATA_AXIS
+        )
     else:
         shared["opt_state"] = jax.tree_util.tree_map(
             lambda leaf: jax.device_put(leaf, repl), state.opt_state
@@ -273,7 +275,7 @@ def _reapply_mode_shardings(state: TrainState, mesh: jax.sharding.Mesh,
         # opt leaf apply_tp_sharding_to_opt did not cover (step counts,
         # schedule state — not params-shaped) still sits on the OLD mesh;
         # replicate it onto the new one.
-        repl = NamedSharding(mesh, P())
+        repl = shreg.replicated_sharding(mesh)
         opt = jax.tree_util.tree_map(
             lambda leaf: leaf
             if isinstance(getattr(leaf, "sharding", None), NamedSharding)
@@ -345,6 +347,8 @@ def evict_and_reshard(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         and config.parallelism == "data",
         place_params=not _tp_placement_owns_params(config.parallelism,
                                                    new_mesh),
+        shard_params=config.shard_params and data_size > 1
+        and config.parallelism == "data",
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)
@@ -518,6 +522,8 @@ def readmit_and_reshard(trainer, node_ids: Sequence[int]) -> Dict[str, Any]:
         and config.parallelism == "data",
         place_params=not _tp_placement_owns_params(config.parallelism,
                                                    new_mesh),
+        shard_params=config.shard_params and data_size > 1
+        and config.parallelism == "data",
     )
     new_state = _reapply_mode_shardings(new_state, new_mesh,
                                         config.parallelism)

@@ -169,24 +169,13 @@ def zero1_place_opt_state(opt_state: Any, mesh: Any) -> Any:
     partitions the moment update and gathers the applied params, so an
     n-way data mesh keeps only 1/n of the Adam moments per chip (the
     reference has no distributed-memory story at all: every node held a
-    full optimizer copy, distributed_trainer.py:90-91)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    full optimizer copy, distributed_trainer.py:90-91).
 
+    Thin delegate kept for back-compat: the placement rule itself lives
+    in the registry (core/sharding.py:place_zero_sharded), shared with
+    FSDP param placement and elastic migration so no call site can
+    drift."""
+    from trustworthy_dl_tpu.core import sharding as shreg
     from trustworthy_dl_tpu.core.mesh import DATA_AXIS
 
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    n_data = sizes.get(DATA_AXIS, 1)
-    repl = NamedSharding(mesh, P())
-
-    def place(leaf):
-        if getattr(leaf, "ndim", 0) >= 1 and n_data > 1:
-            for axis, dim in enumerate(leaf.shape):
-                if dim >= n_data and dim % n_data == 0:
-                    spec = [None] * leaf.ndim
-                    spec[axis] = DATA_AXIS
-                    return jax.device_put(
-                        leaf, NamedSharding(mesh, P(*spec))
-                    )
-        return jax.device_put(leaf, repl)
-
-    return jax.tree_util.tree_map(place, opt_state)
+    return shreg.place_zero_sharded(opt_state, mesh, DATA_AXIS)

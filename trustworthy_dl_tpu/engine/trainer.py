@@ -25,9 +25,10 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, null_plan
+from trustworthy_dl_tpu.core import sharding as shreg
 from trustworthy_dl_tpu.core.config import NodeConfig, TrainingConfig
 from trustworthy_dl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, \
     bind_mode_mesh, build_mesh
@@ -43,8 +44,7 @@ from trustworthy_dl_tpu.detect.verifier import FleetEpisodeTracker, \
 from trustworthy_dl_tpu.engine.checkpoint import CheckpointManager
 from trustworthy_dl_tpu.engine.optimizer import build_optimizer
 from trustworthy_dl_tpu.engine.state import TrainState, \
-    fleet_scalar_fields, init_train_state, \
-    zero1_place_opt_state
+    fleet_scalar_fields, init_train_state
 from trustworthy_dl_tpu.engine.step import StepMetrics, \
     build_node_eval_step, \
     build_train_step
@@ -311,7 +311,7 @@ class DistributedTrainer:
             num_monitor_leaves = len(
                 jax.tree_util.tree_leaves(params["blocks"])
             )
-            stage_sharding = NamedSharding(self.mesh, P("stage"))
+            stage_sharding = shreg.row_sharding(self.mesh, STAGE_AXIS)
             params["blocks"] = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, stage_sharding), params["blocks"]
             )
@@ -375,11 +375,16 @@ class DistributedTrainer:
         return self.initialize(seed=seed)
 
     def _place_on_mesh(self, state: TrainState) -> TrainState:
-        """Explicit mesh placement of the whole TrainState: per-node rows
-        shard over the node axis ('stage' under pipelining, 'data'
-        otherwise), leaves already laid out on this mesh (stage-stacked
-        blocks, TP params and their optimizer mirrors) keep their
-        shardings, and everything else replicates.
+        """Explicit mesh placement of the whole TrainState, every rule
+        resolved through the sharding registry (core/sharding.py):
+        per-node rows shard over the node axis ('stage' under pipelining,
+        'data' otherwise) via the shared ``row_placer``, ZeRO/FSDP state
+        shards via the shared ``place_zero_sharded``, leaves already laid
+        out on this mesh (stage-stacked blocks, TP params and their
+        optimizer mirrors) keep their shardings, and everything else
+        replicates.  Elastic migration (elastic/reassignment.py) calls
+        the SAME helpers, so an evict/readmit cycle reproduces exactly
+        these shardings.
 
         Freshly-initialised arrays would otherwise sit uncommitted on
         device 0 — fine for the first jitted step (GSPMD replicates them),
@@ -392,9 +397,8 @@ class DistributedTrainer:
         node_axis = STAGE_AXIS if self.config.parallelism == "model" else \
             DATA_AXIS
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        axis_size = sizes.get(node_axis, 1)
         n = self.config.num_nodes
-        repl = NamedSharding(mesh, P())
+        repl = shreg.replicated_sharding(mesh)
 
         def keep_or_repl(leaf):
             sh = getattr(leaf, "sharding", None)
@@ -402,12 +406,7 @@ class DistributedTrainer:
                 return leaf  # already mesh-placed (stage/TP layouts)
             return jax.device_put(leaf, repl)
 
-        def place_row(leaf):
-            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n \
-                    and axis_size > 1 and n % axis_size == 0:
-                spec = P(node_axis, *([None] * (leaf.ndim - 1)))
-                return jax.device_put(leaf, NamedSharding(mesh, spec))
-            return jax.device_put(leaf, repl)
+        place_row = shreg.row_placer(mesh, node_axis, n)
 
         per_node = dict(
             trust=state.trust, out_baseline=state.out_baseline,
@@ -419,14 +418,24 @@ class DistributedTrainer:
             per_node["canary"] = state.canary
         placed = {k: jax.tree_util.tree_map(place_row, v)
                   for k, v in per_node.items()}
-        if self.config.shard_opt_state and \
-                self.config.parallelism == "data" and \
-                sizes.get(DATA_AXIS, 1) > 1:
-            opt_state = zero1_place_opt_state(state.opt_state, mesh)
+        data_sharded = self.config.parallelism == "data" and \
+            sizes.get(DATA_AXIS, 1) > 1
+        if self.config.shard_params and data_sharded:
+            # FSDP: weights shard over the data axis by the same registry
+            # rule as the moments; GSPMD gathers per-layer where needed.
+            params = shreg.place_zero_sharded(state.params, mesh, DATA_AXIS)
+        else:
+            params = jax.tree_util.tree_map(keep_or_repl, state.params)
+        if data_sharded and (self.config.shard_opt_state
+                             or self.config.shard_params):
+            # ZeRO-1 (and FSDP, which subsumes it): one shared spelling
+            # with elastic migration — see place_zero_sharded.
+            opt_state = shreg.place_zero_sharded(state.opt_state, mesh,
+                                                 DATA_AXIS)
         else:
             opt_state = jax.tree_util.tree_map(keep_or_repl, state.opt_state)
         shared = {
-            "params": jax.tree_util.tree_map(keep_or_repl, state.params),
+            "params": params,
             "opt_state": opt_state,
         }
         scalars = jax.tree_util.tree_map(
@@ -447,19 +456,8 @@ class DistributedTrainer:
             return plan
         node_axis = STAGE_AXIS if self.config.parallelism == "model" else \
             DATA_AXIS
-        axis_size = dict(
-            zip(mesh.axis_names, mesh.devices.shape)
-        ).get(node_axis, 1)
-        n = self.config.num_nodes
-        repl = NamedSharding(mesh, P())
-
-        def place(leaf):
-            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n \
-                    and axis_size > 1 and n % axis_size == 0:
-                spec = P(node_axis, *([None] * (leaf.ndim - 1)))
-                return jax.device_put(leaf, NamedSharding(mesh, spec))
-            return jax.device_put(leaf, repl)
-
+        # Same registry rule as the TrainState's per-node rows.
+        place = shreg.row_placer(mesh, node_axis, self.config.num_nodes)
         return jax.tree_util.tree_map(place, plan)
 
     def set_attack_plan(self, plan: AttackPlan,
@@ -638,9 +636,8 @@ class DistributedTrainer:
             zip(self.mesh.axis_names, self.mesh.devices.shape)
         ).get(DATA_AXIS, 1)
         if data_size > 1 and rows % data_size == 0:
-            sharding = NamedSharding(
-                self.mesh, P(DATA_AXIS, *([None] * (reshaped.ndim - 1)))
-            )
+            sharding = shreg.row_sharding(self.mesh, DATA_AXIS,
+                                          reshaped.ndim)
             return jax.device_put(reshaped, sharding)
         return jnp.asarray(reshaped)
 
@@ -853,7 +850,7 @@ class DistributedTrainer:
                 # first step of every post-adjustment epoch (caught by
                 # the compile watcher's train_step guard).
                 threshold = jax.device_put(
-                    threshold, NamedSharding(self.mesh, P())
+                    threshold, shreg.replicated_sharding(self.mesh)
                 )
             self.state = self.state._replace(
                 trust=self.state.trust._replace(threshold=threshold)

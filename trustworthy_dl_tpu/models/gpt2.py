@@ -250,6 +250,38 @@ def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
     }
 
 
+def logical_axes() -> Params:
+    """The model's sharding declaration — named once, HERE, and resolved
+    per parallelism mode by the registry (core/sharding.py).  Each leaf
+    is a tuple of logical axis names, one per dim of the matching param
+    (blocks carry the stacked ``layer`` leading dim).  Megatron layout:
+    qkv/fc shard their output dim (column parallel, ``w_tp``), the two
+    proj weights shard their input dim (row parallel) so the pair needs
+    one all-reduce; col-parallel biases shard, row-parallel biases and
+    norms/embeddings replicate."""
+    from trustworthy_dl_tpu.core import sharding as shreg
+
+    LYR, HID, TP = shreg.LAYER, shreg.HIDDEN, shreg.W_TP
+    block = {
+        "ln_1": {"scale": (LYR, HID), "bias": (LYR, HID)},
+        "attn": {
+            "qkv": {"w": (LYR, HID, TP), "b": (LYR, TP)},
+            "proj": {"w": (LYR, TP, HID), "b": (LYR, HID)},
+        },
+        "ln_2": {"scale": (LYR, HID), "bias": (LYR, HID)},
+        "mlp": {
+            "fc": {"w": (LYR, HID, TP), "b": (LYR, TP)},
+            "proj": {"w": (LYR, TP, HID), "b": (LYR, HID)},
+        },
+    }
+    return {
+        "wte": (None, HID),
+        "wpe": (None, HID),
+        "blocks": block,
+        "ln_f": {"scale": (HID,), "bias": (HID,)},
+    }
+
+
 # --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------

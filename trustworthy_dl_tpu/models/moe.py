@@ -61,12 +61,13 @@ def use_expert_mesh(mesh):
 
 
 def _expert_sharding():
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from trustworthy_dl_tpu.core import sharding as shreg
 
     mesh = _EXPERT_MESH
     if mesh is None or EXPERT_AXIS not in mesh.axis_names:
         return None
-    return NamedSharding(mesh, P(EXPERT_AXIS, None, None))
+    return shreg.rules_for("expert").named_sharding(
+        mesh, shreg.EXPERT, None, None)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -370,14 +371,17 @@ def moe_ep_specs(params: Params):
     """PartitionSpec tree for expert parallelism: expert-dim arrays shard on
     'expert' (leading axis after the stacked-layer axis), everything else
     replicated.  Feed to NamedSharding/device_put like gpt2_tp_specs."""
-    from jax.sharding import PartitionSpec as P
+    from trustworthy_dl_tpu.core import sharding as shreg
+
+    rules = shreg.rules_for("expert")
 
     def spec(path, leaf):
         keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
         if "moe" in keys and "router" not in keys:
             # [L, E, ...]: layer axis replicated, expert axis sharded.
-            return P(None, EXPERT_AXIS, *([None] * (leaf.ndim - 2)))
-        return P()
+            return rules.partition_spec(
+                shreg.LAYER, shreg.EXPERT, *([None] * (leaf.ndim - 2)))
+        return rules.partition_spec()
 
     return jax.tree_util.tree_map_with_path(spec, params)
 

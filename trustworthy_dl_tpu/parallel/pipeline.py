@@ -34,7 +34,9 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from trustworthy_dl_tpu.core import sharding as shreg
 
 from trustworthy_dl_tpu.attacks.adversarial import AttackPlan, \
     corrupt_stage_compute, poison_gradients
@@ -56,6 +58,10 @@ from trustworthy_dl_tpu.models import layers as L
 from trustworthy_dl_tpu.trust import state as ts
 
 Array = jax.Array
+
+#: Registry rules for pipeline mode ("model"): the stage axis carries
+#: the trust nodes, microbatch rows shard over the DP replica rows.
+_PP_RULES = shreg.rules_for("model")
 
 
 def stack_stages(blocks: Any, num_stages: int) -> Any:
@@ -248,9 +254,12 @@ def build_pipeline_apply(
         mesh=mesh,
         # mb (dim 1 of x_mb / outputs) shards over the DP replica rows; on
         # the (1, S) mesh the spec degenerates to full replication.
-        in_specs=(P(STAGE_AXIS), P(None, DATA_AXIS)),
-        out_specs=(P(None, DATA_AXIS), P(STAGE_AXIS), P(STAGE_AXIS),
-                   P(STAGE_AXIS)),
+        in_specs=(_PP_RULES.partition_spec(shreg.STAGE),
+                  _PP_RULES.partition_spec(None, shreg.BATCH)),
+        out_specs=(_PP_RULES.partition_spec(None, shreg.BATCH),
+                   _PP_RULES.partition_spec(shreg.STAGE),
+                   _PP_RULES.partition_spec(shreg.STAGE),
+                   _PP_RULES.partition_spec(shreg.STAGE)),
         check_vma=False,
     )
     return pipe

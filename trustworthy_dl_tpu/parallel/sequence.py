@@ -30,10 +30,16 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
+from trustworthy_dl_tpu.core import sharding as shreg
 from trustworthy_dl_tpu.core.mesh import SEQ_AXIS, \
     shard_map_compat as shard_map
+
+#: Registry rules for this mode: the Ulysses exchange is exactly the
+#: head<->seqlen logical rename the table encodes (both map onto the
+#: 'seq' mesh axis).
+_SP_RULES = shreg.rules_for("sequence")
 from trustworthy_dl_tpu.models.gpt2 import full_attention, register_attention
 
 _SEQ_MESH: Optional[Mesh] = None
@@ -79,8 +85,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     mesh = get_sequence_mesh()
     if mesh is None:
         return full_attention(q, k, v, causal)
-    heads_sharded = NamedSharding(mesh, P(None, SEQ_AXIS, None, None))
-    seq_sharded = NamedSharding(mesh, P(None, None, SEQ_AXIS, None))
+    heads_sharded = _SP_RULES.named_sharding(
+        mesh, None, shreg.HEAD, None, None)
+    seq_sharded = _SP_RULES.named_sharding(
+        mesh, None, None, shreg.SEQLEN, None)
     q, k, v = (jax.lax.with_sharding_constraint(a, heads_sharded)
                for a in (q, k, v))
     out = full_attention(q, k, v, causal)
@@ -240,7 +248,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ring_size = dict(zip(mesh.axis_names, mesh.devices.shape))[SEQ_AXIS]
     if q.shape[2] % ring_size:
         return full_attention(q, k, v, causal)
-    spec = P(None, None, SEQ_AXIS, None)
+    spec = _SP_RULES.partition_spec(None, None, shreg.SEQLEN, None)
     fn = shard_map(
         lambda q_, k_, v_: _ring_attention_local(q_, k_, v_, causal, ring_size),
         mesh=mesh,

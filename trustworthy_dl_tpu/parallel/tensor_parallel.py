@@ -22,42 +22,26 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from trustworthy_dl_tpu.core import sharding as shreg
 from trustworthy_dl_tpu.core.mesh import MODEL_AXIS
 
 Params = Dict[str, Any]
 
+#: One rule table for the whole module: the TP layout is the model's
+#: logical declaration (models/gpt2.py:logical_axes) resolved under the
+#: "tensor" rules — no PartitionSpec is spelled here.
+_TP_RULES = shreg.rules_for("tensor")
+
 
 def gpt2_tp_specs(params: Params) -> Params:
-    """PartitionSpec tree for GPT-2 params (blocks have a leading stacked
-    layer axis, hence the leading None)."""
+    """PartitionSpec tree for GPT-2 params: the model's logical-axis
+    declaration resolved through the registry (blocks have a leading
+    stacked layer axis)."""
+    from trustworthy_dl_tpu.models.gpt2 import logical_axes
 
-    def spec_for_block():
-        return {
-            "ln_1": {"scale": P(None, None), "bias": P(None, None)},
-            "attn": {
-                "qkv": {"w": P(None, None, MODEL_AXIS),
-                        "b": P(None, MODEL_AXIS)},
-                "proj": {"w": P(None, MODEL_AXIS, None),
-                         "b": P(None, None)},
-            },
-            "ln_2": {"scale": P(None, None), "bias": P(None, None)},
-            "mlp": {
-                "fc": {"w": P(None, None, MODEL_AXIS),
-                       "b": P(None, MODEL_AXIS)},
-                "proj": {"w": P(None, MODEL_AXIS, None),
-                         "b": P(None, None)},
-            },
-        }
-
-    specs: Params = {
-        "wte": P(None, None),
-        "wpe": P(None, None),
-        "blocks": spec_for_block(),
-        "ln_f": {"scale": P(None), "bias": P(None)},
-    }
-    return specs
+    return shreg.resolve_tree(logical_axes(), _TP_RULES)
 
 
 def _spec_tree_for(params: Params) -> Params:
@@ -68,9 +52,10 @@ def _spec_tree_for(params: Params) -> Params:
     if not ("blocks" in params and "wte" in params):
         # Vision models: no TP layout defined — replicate everything (TP is
         # a transformer play; convs scale via data/spatial sharding).
-        return jax.tree_util.tree_map(lambda _: P(), params)
+        return jax.tree_util.tree_map(
+            lambda _: shreg.replicated_spec(), params)
     specs = gpt2_tp_specs(params)
-    is_spec = lambda x: isinstance(x, P)
+    is_spec = lambda x: isinstance(x, PartitionSpec)
     p_paths = {
         jax.tree_util.keystr(kp)
         for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0]
@@ -121,7 +106,7 @@ def apply_tp_sharding_to_opt(opt_state: Any, params: Params,
         return opt_state
     specs = _spec_tree_for(params)
     pdef = jax.tree_util.tree_structure(params)
-    repl = NamedSharding(mesh, P())
+    repl = shreg.replicated_sharding(mesh)
 
     def params_like(node):
         try:

@@ -105,12 +105,12 @@ def adapter_page_row(page_by_slot: Dict[int, int],
 
 def adapter_partition_specs() -> Tuple[Any, Any]:
     """PartitionSpecs for the (a, b) pool arrays: replicated — every
-    chip serves every tenant, exactly like the KV pool.  Spelled only
-    here (lint: adapter-locality); the engine applies them when a mesh
-    is active."""
-    from jax.sharding import PartitionSpec
+    chip serves every tenant, exactly like the KV pool.  Resolved only
+    here (lint: adapter-locality) through the sharding registry; the
+    engine applies them when a mesh is active."""
+    from trustworthy_dl_tpu.core import sharding as shreg
 
-    return PartitionSpec(), PartitionSpec()
+    return shreg.replicated_spec(), shreg.replicated_spec()
 
 
 def _adapter_seed(name: str) -> int:

@@ -358,6 +358,14 @@ class PredictiveArmConfig:
     per_replica_rps: float
     lead_s: float = 0.0
     tick_duration_s: float = 0.05
+    #: Per-role demand envelopes for the disaggregated prefill/decode
+    #: split: role -> the FRACTION of fleet-wide demand that pool
+    #: serves (e.g. ``{"prefill": 0.4, "decode": 0.6}``).  None keeps
+    #: the predictive arm fleet-wide only (pool scalers run reactive) —
+    #: the pre-split behaviour.  Shares must sum to <= 1.0: the roles
+    #: PARTITION the demand, which is exactly what makes per-pool
+    #: prediction safe from double-provisioning.
+    role_share: Optional[Mapping[str, float]] = None
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.burstiness < 1.0:
@@ -369,6 +377,18 @@ class PredictiveArmConfig:
         if self.lead_s < 0 or self.tick_duration_s <= 0:
             raise ValueError("lead_s must be >= 0 and tick_duration_s "
                              "> 0")
+        if self.role_share is not None:
+            shares = dict(self.role_share)
+            if not shares or any(not 0.0 < s <= 1.0
+                                 for s in shares.values()):
+                raise ValueError("role_share fractions must be in (0, 1]")
+            if sum(shares.values()) > 1.0 + 1e-9:
+                raise ValueError(
+                    "role_share fractions must sum to <= 1.0 (the roles "
+                    f"partition fleet demand), got {shares}")
+            # Freeze for hashability of the frozen dataclass.
+            object.__setattr__(self, "role_share",
+                               tuple(sorted(shares.items())))
 
 
 def diurnal_rate(mean_rps: float, burstiness: float,
@@ -381,13 +401,32 @@ def diurnal_rate(mean_rps: float, burstiness: float,
     return max(rate, mean_rps * (1.0 - burstiness), 1e-6)
 
 
-def predicted_replicas(cfg: PredictiveArmConfig, tick: int) -> int:
+def predicted_replicas(cfg: PredictiveArmConfig, tick: int,
+                       role: Optional[str] = None) -> int:
     """Replicas the diurnal envelope will demand ``lead_s`` from now:
     the predictive arm's scale-ahead estimate, a pure function of the
-    tick (deterministic drills)."""
+    tick (deterministic drills).
+
+    ``role`` asks for ONE disaggregated pool's slice of that demand:
+    the fleet-wide rate is scaled by the pool's declared
+    ``role_share`` fraction before dividing by per-replica capacity.
+    Because the shares partition the demand (they sum to <= 1), the
+    pools' predictions can never jointly exceed what the fleet-wide
+    arm would have asked for — the double-provisioning hazard that
+    used to force pool-mode scalers to run reactive-only.  Returns
+    a role estimate only when the config declares a share for it;
+    asking for an undeclared role raises (a silently-fleet-wide
+    number would quietly double-provision)."""
     t_s = tick * cfg.tick_duration_s + cfg.lead_s
     rate = diurnal_rate(cfg.mean_rps, cfg.burstiness,
                         cfg.burst_period_s, t_s)
+    if role is not None:
+        shares = dict(cfg.role_share or ())
+        if role not in shares:
+            raise ValueError(
+                f"predictive role_share declares no share for role "
+                f"{role!r} (declared: {sorted(shares)})")
+        rate *= shares[role]
     return max(int(math.ceil(rate / cfg.per_replica_rps)), 1)
 
 
@@ -479,6 +518,32 @@ def autoscale_pressure(cfg: AutoscalerConfig, sig: ScaleSignals) -> int:
             and (sig.predicted_replicas is None
                  or sig.predicted_replicas < sig.in_service))
     return -1 if down else 0
+
+
+def choose_scale_action(cfg: AutoscalerConfig, sig: ScaleSignals,
+                        tp_size: int, tp_max: int) -> str:
+    """Scale-OUT vs scale-UP: once the autoscaler has decided to add
+    capacity, choose its SHAPE.  Pure, like :func:`autoscale_pressure`,
+    and sharing its thresholds so the two predicates cannot drift.
+
+    * ``"up"`` — grow the model-shard dimension: the next replica is
+      built with a DOUBLED tensor-parallel group (bounded by
+      ``tp_max``).  Chosen when the pressure is occupancy-driven while
+      the queue stays quiet: each replica's KV pool is the bottleneck,
+      and a larger TP group shards the per-token KV bytes across more
+      chips, so the same per-device HBM budget holds proportionally
+      more blocks (the headroom gate sizes per SHARD —
+      serve/engine.py).
+    * ``"out"`` — add another replica of the current shape.  Chosen
+      for queue-driven pressure (demand exceeds aggregate service
+      rate: more independent engines beat bigger ones) and whenever
+      the TP dimension is already at ``tp_max``.
+    """
+    if (tp_size < tp_max
+            and sig.occupancy >= cfg.scale_up_occupancy
+            and sig.queue_per_replica < cfg.scale_up_queue_per_replica):
+        return "up"
+    return "out"
 
 
 class Autoscaler:

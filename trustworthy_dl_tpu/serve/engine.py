@@ -233,13 +233,30 @@ class ServingEngine:
                  adapter_rank: int = 0,
                  adapter_pool_pages: Optional[int] = None,
                  adapter_dtype: str = "model",
-                 adapter_map: Optional[Dict[str, str]] = None):
+                 adapter_map: Optional[Dict[str, str]] = None,
+                 tp_size: int = 1,
+                 tp_devices: Optional[Sequence[Any]] = None):
         # ``chaos``: an optional chaos.FaultInjector whose SERVE_POISON
         # events overwrite a retiring request's output signals — the
         # deterministic drill for the monitor→quarantine path (a poisoned
         # replica must lose its slot, not keep serving).
         self.chaos = chaos
         self.cfg = cfg
+        # Tensor-parallel replica: the engine owns a TP submesh over the
+        # 'model' axis and the params carry the model's registry-declared
+        # TP layout (core/sharding.py:serve_tp_mesh/place_serve_tp — the
+        # SAME rules training TP resolves, so one layout serves both
+        # planes).  Every jitted serve program then runs GSPMD-partitioned
+        # over the group; tp_size=1 is byte-for-byte the single-chip
+        # engine.  ``tp_devices`` is the fleet's carved per-replica device
+        # slice; None defaults to the first tp_size local devices.
+        self.tp_size = int(tp_size)
+        self.tp_mesh = None
+        if self.tp_size > 1:
+            from trustworthy_dl_tpu.core import sharding as shreg
+
+            self.tp_mesh = shreg.serve_tp_mesh(self.tp_size, tp_devices)
+            params = shreg.place_serve_tp(params, self.tp_mesh)
         # Paged pool geometry fails loudly HERE, before any model work
         # (kv_slots.validate_paged_geometry — the same check ServeConfig
         # runs, so engines built without a config stay just as safe).
@@ -263,6 +280,12 @@ class ServingEngine:
         if hbm is not None:
             bpt = kv_bytes_per_token(cfg, jnp.int8) \
                 if kv_dtype == "int8" else kv_bytes_per_token(cfg)
+            # TP replica: the KV heads shard over the group, so each
+            # device holds 1/tp of the pool's bytes — the headroom gate
+            # budgets per DEVICE, so it admits the per-shard cost.  This
+            # is what lets a scale-UP (bigger TP group) fit more blocks
+            # into the same per-chip budget.
+            bpt = max(bpt // max(self.tp_size, 1), 1)
             if paged:
                 requested = num_blocks * block_size * bpt
                 if not hbm.admit(requested, what="serve_paged_pool"):
@@ -714,6 +737,7 @@ class ServingEngine:
             adapter_rank=serve_config.adapter_rank,
             adapter_pool_pages=serve_config.adapter_pool_pages,
             adapter_dtype=serve_config.adapter_dtype,
+            tp_size=serve_config.tp_size,
             **kwargs,
         )
 

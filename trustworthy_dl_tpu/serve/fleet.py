@@ -251,8 +251,26 @@ class FleetConfig:
     #: replay, preemptions replay, disaggregated rebalance is inert —
     #: without touching any other knob.
     live_migration: bool = True
+    #: Tensor-parallel width of every INITIAL replica: each engine owns
+    #: a tp_size-device submesh over the 'model' axis and its weights
+    #: carry the registry-declared TP layout (core/sharding.py), so the
+    #: fleet's capacity is a replicas × model-shards grid.  1 (default)
+    #: is the single-chip fleet, byte-for-byte.
+    tp_size: int = 1
+    #: Scale-UP headroom: the autoscaler may grow a replica's TP group
+    #: up to this width (control.choose_scale_action — occupancy-driven
+    #: pressure doubles the group; queue-driven pressure adds replicas).
+    #: 0 (default) pins tp_max = tp_size: no scale-up dimension, the
+    #: pre-TP autoscaler byte-for-byte.
+    tp_max: int = 0
 
     def __post_init__(self) -> None:
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
+        if self.tp_max and self.tp_max < self.tp_size:
+            raise ValueError(
+                f"tp_max={self.tp_max} must be 0 (= tp_size) or >= "
+                f"tp_size={self.tp_size}")
         if self.num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         if not 0.0 < self.flag_rate_quarantine <= 1.0:
@@ -389,6 +407,7 @@ class _Replica:
         self.engine = engine
         self.gen = 0
         self.role = "mixed"         # pool role; "mixed" = unified fleet
+        self.tp = 1                 # tensor-parallel group width
         self.state = ReplicaState.HEALTHY
         self.last_progress_tick = 0
         self.stalled_until = -1     # chaos wedge: step() suspended until
@@ -483,6 +502,14 @@ class ServingFleet:
         self._params = params
         self._cfg = cfg
         self._engine_kwargs = dict(engine_kwargs)
+        # Tensor-parallel replica width: FleetConfig.tp_size governs;
+        # a tp_size riding engine_kwargs (from_config passes the
+        # ServeConfig knob through) seeds it when the fleet config
+        # leaves the default.  Per-replica widths can then diverge via
+        # scale-UP, so the knob is popped here and threaded per build.
+        self._base_tp = max(
+            int(self._engine_kwargs.pop("tp_size", 1) or 1),
+            self.config.tp_size)
         # Per-replica SLO rules (None + attach_watchers=False = no
         # watchers).  Watchers are built per REPLICA, not per fleet —
         # a breach is a replica-local signal (one slow replica must not
@@ -575,6 +602,11 @@ class ServingFleet:
             "In-service replicas per disaggregated pool role",
             labels=("role",),
         )
+        self._chips_gauge = registry.gauge(
+            "tddl_fleet_chips",
+            "Devices occupied: in-service replicas weighted by their "
+            "tensor-parallel group width",
+        )
         self._classq_gauge = registry.gauge(
             "tddl_fleet_class_queue_depth",
             "Fleet admission-queue depth, by SLO class",
@@ -607,7 +639,7 @@ class ServingFleet:
             "hedges": 0, "hedge_lost": 0,
             "suspicions": 0, "votes": 0, "outvotes": 0,
             "tenant_floods": 0, "throttles": 0,
-            "scale_ups": 0, "scale_downs": 0,
+            "scale_ups": 0, "scale_downs": 0, "tp_scale_ups": 0,
             "adapter_poisons": 0, "adapter_quarantines": 0,
             "adapter_throttles": 0,
             "preempts": 0, "migrations": 0,
@@ -729,6 +761,10 @@ class ServingFleet:
             adapter_rank=serve_config.adapter_rank,
             adapter_pool_pages=serve_config.adapter_pool_pages,
             adapter_dtype=serve_config.adapter_dtype,
+            # TP width rides engine_kwargs too; the fleet pops it into
+            # its per-replica width bookkeeping (scale-UP can diverge
+            # individual replicas from this base).
+            tp_size=serve_config.tp_size,
             **kwargs,
         )
 
@@ -737,8 +773,25 @@ class ServingFleet:
     def _default_factory(self, index: int, **kwargs: Any) -> Any:
         return ServingEngine(self._params, self._cfg, **kwargs)
 
-    def _engine_build_kwargs(self, index: int) -> Dict[str, Any]:
+    def _tp_devices(self, index: int, tp: int) -> Optional[List[Any]]:
+        """Carve replica ``index``'s TP device slice: contiguous groups
+        of ``tp`` local devices when the host has enough for disjoint
+        slices, else None (the engine defaults to the first ``tp``
+        devices — simulation aliasing on small hosts; real deployments
+        size the host to replicas × tp chips)."""
+        devices = jax.devices()
+        lo, hi = index * tp, (index + 1) * tp
+        if hi <= len(devices):
+            return list(devices[lo:hi])
+        return None
+
+    def _engine_build_kwargs(self, index: int,
+                             tp: Optional[int] = None) -> Dict[str, Any]:
         kwargs = dict(self._engine_kwargs)
+        tp = tp or self._base_tp
+        if tp > 1:
+            kwargs["tp_size"] = tp
+            kwargs["tp_devices"] = self._tp_devices(index, tp)
         kwargs.setdefault("rng", jax.random.fold_in(self._rng, index))
         kwargs["replica_id"] = index
         kwargs["chaos"] = self.chaos
@@ -763,11 +816,19 @@ class ServingFleet:
 
     def _build_replica(self, index: int,
                        prev: Optional[_Replica] = None,
-                       role: Optional[str] = None) -> _Replica:
-        engine = self._factory(index, **self._engine_build_kwargs(index))
+                       role: Optional[str] = None,
+                       tp: Optional[int] = None) -> _Replica:
+        # TP width is sticky like the role: a rebuild/restart keeps the
+        # width it had; only an explicit scale-UP changes it.
+        if tp is None:
+            tp = prev.tp if prev is not None and prev.tp > 1 \
+                else self._base_tp
+        engine = self._factory(index,
+                               **self._engine_build_kwargs(index, tp))
         rep = prev if prev is not None else _Replica(
             index, engine, self.config.flag_window)
         rep.engine = engine
+        rep.tp = tp
         # Pool role is a property of the INDEX (initial assignment) or
         # of the scale-up that created the replica — a rebuild/restart
         # keeps the role it had; chaos must not reshuffle the pools.
@@ -1475,11 +1536,19 @@ class ServingFleet:
         itl = (self._itl_est.quantile(0.99)
                if self._itl_est.count else None)
         cfg = self.autoscaler.cfg
-        # The predictive arm models FLEET-wide demand: applying it to
-        # each pool separately would double-provision, so it only
-        # steers the unified fleet.
-        pred = (predicted_replicas(cfg.predictive, self.tick)
-                if cfg.predictive is not None and role is None else None)
+        # The predictive arm models FLEET-wide demand.  A pool scaler
+        # may consume it only when the config DECLARES that pool's
+        # demand share (PredictiveArmConfig.role_share) — the shares
+        # partition the envelope, so per-pool predictions cannot
+        # jointly exceed the fleet-wide ask (the double-provisioning
+        # hazard that used to force pool mode to run reactive-only).
+        pred = None
+        if cfg.predictive is not None:
+            if role is None:
+                pred = predicted_replicas(cfg.predictive, self.tick)
+            elif role in dict(cfg.predictive.role_share or ()):
+                pred = predicted_replicas(cfg.predictive, self.tick,
+                                          role=role)
         return ScaleSignals(
             tick=self.tick, in_service=len(staying),
             queue_per_replica=queue / max(len(staying), 1),
@@ -1509,9 +1578,27 @@ class ServingFleet:
         RESTARTING like any rebuild — scale-up is never instant
         admission.  ``role`` pins the new capacity to one disaggregated
         pool: the revived/appended replica joins THAT pool (a decode
-        scale-up must never come back as a prefill specialist)."""
+        scale-up must never come back as a prefill specialist).
+
+        With TP headroom configured (``tp_max > tp_size``) the pure
+        shape predicate (control.choose_scale_action) picks scale-OUT
+        (another replica of the current width) vs scale-UP (the new
+        capacity arrives with a DOUBLED TP group): occupancy pressure
+        with a quiet queue means per-replica HBM is the bottleneck and
+        a wider shard group buys pool blocks, while queue pressure
+        means aggregate service rate is — more engines beat bigger
+        ones.  Existing replicas are never rebuilt in place (that would
+        kill their in-flight work); the fleet upgrades through churn."""
+        from trustworthy_dl_tpu.serve.control import choose_scale_action
+
         frm = len(self._in_service())
         cfgc = self.config
+        cur_tp = max((r.tp for r in self._in_service()
+                      if r.engine is not None), default=self._base_tp)
+        tp_max = cfgc.tp_max or max(cfgc.tp_size, self._base_tp)
+        action = choose_scale_action(self.autoscaler.cfg, sig,
+                                     cur_tp, tp_max)
+        tp_new = min(cur_tp * 2, tp_max) if action == "up" else None
         rep = next((r for r in self.replicas
                     if r.state is ReplicaState.RETIRED
                     and (role is None or r.role == role)), None)
@@ -1523,15 +1610,19 @@ class ServingFleet:
                         if r.state is ReplicaState.RETIRED), None)
         if rep is not None:
             rep.gen += 1
-            self._build_replica(rep.index, prev=rep, role=role)
+            self._build_replica(rep.index, prev=rep, role=role, tp=tp_new)
         else:
-            rep = self._build_replica(len(self.replicas), role=role)
+            rep = self._build_replica(len(self.replicas), role=role,
+                                      tp=tp_new)
             self.replicas.append(rep)
         rep.warm_until = self.tick + cfgc.restart_ticks
         rep.last_progress_tick = self.tick
         self._transition(rep, ReplicaState.RESTARTING, "scale_up")
-        logger.warning("fleet: scale-up -> replica %d (queue/replica "
-                       "%.1f, occupancy %.2f)", rep.index,
+        if action == "up":
+            self.counters["tp_scale_ups"] += 1
+        logger.warning("fleet: scale-%s -> replica %d tp=%d "
+                       "(queue/replica %.1f, occupancy %.2f)", action,
+                       rep.index, rep.tp,
                        sig.queue_per_replica, sig.occupancy)
         self._emit_scale("up", frm, len(self._in_service()), "scale_up")
 
@@ -2579,9 +2670,17 @@ class ServingFleet:
                 self._pool_gauge.set(float(n), role=role)
         self._tif_gauge.set(float(tif))
         self._queue_gauge.set(float(load))
+        self._chips_gauge.set(float(self.chips_in_service()))
         if self._classq is not None:
             for name, depth in self._classq.depth_by_class().items():
                 self._classq_gauge.set(float(depth), slo_class=name)
+
+    def chips_in_service(self) -> int:
+        """Devices the fleet occupies: the replicas × model-shards grid
+        summed (each replica counts its TP group width) — the capacity
+        dimension a scale-OUT and a scale-UP both grow, each along its
+        own axis."""
+        return sum(r.tp for r in self._in_service())
 
     @property
     def open_requests(self) -> int:
