@@ -303,6 +303,15 @@ def _attach_perf_sections(record: dict, compiles=None, hbm=None) -> dict:
         # silent fallback to the jnp gather path inflates it.
         decode_tick_fraction=(record.get("paged_attn")
                               or {}).get("decode_tick_fraction"),
+        # Prefill-chunk / spec-verify serve-wall shares of the kernel
+        # arms (same rounds): direction lower-is-better — a silent
+        # fallback of the chunked-prefill flash program or the fused
+        # verify tail inflates exactly one of them, and the per-program
+        # attn-kernel gauge names which.
+        prefill_chunk_fraction=(record.get("paged_attn")
+                                or {}).get("prefill_chunk_fraction"),
+        spec_verify_fraction=(record.get("paged_attn")
+                              or {}).get("spec_verify_fraction"),
         # Adapter-pool locality + equal-HBM personalisation cost
         # (TDDL_BENCH_ADAPTERS rounds): both higher-is-better — a
         # colder pool or a pricier adapter path bands like a perf
@@ -980,13 +989,19 @@ def bench_spec() -> "dict":
 
 
 def bench_paged_attn() -> "dict":
-    """Paged-attention kernel A/B (TDDL_BENCH_PAGED_ATTN=1, riding
+    """Paged-attention kernel-tier A/B (TDDL_BENCH_PAGED_ATTN=1, riding
     TDDL_BENCH_SERVE=1): the SAME seeded open-loop workload through a
     kernel-on arm (``attn_impl="pallas"`` — the ragged Pallas
     paged-decode attention + fused trust epilogue) and the jnp-fallback
     arm (``attn_impl="jnp"`` — today's gather path), both rows in the
     shared serve record shape (tokens/s, latency percentiles, SLO block,
-    decode_tick_fraction + attn_kernel_path).  On top it microbenches
+    decode_tick_fraction + attn_kernel_path).  Two more A/B pairs cover
+    the rest of the tier over the same workload: a chunked-prefill pair
+    (``prefill_chunk`` on — the flash chunk program vs the gathered
+    view; ``prefill_chunk_fraction``) and a speculative-verify pair
+    (``spec_k`` on — the fused verify tail vs materialise-then-reduce;
+    ``spec_verify_fraction``), each fraction joining the sentinel
+    fingerprint direction lower.  On top it microbenches
     the output monitor's per-token reductions standalone — the jnp
     log_softmax/exp/top-k battery vs the single-pass trust epilogue over
     decode-shaped [slots, vocab] logits — so the "trust monitoring is
@@ -1004,7 +1019,8 @@ def bench_paged_attn() -> "dict":
     Env: TDDL_BENCH_SERVE_MODEL (gpt2), TDDL_BENCH_PAGED_ATTN_SLOTS (4),
     TDDL_BENCH_PAGED_ATTN_SEQ (256), TDDL_BENCH_PAGED_ATTN_BLOCK (16),
     TDDL_BENCH_PAGED_ATTN_REQUESTS (16), TDDL_BENCH_PAGED_ATTN_NEW (32),
-    TDDL_BENCH_PAGED_ATTN_RATE (64)."""
+    TDDL_BENCH_PAGED_ATTN_RATE (64), TDDL_BENCH_PAGED_ATTN_CHUNK
+    (2*block), TDDL_BENCH_PAGED_ATTN_SPEC_K (2)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1099,6 +1115,75 @@ def bench_paged_attn() -> "dict":
     # decode-phase share of the serve wall.
     record["decode_tick_fraction"] = \
         record["arms"]["pallas"]["decode_tick_fraction"]
+
+    # Prefill-chunk arm: the SAME seeded workload with chunked prefill
+    # on, kernel tier vs jnp — the chunk program is the only prefill
+    # path an adapter-carrying or prefix-resumed prompt can take, so
+    # its wall share gets its own A/B and fingerprint entry.
+    chunk = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_CHUNK",
+                               str(2 * block)))
+    record["prefill_arms"] = {}
+    prefill_streams = {}
+    for label, impl in (("pallas", kernel_impl), ("jnp", "jnp")):
+        watcher = SLOWatcher(default_serve_rules())
+        engine = ServingEngine(params, cfg, max_slots=max_slots,
+                               max_seq=max_seq, queue_limit=n_requests,
+                               rng=jax.random.PRNGKey(1), slo=watcher,
+                               block_size=block, attn_impl=impl,
+                               prefill_chunk=chunk)
+        shed = _drive_serve_open_loop(engine, build_workload())
+        row = _serve_sweep_row(engine, watcher, rate, shed)
+        row["prefill_chunk_fraction"] = round(
+            engine.metrics_summary()["prefill_chunk_fraction"], 4)
+        record["prefill_arms"][label] = row
+        prefill_streams[label] = {r: v.tokens
+                                  for r, v in engine.results.items()
+                                  if v.status == "completed"}
+        log(f"paged_attn prefill [{label}]: "
+            f"{row['tokens_per_s']:8.1f} tok/s, prefill-chunk fraction "
+            f"{row['prefill_chunk_fraction']:.3f}")
+    record["prefill_streams_identical"] = \
+        prefill_streams["pallas"] == prefill_streams["jnp"]
+    record["prefill_tokens_per_s_ratio"] = round(
+        record["prefill_arms"]["pallas"]["tokens_per_s"]
+        / max(record["prefill_arms"]["jnp"]["tokens_per_s"], 1e-9), 3)
+    record["prefill_chunk_fraction"] = \
+        record["prefill_arms"]["pallas"]["prefill_chunk_fraction"]
+
+    # Speculative-verify arm: drafting on (spec_k), kernel tier vs jnp
+    # — the fused one-pass verify tail vs materialise-then-reduce; the
+    # verify-tick wall share is the fingerprint entry.
+    spec_k = int(os.environ.get("TDDL_BENCH_PAGED_ATTN_SPEC_K", "2"))
+    record["verify_arms"] = {}
+    verify_streams = {}
+    for label, impl in (("pallas", kernel_impl), ("jnp", "jnp")):
+        watcher = SLOWatcher(default_serve_rules())
+        engine = ServingEngine(params, cfg, max_slots=max_slots,
+                               max_seq=max_seq, queue_limit=n_requests,
+                               rng=jax.random.PRNGKey(1), slo=watcher,
+                               block_size=block, attn_impl=impl,
+                               spec_k=spec_k)
+        shed = _drive_serve_open_loop(engine, build_workload())
+        row = _serve_sweep_row(engine, watcher, rate, shed)
+        summary = engine.metrics_summary()
+        row["spec_verify_fraction"] = round(
+            summary["spec_verify_fraction"], 4)
+        if "accepted_rate" in summary:
+            row["accepted_rate"] = round(summary["accepted_rate"], 4)
+        record["verify_arms"][label] = row
+        verify_streams[label] = {r: v.tokens
+                                 for r, v in engine.results.items()
+                                 if v.status == "completed"}
+        log(f"paged_attn verify [{label}]: "
+            f"{row['tokens_per_s']:8.1f} tok/s, spec-verify fraction "
+            f"{row['spec_verify_fraction']:.3f}")
+    record["verify_streams_identical"] = \
+        verify_streams["pallas"] == verify_streams["jnp"]
+    record["verify_tokens_per_s_ratio"] = round(
+        record["verify_arms"]["pallas"]["tokens_per_s"]
+        / max(record["verify_arms"]["jnp"]["tokens_per_s"], 1e-9), 3)
+    record["spec_verify_fraction"] = \
+        record["verify_arms"]["pallas"]["spec_verify_fraction"]
 
     # Monitor-cost microbench: the output monitor's per-token reductions
     # over decode-shaped logits, jnp battery vs fused epilogue, jitted

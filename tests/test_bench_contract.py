@@ -312,6 +312,22 @@ def test_bench_paged_attn_ab_records(monkeypatch):
         == record["arms"]["pallas"]["decode_tick_fraction"]
     assert record["streams_identical"] is True
     assert record["tokens_per_s_ratio"] > 0
+    # The tier's two new A/B pairs ride the same serve record shape
+    # plus their own serve-wall fraction — the sentinel lifts the
+    # kernel arm's number for each.
+    for arms_key, frac in (("prefill_arms", "prefill_chunk_fraction"),
+                           ("verify_arms", "spec_verify_fraction")):
+        assert set(record[arms_key]) == {"pallas", "jnp"}
+        for label in ("pallas", "jnp"):
+            row = record[arms_key][label]
+            assert row["completed"] + row["shed"] == 4
+            assert row["tokens_per_s"] > 0
+            assert 0.0 < row[frac] <= 1.0
+        assert record[frac] == record[arms_key]["pallas"][frac]
+    assert record["prefill_streams_identical"] is True
+    assert record["verify_streams_identical"] is True
+    assert record["prefill_tokens_per_s_ratio"] > 0
+    assert record["verify_tokens_per_s_ratio"] > 0
     assert record["monitor_us_jnp"] > 0
     assert record["monitor_us_kernel"] > 0
     assert "monitor_cost_delta_us" in record

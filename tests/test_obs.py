@@ -943,13 +943,14 @@ def test_spec_surface_inside_the_lint_perimeter():
 
 
 def test_paged_attn_surface_inside_the_lint_perimeter():
-    """Paged-attention kernel extension: the attention-path gauge is a
-    literal ``tddl_`` name the metric-name lint scans, registered
+    """Paged-attention kernel-tier extension: the attention-path gauge
+    is a literal ``tddl_`` name the metric-name lint scans, registered
     through the same ``_metric`` replica-label surface as the rest of
-    the tddl_serve_* family with the ``path`` label (added to the
-    dashboard vocabulary deliberately, contracts.KNOWN_METRIC_LABELS),
-    and the sentinel fingerprint carries the decode-tick fraction with
-    a lower-is-better direction."""
+    the tddl_serve_* family with the ``path`` AND per-program
+    ``program`` labels (both in the dashboard vocabulary deliberately,
+    contracts.KNOWN_METRIC_LABELS), and the sentinel fingerprint
+    carries the decode-tick, prefill-chunk and spec-verify serve-wall
+    fractions with a lower-is-better direction."""
     import re
 
     from trustworthy_dl_tpu.analysis.contracts import KNOWN_METRIC_LABELS
@@ -960,11 +961,14 @@ def test_paged_attn_surface_inside_the_lint_perimeter():
     assert '"tddl_serve_attn_kernel"' in engine_src
     pattern = re.compile(
         r'"tddl_serve_attn_kernel",.*?'
-        r'labels=\("path",\) \+ self\._rlabel_names', re.DOTALL)
+        r'labels=\("path", "program"\) \+ self\._rlabel_names', re.DOTALL)
     assert pattern.search(engine_src), \
-        "tddl_serve_attn_kernel not path+replica labelled"
+        "tddl_serve_attn_kernel not path+program+replica labelled"
     assert "path" in KNOWN_METRIC_LABELS
+    assert "program" in KNOWN_METRIC_LABELS
     assert SENTINEL_METRICS["decode_tick_fraction"] == "lower"
+    assert SENTINEL_METRICS["prefill_chunk_fraction"] == "lower"
+    assert SENTINEL_METRICS["spec_verify_fraction"] == "lower"
 
 
 def test_migration_surface_inside_the_lint_perimeter():

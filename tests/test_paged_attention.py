@@ -382,10 +382,13 @@ def test_compile_once_under_block_churn_zero_storms(params):
 
 
 def test_attn_gauge_and_summary_surface(params):
-    """Every serve snapshot names the active attention path: the
-    ``tddl_serve_attn_kernel{path=}`` gauge sets 1 on exactly the
-    resolved path, and metrics_summary carries decode_tick_fraction +
-    attn_kernel_path (the pair the perf sentinel bands)."""
+    """Every serve snapshot names the active path of EVERY program in
+    the serving-kernel tier: the ``tddl_serve_attn_kernel{path=,
+    program=}`` gauge sets 1 on exactly the resolved path per program
+    (decode / prefill / verify / adapter), and metrics_summary carries
+    decode_tick_fraction + prefill_chunk_fraction +
+    spec_verify_fraction + the path map (what the perf sentinel
+    bands)."""
     for impl, expect in (("interpret", "interpret"), ("jnp", "jnp")):
         registry = MetricsRegistry()
         engine = ServingEngine(params, CFG, max_slots=2, max_seq=32,
@@ -393,17 +396,30 @@ def test_attn_gauge_and_summary_surface(params):
                                attn_impl=impl)
         engine.submit(ServeRequest(prompt=[3, 1, 4], max_new_tokens=3))
         engine.run_until_idle()
+        paths = engine.attn_kernel_paths
+        assert paths["decode"] == expect
+        assert paths["prefill"] == expect
+        assert paths["verify"] == expect
+        # No adapter pool configured: the adapter program has no work,
+        # its path stays the structural-absence "jnp".
+        assert paths["adapter"] == "jnp"
         gauge = registry.get("tddl_serve_attn_kernel")
-        for path in ("pallas", "interpret", "jnp"):
-            assert gauge.value(path=path) == (1.0 if path == expect
-                                              else 0.0), (impl, path)
+        for program in pattn.PAGED_PROGRAMS:
+            for path in ("pallas", "interpret", "jnp"):
+                want = 1.0 if path == paths[program] else 0.0
+                assert gauge.value(path=path, program=program) == want, \
+                    (impl, program, path)
         summary = engine.metrics_summary()
         assert summary["attn_kernel_path"] == expect
+        assert summary["attn_kernel_paths"] == paths
         assert 0.0 < summary["decode_tick_fraction"] <= 1.0
-    # The stripe pool has no paged kernel: its path is always jnp.
+        assert 0.0 < summary["prefill_chunk_fraction"] <= 1.0
+        assert summary["spec_verify_fraction"] == 0.0  # spec_k == 0
+    # The stripe pool has no paged kernel: its paths are always jnp.
     stripe = ServingEngine(params, CFG, max_slots=2, max_seq=32,
                            paged=False, registry=MetricsRegistry())
     assert stripe.attn_kernel_path == "jnp"
+    assert set(stripe.attn_kernel_paths.values()) == {"jnp"}
 
 
 def test_config_knob_validation_and_threading(params):

@@ -80,7 +80,8 @@ HOST_SYNC_SCOPES = {
     # any host pull of a traced value here would serialise every decode
     # tick (there is no intentional pull — these scopes allow zero).
     "trustworthy_dl_tpu/ops/paged_attention.py": (
-        "paged_attention", "logit_trust_stats",
+        "paged_attention", "paged_prefill_attention", "fused_verify_tail",
+        "adapter_delta", "logit_trust_stats",
     ),
 }
 
@@ -138,9 +139,9 @@ PREDICT_FUNCTION_PATTERNS = (
 #: added HERE (and to the dashboards) deliberately, not slipped in.
 KNOWN_METRIC_LABELS = frozenset({
     "action", "adapter", "device", "direction", "dtype", "kind", "metric",
-    "node", "outcome", "path", "phase", "reason", "replica", "role",
-    "scope", "signal", "slo", "slo_class", "stage", "state", "status",
-    "tenant", "to_state", "type",
+    "node", "outcome", "path", "phase", "program", "reason", "replica",
+    "role", "scope", "signal", "slo", "slo_class", "stage", "state",
+    "status", "tenant", "to_state", "type",
 })
 
 #: Metric-name prefix every registered literal must carry (the

@@ -256,10 +256,27 @@ def restaff_pipeline(trainer, drop: Sequence[int]) -> Dict[str, Any]:
         new_S, make_canary(trainer.model.config, config.canary_tokens)
     )
 
-    # --- placement on the new mesh (shared rule: row_placer) -------------
-    from trustworthy_dl_tpu.elastic.reassignment import row_placer
+    # --- placement on the new mesh (declared logical-axis layout) --------
+    # Stage-stacked leaves are DECLARED [STAGE, ...] in the sharding
+    # registry's model-parallel rule table; resolving the repartition
+    # through rules_for("model") + named_sharding keeps restaff on the
+    # same declaration every other placement site reads, instead of
+    # re-deriving the row split through the reassignment helpers (which
+    # encode the per-NODE rule, coincidentally identical today).
+    from trustworthy_dl_tpu.core import sharding as shreg
 
-    place_stage, repl = row_placer(new_mesh, STAGE_AXIS, new_S)
+    rules = shreg.rules_for("model")
+    repl = shreg.replicated_sharding(new_mesh)
+    stage_size = dict(new_mesh.shape).get(STAGE_AXIS, 1)
+
+    def place_stage(leaf):
+        nd = getattr(leaf, "ndim", 0)
+        if nd >= 1 and leaf.shape[0] == new_S and stage_size > 1 \
+                and new_S % stage_size == 0:
+            sharding = rules.named_sharding(
+                new_mesh, shreg.STAGE, *([None] * (nd - 1)))
+            return jax.device_put(leaf, sharding)
+        return jax.device_put(leaf, repl)
 
     params["blocks"] = jax.tree_util.tree_map(place_stage, params["blocks"])
     params = {

@@ -121,7 +121,9 @@ def _attn_qkv(block: Params, x: jax.Array,
 
 def _attn_mlp_tail(block: Params, x: jax.Array, out: jax.Array,
                    cfg: gpt2.GPT2Config,
-                   adapter: Optional[tuple] = None) -> jax.Array:
+                   adapter: Optional[tuple] = None,
+                   adapter_pool: Optional[tuple] = None,
+                   adapter_impl: str = "jnp") -> jax.Array:
     """The post-attention scaffolding every cached-decode block shares:
     merge heads, attention projection + residual, ln_2 + MLP +
     residual.  ``out`` [B, H, T, Dh] is the attention output.
@@ -133,32 +135,54 @@ def _attn_mlp_tail(block: Params, x: jax.Array, out: jax.Array,
     pointing at the reserved zero page contributes an exactly-zero
     delta.  ``None`` (every non-serving caller, and every serve program
     with ``adapter_rank == 0``) keeps this function bit-for-bit the
-    pre-adapter tail — structural absence, not a traced branch."""
+    pre-adapter tail — structural absence, not a traced branch.
+
+    ``adapter_pool`` is the UNGATHERED pool form ``(a_l [P+1, 2, D, r],
+    b_l [P+1, 2, r, D], a_scale_l, b_scale_l, apages [B])`` for the
+    in-grid kernel path (``adapter_impl`` "pallas"/"interpret"): the
+    per-slot page row joins the kernel's scalar-prefetch operands and
+    the A/B tiles stream HBM→VMEM inside ``ops.adapter_delta`` — no
+    gathered page copy exists.  Exactly one of ``adapter`` /
+    ``adapter_pool`` may be given."""
     from trustworthy_dl_tpu.ops.fused_dequant_matmul import lowrank_delta
     from trustworthy_dl_tpu.quant import int8 as q8
 
     dtype = cfg.dtype
     b, t, d = x.shape
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+
+    def delta(site_x: jax.Array, site: int) -> Optional[jax.Array]:
+        if adapter_pool is not None:
+            from trustworthy_dl_tpu.ops import paged_attention as pattn
+
+            a_l, b_l, as_l, bs_l, apages = adapter_pool
+            return pattn.adapter_delta(
+                site_x, a_l[:, site], b_l[:, site], apages,
+                a_scale=None if as_l is None else as_l[:, site],
+                b_scale=None if bs_l is None else bs_l[:, site],
+                interpret=(adapter_impl == "interpret"),
+            )
+        if adapter is not None:
+            a_s, b_s, a_sc, b_sc = adapter
+            return lowrank_delta(
+                site_x, a_s[:, site], b_s[:, site],
+                None if a_sc is None else a_sc[:, site],
+                None if b_sc is None else b_sc[:, site],
+            )
+        return None
+
     x = x + q8.qdense(block["attn"]["proj"], out, dtype).astype(x.dtype)
-    if adapter is not None:
-        a_s, b_s, a_sc, b_sc = adapter
-        x = x + lowrank_delta(
-            out, a_s[:, 0], b_s[:, 0],
-            None if a_sc is None else a_sc[:, 0],
-            None if b_sc is None else b_sc[:, 0],
-        ).astype(x.dtype)
+    d0 = delta(out, 0)
+    if d0 is not None:
+        x = x + d0.astype(x.dtype)
     y = L.layernorm(block["ln_2"], x).astype(dtype)
     ln2 = y
     y = q8.qdense(block["mlp"]["fc"], y, dtype)
     y = jax.nn.gelu(y)
     mlp = q8.qdense(block["mlp"]["proj"], y, dtype).astype(x.dtype)
-    if adapter is not None:
-        mlp = mlp + lowrank_delta(
-            ln2, a_s[:, 1], b_s[:, 1],
-            None if a_sc is None else a_sc[:, 1],
-            None if b_sc is None else b_sc[:, 1],
-        ).astype(x.dtype)
+    d1 = delta(ln2, 1)
+    if d1 is not None:
+        mlp = mlp + d1.astype(x.dtype)
     return x + mlp
 
 
@@ -345,6 +369,35 @@ def _all_logits(params: Params, x: jax.Array,
     return (normed.astype(cfg.dtype) @ wte_head.T).astype(jnp.float32)
 
 
+def fused_verify_logits(params: Params, x: jax.Array,
+                        cfg: gpt2.GPT2Config, *, interpret: bool
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Kernel twin of :func:`_all_logits` + the trust epilogue for the
+    speculative-verify tail: pre-``ln_f`` activations ``x`` [R, T, D]
+    -> (logits [R, T, V] f32, entropy [R·T], margin [R·T]) in ONE
+    streaming pass over the vocab (``ops.fused_verify_tail``) — the
+    [R, T, V] materialise-then-re-read of the jnp tail collapses into
+    per-tile reductions while each head tile is still in VMEM.
+
+    The head operand is exactly the one ``_all_logits`` contracts with:
+    ``wte_head`` when the decode view split one out, else the tied
+    ``wte`` cast to the compute dtype (``gpt2.project_logits``' own
+    cast); the layernorm + dtype rounding discipline matches
+    position-for-position, so the verify sampler sees bit-identical
+    logits and the scheduler's trust stats keep the pinned epilogue
+    algebra."""
+    from trustworthy_dl_tpu.ops import paged_attention as pattn
+
+    r, t, d = x.shape
+    wte_head = params.get("wte_head")
+    if wte_head is None:
+        wte_head = params["wte"].astype(cfg.dtype)
+    normed = L.layernorm(params["ln_f"], x).astype(cfg.dtype)
+    logits, ent, mar = pattn.fused_verify_tail(
+        normed.reshape(r * t, d), wte_head, interpret=interpret)
+    return logits.reshape(r, t, -1), ent, mar
+
+
 # ---------------------------------------------------------------------------
 # Paged-KV read/write path (serve/kv_slots.PagedKV pools).
 #
@@ -405,6 +458,7 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
                  pool_vs_l: Optional[jax.Array] = None,
                  attn_impl: str = "jnp",
                  adapter_l: Optional[tuple] = None,
+                 adapter_impl: str = "jnp",
                  ) -> Tuple[jax.Array, jax.Array, jax.Array,
                             Optional[jax.Array], Optional[jax.Array]]:
     """One transformer block over [R, T, D] new positions against a PAGED
@@ -431,21 +485,36 @@ def _paged_block(block: Params, x: jax.Array, pool_k_l: jax.Array,
 
     ``adapter_l`` is one layer's slice of the paged adapter pool plus
     the per-slot page table: ``(a_l [P+1, 2, D, r], b_l [P+1, 2, r, D],
-    a_scale_l, b_scale_l, apages [R])``.  The page gather happens HERE,
-    inside the layer scan — exactly one layer's gathered pages are ever
-    live, mirroring the KV view discipline — and feeds both attention
-    paths through the shared ``_attn_mlp_tail``."""
+    a_scale_l, b_scale_l, apages [R])``.  On the jnp paths the page
+    gather happens HERE, inside the layer scan — exactly one layer's
+    gathered pages are ever live, mirroring the KV view discipline —
+    and feeds ``_attn_mlp_tail``.  When ``adapter_impl`` (trace-time
+    static, resolved per-program by ``ops.resolve_attn_impls``) is
+    "pallas"/"interpret" AND the attention read is on a kernel path,
+    the gather disappears entirely: the pool form is handed down and
+    ``ops.adapter_delta`` streams exactly the pages it needs HBM→VMEM
+    inside its own grid, per-slot page row as scalar prefetch."""
     adapter_s: Optional[tuple] = None
+    if attn_impl != "jnp":
+        adapter_pool = None
+        if adapter_l is not None and adapter_impl != "jnp":
+            adapter_pool = adapter_l
+        elif adapter_l is not None:
+            a_l, b_l, as_l, bs_l, apages = adapter_l
+            adapter_s = (a_l[apages], b_l[apages],
+                         None if as_l is None else as_l[apages],
+                         None if bs_l is None else bs_l[apages])
+        return _paged_block_kernel(block, x, pool_k_l, pool_v_l, table,
+                                   start, cfg, pool_ks_l, pool_vs_l,
+                                   interpret=(attn_impl == "interpret"),
+                                   adapter=adapter_s,
+                                   adapter_pool=adapter_pool,
+                                   adapter_impl=adapter_impl)
     if adapter_l is not None:
         a_l, b_l, as_l, bs_l, apages = adapter_l
         adapter_s = (a_l[apages], b_l[apages],
                      None if as_l is None else as_l[apages],
                      None if bs_l is None else bs_l[apages])
-    if attn_impl != "jnp":
-        return _paged_block_kernel(block, x, pool_k_l, pool_v_l, table,
-                                   start, cfg, pool_ks_l, pool_vs_l,
-                                   interpret=(attn_impl == "interpret"),
-                                   adapter=adapter_s)
     r, t, _ = x.shape
     nbps = table.shape[1]
     bsz = pool_k_l.shape[2]
@@ -497,16 +566,22 @@ def _paged_block_kernel(block: Params, x: jax.Array, pool_k_l: jax.Array,
                         pool_vs_l: Optional[jax.Array],
                         interpret: bool,
                         adapter: Optional[tuple] = None,
+                        adapter_pool: Optional[tuple] = None,
+                        adapter_impl: str = "jnp",
                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                    Optional[jax.Array],
                                    Optional[jax.Array]]:
     """The kernel-path twin of the gather branch in :func:`_paged_block`:
     write-then-attend.  The fresh K/V (quantized at the write on the int8
     tier — the exact values the gather path writes) scatter into the pool
-    first; the ``ops.paged_attention`` kernel then reads positions
+    first; a ``ops.paged_attention`` program then reads positions
     [0, start+T) straight from the pool with the causal window masked
     in absolute positions, which is precisely what the gathered view
-    exposes to ``_block_with_cache``."""
+    exposes to ``_block_with_cache``.  T selects the program (static —
+    each serve program compiles one shape): the one-query-tile decode
+    kernel up to ``QROWS`` rows (decode T=1, speculative verify T=k+1),
+    the query-tiled chunked-prefill flash kernel above it (per-tile
+    causal block bounds skip KV tiles whole query tiles cannot see)."""
     from trustworthy_dl_tpu.ops import paged_attention as pattn
     from trustworthy_dl_tpu.quant import int8 as q8
 
@@ -538,11 +613,14 @@ def _paged_block_kernel(block: Params, x: jax.Array, pool_k_l: jax.Array,
     pool_k_l = pool_k_l.at[phys, :, offs].set(rows_of(k_w))
     pool_v_l = pool_v_l.at[phys, :, offs].set(rows_of(v_w))
 
-    out = pattn.paged_attention(
+    attend = (pattn.paged_prefill_attention if t > pattn.QROWS
+              else pattn.paged_attention)
+    out = attend(
         q, pool_k_l, pool_v_l, table, start,
         k_scale=pool_ks_l, v_scale=pool_vs_l, interpret=interpret,
     ).astype(cfg.dtype)                                    # [R, H, T, Dh]
-    x = _attn_mlp_tail(block, x, out, cfg, adapter=adapter)
+    x = _attn_mlp_tail(block, x, out, cfg, adapter=adapter,
+                       adapter_pool=adapter_pool, adapter_impl=adapter_impl)
     return x, pool_k_l, pool_v_l, pool_ks_l, pool_vs_l
 
 
@@ -556,6 +634,8 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
                             all_logits: bool = False,
                             attn_impl: str = "jnp",
                             adapter: Optional[tuple] = None,
+                            adapter_impl: str = "jnp",
+                            hidden: bool = False,
                             ) -> Tuple[jax.Array, jax.Array, jax.Array,
                                        Optional[jax.Array],
                                        Optional[jax.Array]]:
@@ -567,10 +647,17 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
     ``all_logits`` (trace-time bool) returns [R, T, V] logits at every
     fed position instead — the speculative-verify program's tail, where
     the target's token choice is needed at each draft position.
+    ``hidden`` (trace-time bool) skips the projection entirely and
+    returns the pre-``ln_f`` activations [R, T, D] — the fused-verify
+    caller hands them to :func:`fused_verify_logits`, which streams the
+    vocab ONCE for logits AND trust stats instead of materialising
+    [R, T, V] and re-reading it.
     ``attn_impl`` (trace-time static, see :func:`_paged_block`) swaps the
     gathered-view attention for the ragged ``ops.paged_attention``
-    kernel; tables/starts stay traced values either way, so the
-    compile-once pin holds on both paths.
+    kernel, and ``adapter_impl`` likewise swaps the per-layer adapter
+    page gather for the in-grid ``ops.adapter_delta`` stream;
+    tables/starts/pages stay traced values every way, so the
+    compile-once pin holds on all paths.
 
     ``adapter`` is the paged adapter-pool pytree ``(a [L, P+1, 2, D,
     r], b, a_scale, b_scale, apages [R])`` (serve/adapters.py): the
@@ -600,13 +687,16 @@ def _apply_with_cache_paged(params: Params, tokens: jax.Array,
         x, pk, pv, pks, pvs = _paged_block(block, x, pk, pv, table, start,
                                            cfg, pks, pvs,
                                            attn_impl=attn_impl,
-                                           adapter_l=adapter_l)
+                                           adapter_l=adapter_l,
+                                           adapter_impl=adapter_impl)
         return x, (pk, pv, pks, pvs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
         scan_fn, x, (params["blocks"], pool_k, pool_v, pool_ks, pool_vs,
                      ad_a, ad_b, ad_as, ad_bs),
     )
+    if hidden:
+        return x, new_k, new_v, new_ks, new_vs
     if all_logits:
         return _all_logits(params, x, cfg), new_k, new_v, new_ks, new_vs
     return _final_logits(params, x, cfg, last_pos), new_k, new_v, \

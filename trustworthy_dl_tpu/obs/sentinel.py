@@ -52,6 +52,15 @@ SENTINEL_METRICS: Dict[str, str] = {
     # perf regression even while tokens/s noise hides it, and the
     # tddl_serve_attn_kernel{path=} gauge names the culprit.
     "decode_tick_fraction": "lower",
+    # Prefill-chunk and speculative-verify shares of the serve wall —
+    # the same silent-downgrade story as decode_tick_fraction, one per
+    # new kernel program: the chunked-prefill flash program falling
+    # back to the gathered-view jnp path inflates the prefill share,
+    # the fused verify tail falling back to materialise-then-reduce
+    # inflates the verify share.  The per-program
+    # tddl_serve_attn_kernel{path=,program=} gauge names the culprit.
+    "prefill_chunk_fraction": "lower",
+    "spec_verify_fraction": "lower",
     # Adapter-pool locality (pool hits / lookups) and the equal-HBM
     # personalisation cost (adapter-arm tokens/s over base-arm tokens/s
     # at the SAME budget, TDDL_BENCH_ADAPTERS rounds).  A colder pool
@@ -79,6 +88,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                 hbm_watermark_bytes: Optional[int] = None,
                 accepted_rate: Optional[float] = None,
                 decode_tick_fraction: Optional[float] = None,
+                prefill_chunk_fraction: Optional[float] = None,
+                spec_verify_fraction: Optional[float] = None,
                 adapter_hit_rate: Optional[float] = None,
                 adapter_tokens_ratio: Optional[float] = None,
                 migration_fraction: Optional[float] = None,
@@ -109,6 +120,8 @@ def fingerprint(source: str, *, metric: Optional[str] = None,
                         ("hbm_watermark_bytes", hbm_watermark_bytes),
                         ("accepted_rate", accepted_rate),
                         ("decode_tick_fraction", decode_tick_fraction),
+                        ("prefill_chunk_fraction", prefill_chunk_fraction),
+                        ("spec_verify_fraction", spec_verify_fraction),
                         ("adapter_hit_rate", adapter_hit_rate),
                         ("adapter_tokens_ratio", adapter_tokens_ratio),
                         ("migration_fraction", migration_fraction)):

@@ -11,11 +11,15 @@ isn't enough.  Current kernels:
 * ``fused_dequant_matmul`` — int8-weight dequant matmul tile for the
   serving engine's weight-only-int8 decode path (quant/): streams int8
   weight tiles HBM→VMEM, upcasts in-register, scales per output channel.
-* ``paged_attention`` — ragged paged-decode attention over the serving
-  engine's block pool (one program per block-table row, int8 KV tiles
-  dequantized in-register, online softmax, early exit at each row's true
-  length) plus the fused logit trust epilogue (entropy / top-1 margin in
-  one pass over the vocab).
+* ``paged_attention`` — the serving-kernel TIER over the engine's block
+  pool: ragged paged-decode attention (one program per block-table row,
+  int8 KV tiles dequantized in-register, online softmax, early exit at
+  each row's true length), the query-tiled chunked-prefill program
+  (per-tile causal bounds over the same scalar-prefetch tables), the
+  fused speculative-verify tail (logits projection + trust stats in one
+  streaming vocab pass), the in-grid adapter low-rank gather (per-slot
+  page table as scalar prefetch) and the fused logit trust epilogue
+  (entropy / top-1 margin in one pass over the vocab).
 
 All four dispatch through the ONE shared gate below: :func:`pallas_enabled`
 (env-var opt-in/out, TPU-backend default) and :func:`pallas_interpret`
@@ -72,20 +76,28 @@ from trustworthy_dl_tpu.ops.fused_stats import (
 # unlike ``flash_attention`` where the function deliberately shadows its
 # submodule and callers only ever want the one entry point.
 from trustworthy_dl_tpu.ops.paged_attention import (
+    adapter_delta,
+    fused_verify_tail,
     logit_trust_stats,
+    paged_prefill_attention,
     resolve_attn_impl,
+    resolve_attn_impls,
     supports_paged_attention,
 )
 
 __all__ = [
     "BLOCK_ROWS",
     "LANES",
+    "adapter_delta",
     "dequant_matmul",
     "flash_attention",
     "fused_moments",
+    "fused_verify_tail",
     "logit_trust_stats",
+    "paged_prefill_attention",
     "pallas_enabled",
     "pallas_interpret",
     "resolve_attn_impl",
+    "resolve_attn_impls",
     "supports_paged_attention",
 ]

@@ -1,4 +1,6 @@
-"""Pallas TPU kernel: ragged paged-decode attention + fused trust epilogue.
+"""Pallas TPU serving-kernel tier: ragged paged attention (decode +
+query-tiled chunked prefill), the fused speculative-verify tail, the
+in-grid adapter gather, and the fused trust epilogue.
 
 Decode attention over the paged KV pool (serve/kv_slots.PagedKV) has been
 reading the cache through jnp gathers: ``models/generate._paged_gather``
@@ -25,6 +27,40 @@ tokens/sec lever ROADMAP item 2 names:
   block index issues no copy, the same bandwidth trick as
   ``flash_attention``'s causal skip) and ``pl.when`` skips their compute.
 
+**Chunked-prefill program** (:func:`paged_prefill_attention`): the
+multi-query-row extension.  T chunk rows per slot tile into
+``q_tile``-row query tiles (grid ``(R, H, NT, NBPS)``) attending over
+the SAME scalar-prefetch block tables with the ragged causal mask in
+absolute positions.  The per-(row, tile) last-useful-block bound rides
+as a third scalar-prefetch operand, so an early query tile streams only
+the KV blocks its causal window can see — the flash-attention causal
+skip applied ACROSS query tiles of a paged table, which the one-block-
+bound decode program cannot express.  This replaces ``paged_chunk``'s
+gathered-view attention (the whole-prompt [R, H, S, Dh] view per chunk
+per layer).
+
+**Fused speculative-verify tail** (:func:`fused_verify_tail`): the spec
+verify window needs logits at EVERY draft position plus the per-position
+trust stats.  The jnp tail (``models/generate._all_logits`` then
+``logit_trust_stats``) projects [R·(k+1), V] logits to HBM and re-reads
+them for the reductions.  The fused program streams ``wte_head`` in
+vocab tiles through ONE grid: each step runs the tile's head matmul,
+writes the logits tile (sampling's ``jax.random.categorical`` needs the
+full row — gumbel noise cannot be reproduced in-kernel without forking
+the sampled stream) and folds the SAME online entropy/top-2 algebra as
+the trust epilogue over the tile before it leaves VMEM — one vocab
+pass, no separate stats read, margin still bit-exact.
+
+**In-grid adapter gather** (:func:`adapter_delta`): the per-tenant
+low-rank delta (serve/adapters.py) was a ``jnp.take`` of each row's
+pool page ``a_l[apages]`` OUTSIDE the kernel grid.  Here the per-slot
+``adapter_page_row`` joins the scalar-prefetch operands: the A/B delta
+tiles stream HBM→VMEM alongside the KV blocks (index map resolves
+``row -> pages[row]`` before the DMA), int8 pages upcast in-register
+with their per-(page, site) scales applied in exactly the
+``fused_dequant_matmul.lowrank_delta`` order — the host-of-grid take is
+gone.
+
 **Trust epilogue** (:func:`logit_trust_stats`): the serve-side output
 monitor reduces every decode step's logits to softmax entropy + top-1
 margin (serve/scheduler._logit_signals).  Left to jnp that is a
@@ -38,12 +74,16 @@ logits once (which sampling pays anyway).
 Dispatch: behind the shared ops-package gate (``pallas_enabled
 ("TDDL_PAGED_ATTN")`` — default ON on TPU, opt-in off-TPU where it runs
 in interpret mode) with the jnp path as the always-available fallback
-and reference semantics.  The serving engine resolves ONE path at
-construction (:func:`resolve_attn_impl` — "pallas" | "interpret" |
-"jnp") and threads it through its compiled programs as a STATIC value,
-so A/B arms and tests retrace cleanly instead of aliasing each other in
-the process-global jit cache, and the compile-once pin is untouched:
-tables/lengths stay traced VALUES, block churn never recompiles.
+and reference semantics.  The serving engine resolves ONE path PER
+PROGRAM at construction (:func:`resolve_attn_impl` for the decode
+program — "pallas" | "interpret" | "jnp" — and
+:func:`resolve_attn_impls` for the whole tier: ineligible satellite
+programs downgrade LOUDLY to jnp instead of raising, so a geometry that
+can decode but not verify still serves) and threads each through its
+compiled programs as STATIC values, so A/B arms and tests retrace
+cleanly instead of aliasing each other in the process-global jit cache,
+and the compile-once pin is untouched: tables/lengths/adapter pages
+stay traced VALUES, block and adapter churn never recompile.
 
 Numerics: the online softmax is mathematically identical to the jnp
 path's full softmax but accumulates in a different order, so kernel
@@ -81,6 +121,11 @@ TRUST_TILE = 512
 #: the resolved value is one of the other three.
 ATTN_IMPLS = ("auto", "pallas", "interpret", "jnp")
 
+#: The serving-kernel tier's programs: ragged paged-decode attention,
+#: the query-tiled chunked-prefill program, the fused speculative-verify
+#: tail, and the in-grid adapter low-rank gather.
+PAGED_PROGRAMS = ("decode", "prefill", "verify", "adapter")
+
 
 def kv_sublane(kv_dtype) -> int:
     """Mosaic sublane width for a compiled KV tile of ``kv_dtype``: the
@@ -92,19 +137,52 @@ def kv_sublane(kv_dtype) -> int:
 
 
 def supports_paged_attention(*, head_dim: int, block_size: int,
-                             kv_dtype, interpret: bool) -> bool:
-    """THE kernel-eligibility predicate (the ``supports_flash`` pattern):
-    every dispatch site must consult it so the fallback condition can
-    never drift from the kernel's real constraints.
+                             kv_dtype, interpret: bool,
+                             program: str = "decode",
+                             n_embd: Optional[int] = None,
+                             adapter_rank: Optional[int] = None) -> bool:
+    """THE kernel-eligibility predicate (the ``supports_flash`` pattern),
+    now PER PROGRAM: every dispatch site must consult it so the fallback
+    condition can never drift from a kernel's real constraints.
 
-    Compiled Mosaic needs the KV tile's sublane (= pool ``block_size``)
-    to be a multiple of :func:`kv_sublane` for the POOL's storage dtype
-    (8 f32, 16 bf16, 32 int8), and ``head_dim <= MAX_HEAD_DIM``.
+    ``"decode"`` / ``"prefill"`` (the attention programs): compiled
+    Mosaic needs the KV tile's sublane (= pool ``block_size``) to be a
+    multiple of :func:`kv_sublane` for the POOL's storage dtype (8 f32,
+    16 bf16, 32 int8), and ``head_dim <= MAX_HEAD_DIM``.  The prefill
+    program's query tiles add no constraint beyond the decode program's
+    (its T dim pads to the same :data:`QROWS` sublane).
+
+    ``"verify"`` (the fused logits + trust tail): the head matmul's
+    contraction dim is ``n_embd`` — compiled Mosaic wants it a multiple
+    of the 128-lane width (true for every real GPT-2 geometry; tiny
+    test configs run interpret).
+
+    ``"adapter"`` (the in-grid low-rank gather): the delta contraction's
+    minor dim is the adapter rank — compiled eligibility conservatively
+    requires ``rank % QROWS == 0`` plus the verify rule on ``n_embd``
+    (small-rank Mosaic tiling is unvalidated until a healthy TPU round —
+    ROADMAP items 3/4); ranks below that downgrade loudly to the
+    gathered jnp path.
+
     Interpret mode (CPU tests) has no tiling rules — only sanity bounds
-    — so the int8 equality pins run at the small block sizes the test
-    pools use."""
+    — so the equality pins run at the small geometries the test pools
+    use."""
+    if program not in PAGED_PROGRAMS:
+        raise ValueError(
+            f"program must be one of {PAGED_PROGRAMS}, got {program!r}")
     if head_dim < 1 or block_size < 1 or head_dim > MAX_HEAD_DIM:
         return False
+    if program == "verify":
+        if interpret:
+            return True
+        return n_embd is not None and n_embd % 128 == 0
+    if program == "adapter":
+        if adapter_rank is None or adapter_rank < 1:
+            return False
+        if interpret:
+            return True
+        return (adapter_rank % QROWS == 0
+                and n_embd is not None and n_embd % 128 == 0)
     if interpret:
         return True
     return block_size % kv_sublane(kv_dtype) == 0
@@ -165,6 +243,47 @@ def resolve_attn_impl(requested: str, *, head_dim: int, block_size: int,
         "fraction to page in the perf sentinel", detail,
     )
     return "jnp"
+
+
+def resolve_attn_impls(requested: str, *, head_dim: int, block_size: int,
+                       kv_dtype, n_embd: int,
+                       adapter_rank: Optional[int] = None) -> dict:
+    """Resolve the WHOLE serving-kernel tier at construction: one impl
+    per program in :data:`PAGED_PROGRAMS`.
+
+    The decode program keeps :func:`resolve_attn_impl`'s loud contract
+    (explicit asks that cannot dispatch raise).  The satellite programs
+    — prefill, verify, adapter — inherit the decode resolution where
+    their geometry is eligible and DOWNGRADE LOUDLY to ``"jnp"`` where
+    it is not, even under an explicit ask: a pool that can decode but
+    whose ``n_embd`` cannot tile the verify matmul must still serve,
+    and the per-program gauge + the sentinel fractions page the
+    downgrade rather than an exception unwinding the engine.  An
+    unconfigured adapter tier (``adapter_rank`` falsy) resolves its
+    program to ``"jnp"`` silently — there is nothing to fuse."""
+    decode = resolve_attn_impl(requested, head_dim=head_dim,
+                               block_size=block_size, kv_dtype=kv_dtype)
+    impls = {p: "jnp" for p in PAGED_PROGRAMS}
+    impls["decode"] = decode
+    if decode == "jnp":
+        return impls
+    interp = decode == "interpret"
+    for program in ("prefill", "verify", "adapter"):
+        if program == "adapter" and not adapter_rank:
+            continue
+        if supports_paged_attention(
+                head_dim=head_dim, block_size=block_size,
+                kv_dtype=kv_dtype, interpret=interp, program=program,
+                n_embd=n_embd, adapter_rank=adapter_rank):
+            impls[program] = decode
+        else:
+            logger.warning(
+                "paged %s program cannot dispatch compiled Mosaic for "
+                "this geometry (n_embd=%s, adapter_rank=%s); that "
+                "program falls back to jnp — expect its sentinel "
+                "fraction to page", program, n_embd, adapter_rank,
+            )
+    return impls
 
 
 def _dot(a: jax.Array, b: jax.Array, trans_b: bool = False) -> jax.Array:
@@ -399,26 +518,206 @@ def paged_attention_reference(q: jax.Array, pool_k: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Chunked-prefill program: query-tiled multi-row attention with the
+# flash causal skip ACROSS query tiles of the paged table
+# ---------------------------------------------------------------------------
+
+
+def _paged_prefill_kernel(table_ref, start_ref, jmax_ref, q_ref, k_ref,
+                          v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref,
+                          l_ref, *, scale: float, bsz: int, qt: int,
+                          quantized: bool):
+    """One (row, head, query-tile, logical-block) grid step.
+
+    Identical online-softmax algebra to :func:`_paged_attn_kernel`; the
+    difference is the grid's query-tile dim and the PER-TILE ragged
+    bound ``jmax_ref`` i32[R, NT]: tile ``ti``'s causal window ends at
+    its own last query position, so an early tile of a long chunk
+    streams a fraction of the blocks the whole chunk touches — the
+    decode program's single per-row bound would stream (and mask) them
+    all, for every tile."""
+    r = pl.program_id(0)
+    ti = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    jmax = jmax_ref[r, ti]
+
+    @pl.when(j <= jmax)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [qt, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)              # [bsz, Dh]
+        s = _dot(q, k, trans_b=True) * scale             # [qt, bsz] f32
+        if quantized:
+            s = s * ks_ref[0, 0][None, :]
+        # Causal + ragged mask in absolute positions: the tile's queries
+        # sit at start + ti·qt + t.
+        kpos = j * bsz + jax.lax.broadcasted_iota(jnp.int32, (qt, bsz), 1)
+        qpos = start_ref[r] + ti * qt + jax.lax.broadcasted_iota(
+            jnp.int32, (qt, bsz), 0)
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[:, :1]                            # [qt, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        if quantized:
+            p = p * vs_ref[0, 0][None, :]
+        v = v_ref[0, 0].astype(jnp.float32)              # [bsz, Dh]
+        acc_ref[:] = acc_ref[:] * corr + _dot(p, v)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(j == jmax)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_prefill_call(q: jax.Array, pool_k: jax.Array,
+                        pool_v: jax.Array,
+                        k_scale: Optional[jax.Array],
+                        v_scale: Optional[jax.Array],
+                        table: jax.Array, start: jax.Array,
+                        jmax: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """q [R, H, NT·QT, Dh] x pool [NB, H, BLOCK, Dh] -> out like q.
+    ``jmax`` i32[R, NT] is the per-(row, query-tile) last useful logical
+    block."""
+    r, h, t_pad, dh = q.shape
+    nt = jmax.shape[1]
+    qt = t_pad // nt
+    nbps = table.shape[1]
+    bsz = pool_k.shape[2]
+    quantized = k_scale is not None
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(
+        _paged_prefill_kernel, scale=scale, bsz=bsz, qt=qt,
+        quantized=quantized,
+    )
+
+    # Per-tile ragged early exit at the DMA level: past tile ti's causal
+    # window the index repeats and no further copy is issued.
+    def kv_idx(ri, hi, ti, ji, tbl, st, jm):
+        return (tbl[ri, jnp.minimum(ji, jm[ri, ti])], hi, 0, 0)
+
+    def scale_idx(ri, hi, ti, ji, tbl, st, jm):
+        return (tbl[ri, jnp.minimum(ji, jm[ri, ti])], hi, 0)
+
+    def q_idx(ri, hi, ti, ji, tbl, st, jm):
+        return (ri, hi, ti, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, qt, dh), q_idx),
+        pl.BlockSpec((1, 1, bsz, dh), kv_idx),
+        pl.BlockSpec((1, 1, bsz, dh), kv_idx),
+    ]
+    operands = [q, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bsz), scale_idx),
+            pl.BlockSpec((1, 1, bsz), scale_idx),
+        ]
+        operands += [k_scale, v_scale]
+    else:
+        in_specs += [
+            pl.BlockSpec((1, nbps),
+                         lambda ri, hi, ti, ji, tbl, st, jm: (0, 0)),
+            pl.BlockSpec((1, nbps),
+                         lambda ri, hi, ti, ji, tbl, st, jm: (0, 0)),
+        ]
+        operands += [table, table]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(r, h, nt, nbps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, qt, dh), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((qt, dh), jnp.float32),
+            pltpu.VMEM((qt, 128), jnp.float32),
+            pltpu.VMEM((qt, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, h, t_pad, dh), q.dtype),
+        interpret=interpret,
+    )(table, start, jmax, *operands)
+
+
+def paged_prefill_attention(q: jax.Array, pool_k: jax.Array,
+                            pool_v: jax.Array, table: jax.Array,
+                            start: jax.Array, *,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None,
+                            interpret: Optional[bool] = None,
+                            q_tile: int = QROWS) -> jax.Array:
+    """Query-tiled chunked-prefill attention over ONE layer's block pool.
+
+    The multi-query-row twin of :func:`paged_attention` for T ≫ 1: the
+    chunk's T query rows split into ``q_tile``-row tiles, each with its
+    OWN ragged causal bound (the last logical block its final query can
+    see), so KV streaming is proportional to the causal area — the
+    flash-attention causal skip over a paged block table.  Same
+    semantics contract as :func:`paged_attention` (absolute-position
+    mask, int8 scales post-dot / pre-contraction, clamped DMAs past
+    each bound); the jnp pin is the same
+    :func:`paged_attention_reference`."""
+    r, h, t, dh = q.shape
+    bsz = pool_k.shape[2]
+    nbps = table.shape[1]
+    if interpret is None:
+        interpret = pallas_interpret()
+    if jnp.ndim(start) == 0:
+        start = jnp.broadcast_to(start, (r,))
+    start = start.astype(jnp.int32)
+    t_pad = -(-t // q_tile) * q_tile
+    nt = t_pad // q_tile
+    # Tile ti's last useful logical block: its final query sits at
+    # start + (ti+1)·q_tile − 1 (pad rows in the last tile only widen
+    # the bound — their output is sliced away and real rows' masks are
+    # position-exact).
+    tiles = jnp.arange(nt, dtype=jnp.int32)
+    jmax = jnp.clip(
+        (start[:, None] + (tiles[None, :] + 1) * q_tile - 1) // bsz,
+        0, nbps - 1,
+    ).astype(jnp.int32)
+    if t_pad != t:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    out = _paged_prefill_call(q, pool_k, pool_v, k_scale, v_scale,
+                              table, start, jmax, interpret=interpret)
+    return out[:, :, :t]
+
+
+# ---------------------------------------------------------------------------
 # Trust epilogue: entropy + top-1 margin in one pass over the vocab
 # ---------------------------------------------------------------------------
 
 
-def _trust_stats_kernel(x_ref, ent_ref, mar_ref, m_ref, s_ref, w_ref,
-                        t1_ref, t2_ref, *, nv: int):
-    """One [B, TRUST_TILE] logit tile: online logsumexp pieces
-    (m, Σe^{x−m}, Σx·e^{x−m}) for the entropy and an exact top-2 merge
-    for the margin."""
-    j = pl.program_id(0)
+def _trust_init(m_ref, s_ref, w_ref, t1_ref, t2_ref):
+    """Reset the five online-reduction accumulators (grid step 0)."""
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    s_ref[:] = jnp.zeros_like(s_ref)
+    w_ref[:] = jnp.zeros_like(w_ref)
+    t1_ref[:] = jnp.full_like(t1_ref, NEG_INF)
+    t2_ref[:] = jnp.full_like(t2_ref, NEG_INF)
 
-    @pl.when(j == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        s_ref[:] = jnp.zeros_like(s_ref)
-        w_ref[:] = jnp.zeros_like(w_ref)
-        t1_ref[:] = jnp.full_like(t1_ref, NEG_INF)
-        t2_ref[:] = jnp.full_like(t2_ref, NEG_INF)
 
-    x = x_ref[:]                                         # [B, TV] f32
+def _trust_update(x, m_ref, s_ref, w_ref, t1_ref, t2_ref):
+    """Fold one [B, TV] logit tile into the online reductions: logsumexp
+    pieces (m, Σe^{x−m}, Σx·e^{x−m}) for the entropy and an exact top-2
+    merge for the margin.  ONE spelling shared by the standalone trust
+    epilogue and the fused verify tail, so the fused stats can never
+    drift from the pinned epilogue algebra."""
     b, tv = x.shape
     tile_m = jnp.max(x, axis=-1, keepdims=True)          # [B, 1]
     m_prev = m_ref[:, :1]
@@ -451,13 +750,32 @@ def _trust_stats_kernel(x_ref, ent_ref, mar_ref, m_ref, s_ref, w_ref,
         t2_ref.shape,
     )
 
+
+def _trust_finalize(ent_ref, mar_ref, m_ref, s_ref, w_ref, t1_ref,
+                    t2_ref):
+    """Write entropy/margin from the accumulators (last grid step)."""
+    s = jnp.maximum(s_ref[:, :1], 1e-30)
+    logz = m_ref[:, :1] + jnp.log(s)
+    # entropy = -Σ p·logp = logZ - Σ p·x with p = e^{x-m}/s.
+    ent_ref[:] = logz - w_ref[:, :1] / s                 # [B, 1]
+    mar_ref[:] = t1_ref[:, :1] - t2_ref[:, :1]
+
+
+def _trust_stats_kernel(x_ref, ent_ref, mar_ref, m_ref, s_ref, w_ref,
+                        t1_ref, t2_ref, *, nv: int):
+    """One [B, TRUST_TILE] logit tile of the standalone epilogue."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        _trust_init(m_ref, s_ref, w_ref, t1_ref, t2_ref)
+
+    _trust_update(x_ref[:], m_ref, s_ref, w_ref, t1_ref, t2_ref)
+
     @pl.when(j == nv - 1)
     def _finalize():
-        s = jnp.maximum(s_ref[:, :1], 1e-30)
-        logz = m_ref[:, :1] + jnp.log(s)
-        # entropy = -Σ p·logp = logZ - Σ p·x with p = e^{x-m}/s.
-        ent_ref[:] = logz - w_ref[:, :1] / s             # [B, 1]
-        mar_ref[:] = t1_ref[:, :1] - t2_ref[:, :1]
+        _trust_finalize(ent_ref, mar_ref, m_ref, s_ref, w_ref, t1_ref,
+                        t2_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -527,13 +845,225 @@ def logit_trust_stats_reference(logits: jax.Array
     return entropy, top2[:, 0] - top2[:, 1]
 
 
+# ---------------------------------------------------------------------------
+# Fused speculative-verify tail: logits projection + trust stats in ONE
+# streaming vocab pass
+# ---------------------------------------------------------------------------
+
+
+def _verify_tail_kernel(x_ref, w_ref, logits_ref, ent_ref, mar_ref,
+                        m_ref, s_ref, wacc_ref, t1_ref, t2_ref, *,
+                        nv: int, v: int, round_dtype):
+    """One [TRUST_TILE, D] head tile: matmul the resident activations
+    against it, WRITE the logits tile (sampling still needs the full
+    row), and fold the tile into the shared trust reductions before it
+    leaves VMEM."""
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        _trust_init(m_ref, s_ref, wacc_ref, t1_ref, t2_ref)
+
+    acc = _dot(x_ref[:], w_ref[:], trans_b=True)         # [B, TV] f32
+    if round_dtype is not None:
+        # The jnp tail's matmul runs in the compute dtype and upcasts
+        # AFTER — round the f32 accumulator the same way so the fused
+        # logits match the materialised ones.
+        acc = acc.astype(round_dtype).astype(jnp.float32)
+    logits_ref[:] = acc
+    # Vocab-padding columns (zero rows of the padded head) produce logit
+    # 0, not NEG_INF — mask them out of the reductions exactly as the
+    # standalone epilogue's NEG_INF padding does; the written tile's pad
+    # columns are sliced away by the wrapper.
+    b, tv = acc.shape
+    cols = j * tv + jax.lax.broadcasted_iota(jnp.int32, (b, tv), 1)
+    x = jnp.where(cols < v, acc, NEG_INF)
+    _trust_update(x, m_ref, s_ref, wacc_ref, t1_ref, t2_ref)
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        _trust_finalize(ent_ref, mar_ref, m_ref, s_ref, wacc_ref,
+                        t1_ref, t2_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("v", "interpret", "round_to"))
+def _verify_tail_call(normed: jax.Array, head: jax.Array, v: int,
+                      interpret: bool = False,
+                      round_to: Optional[str] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, d = normed.shape
+    v_pad = head.shape[0]
+    nv = v_pad // TRUST_TILE
+    round_dtype = jnp.dtype(round_to) if round_to is not None else None
+    logits, ent, mar = pl.pallas_call(
+        functools.partial(_verify_tail_kernel, nv=nv, v=v,
+                          round_dtype=round_dtype),
+        grid=(nv,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda j: (0, 0)),
+            pl.BlockSpec((TRUST_TILE, d), lambda j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, TRUST_TILE), lambda j: (0, j)),
+            pl.BlockSpec((b, 1), lambda j: (0, 0)),
+            pl.BlockSpec((b, 1), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, v_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, 128), jnp.float32)
+                        for _ in range(5)],
+        interpret=interpret,
+    )(normed, head)
+    return logits, ent[:, 0], mar[:, 0]
+
+
+def fused_verify_tail(normed: jax.Array, head: jax.Array, *,
+                      interpret: Optional[bool] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The speculative-verify tail in ONE streaming vocab pass:
+    ``normed`` [B, D] (post-ln_f activations, already in the compute
+    dtype) x ``head`` [V, D] (the tied unembedding) -> (logits [B, V]
+    f32, entropy [B], margin [B]).
+
+    Replaces the two-pass jnp tail — ``_all_logits`` materialising
+    [B, V] to HBM, then :func:`logit_trust_stats` re-reading it — with
+    one grid over vocab tiles: each head tile is matmul'd, written once
+    (the verify sampler's ``jax.random.categorical`` consumes full
+    rows; its gumbel draws cannot be reproduced in-kernel without
+    forking the sampled stream, so the logits write stays — the pass
+    sampling pays anyway) and reduced while still in VMEM.  The trust
+    algebra is literally the epilogue kernel's (`_trust_update`), so
+    margin stays bit-exact vs ``lax.top_k`` over the SAME logits and
+    entropy agrees to f32 epsilon."""
+    b, d = normed.shape
+    v = head.shape[0]
+    if interpret is None:
+        interpret = pallas_interpret()
+    # Rounding contract: a bf16 jnp tail rounds the matmul to bf16
+    # before the f32 upcast — mirror it so fused == materialised.
+    round_to = (None if normed.dtype == jnp.float32
+                else jnp.dtype(normed.dtype).name)
+    pad_v = (-v) % TRUST_TILE
+    if pad_v:
+        head = jnp.pad(head, ((0, pad_v), (0, 0)))
+    pad_b = (-b) % QROWS
+    if pad_b:
+        normed = jnp.pad(normed, ((0, pad_b), (0, 0)))
+    logits, ent, mar = _verify_tail_call(normed, head, v,
+                                         interpret=interpret,
+                                         round_to=round_to)
+    return logits[:b, :v], ent[:b], mar[:b]
+
+
+# ---------------------------------------------------------------------------
+# In-grid adapter gather: the per-slot low-rank delta with the page
+# table as a scalar-prefetch operand
+# ---------------------------------------------------------------------------
+
+
+def _adapter_delta_kernel(pages_ref, sa_ref, sb_ref, x_ref, a_ref, b_ref,
+                          o_ref):
+    """One row's low-rank delta: the BlockSpec index maps resolved
+    ``row -> pages[row]`` before the A/B DMAs were issued, so the pool
+    pages stream HBM→VMEM exactly like KV blocks — no gathered [R, D,
+    r] copy exists.  Scale order matches ``lowrank_delta`` exactly
+    (h·sa between the contractions): scalar folding would change the
+    f32 rounding the adapter parity pins rely on."""
+    ri = pl.program_id(0)
+    x = x_ref[0].astype(jnp.float32)                     # [T, D]
+    a = a_ref[0].astype(jnp.float32)                     # [D, r]
+    h = _dot(x, a) * sa_ref[ri]                          # [T, r] f32
+    b = b_ref[0].astype(jnp.float32)                     # [r, D]
+    o_ref[0] = _dot(h, b) * sb_ref[ri]                   # [T, D] f32
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _adapter_delta_call(x: jax.Array, a_pool: jax.Array,
+                        b_pool: jax.Array, pages: jax.Array,
+                        sa: jax.Array, sb: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    r, t_pad, d = x.shape
+    rank = a_pool.shape[-1]
+
+    def a_idx(ri, pg, sa_, sb_):
+        return (pg[ri], 0, 0)
+
+    def x_idx(ri, pg, sa_, sb_):
+        return (ri, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, t_pad, d), x_idx),
+            pl.BlockSpec((1, d, rank), a_idx),
+            pl.BlockSpec((1, rank, d), a_idx),
+        ],
+        out_specs=pl.BlockSpec((1, t_pad, d), x_idx),
+        scratch_shapes=[],
+    )
+    return pl.pallas_call(
+        _adapter_delta_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, t_pad, d), jnp.float32),
+        interpret=interpret,
+    )(pages, sa, sb, x, a_pool, b_pool)
+
+
+def adapter_delta(x: jax.Array, a_pool: jax.Array, b_pool: jax.Array,
+                  pages: jax.Array, *,
+                  a_scale: Optional[jax.Array] = None,
+                  b_scale: Optional[jax.Array] = None,
+                  interpret: Optional[bool] = None) -> jax.Array:
+    """In-grid paged low-rank delta for ONE adapter site:
+    ``x`` [R, T, D] x pool pages ``a_pool`` [P+1, D, r] / ``b_pool``
+    [P+1, r, D] selected by ``pages`` i32[R] (the per-slot
+    ``adapter_page_row`` — a traced value, so adapter churn never
+    recompiles) -> f32 [R, T, D].
+
+    The kernel-grid twin of ``fused_dequant_matmul.lowrank_delta`` over
+    ``a_pool[pages]`` — same contraction, same f32 accumulation, same
+    scale order — minus the take: the page table joins the
+    scalar-prefetch operands and each row's A/B tiles stream HBM→VMEM
+    alongside its KV blocks.  ``a_scale``/``b_scale`` are the int8
+    tier's per-page scales [P+1] for this site (None on the f32 tier —
+    the kernel multiplies by exactly 1.0, a bitwise identity)."""
+    r, t, d = x.shape
+    if interpret is None:
+        interpret = pallas_interpret()
+    pages = pages.astype(jnp.int32)
+    npg = a_pool.shape[0]
+    ones = jnp.ones((npg,), jnp.float32)
+    sa = ones if a_scale is None else a_scale.astype(jnp.float32)
+    sb = ones if b_scale is None else b_scale.astype(jnp.float32)
+    # The [R] per-row scale lookup happens outside — R scalars, not the
+    # [R, D, r] page take this kernel exists to eliminate — and rides
+    # scalar prefetch so the kernel reads its row's scale from SMEM.
+    sa_row = sa[pages]
+    sb_row = sb[pages]
+    t_pad = -(-t // QROWS) * QROWS
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    out = _adapter_delta_call(x, a_pool, b_pool, pages, sa_row, sb_row,
+                              interpret=interpret)
+    return out[:, :t]
+
+
 __all__ = [
     "ATTN_IMPLS",
     "MAX_HEAD_DIM",
+    "PAGED_PROGRAMS",
+    "adapter_delta",
+    "fused_verify_tail",
     "logit_trust_stats",
     "logit_trust_stats_reference",
     "paged_attention",
     "paged_attention_reference",
+    "paged_prefill_attention",
     "resolve_attn_impl",
+    "resolve_attn_impls",
     "supports_paged_attention",
 ]

@@ -599,23 +599,29 @@ class ServingEngine:
                 self._adapter_pages_gauge, self._rlabels)
             self.adapter_pool._evictions_counter = _BoundMetric(
                 self._adapter_evictions_counter, self._rlabels)
-        # Decode-attention path gauge: one series per path, the active
-        # one set to 1 — a silent fallback to the slow jnp gather (gate
-        # off, untileable geometry, non-TPU backend) is visible in EVERY
-        # serve snapshot, and pages alongside the sentinel's decode-tick
-        # fraction instead of hiding inside tokens/s.
+        # Serving-kernel path gauge: one series per (program, path),
+        # the active path set to 1 for each of the tier's programs
+        # (decode / prefill / verify / adapter) — a silent fallback of
+        # ANY program to its slow jnp spelling (gate off, untileable
+        # geometry, non-TPU backend) is visible in EVERY serve
+        # snapshot, and pages alongside the sentinel's tick fractions
+        # instead of hiding inside tokens/s.
+        from trustworthy_dl_tpu.ops import paged_attention as pattn
+
         self._attn_gauge = _metric(
             registry.gauge, "tddl_serve_attn_kernel",
-            "Active decode-attention path (1 = in use): the Pallas "
-            "ragged paged-attention kernel, its interpret-mode twin, or "
-            "the jnp gather fallback",
-            labels=("path",) + self._rlabel_names,
+            "Active serving-kernel path per paged program (1 = in "
+            "use): the Pallas kernel, its interpret-mode twin, or the "
+            "jnp gather/materialise fallback",
+            labels=("path", "program") + self._rlabel_names,
         )
-        for _path in ("pallas", "interpret", "jnp"):
-            self._attn_gauge.set(
-                1.0 if _path == self.attn_kernel_path else 0.0,
-                path=_path, **self._rlabels,
-            )
+        _paths = self.attn_kernel_paths
+        for _program in pattn.PAGED_PROGRAMS:
+            for _path in ("pallas", "interpret", "jnp"):
+                self._attn_gauge.set(
+                    1.0 if _path == _paths[_program] else 0.0,
+                    path=_path, program=_program, **self._rlabels,
+                )
         # Speculative-decode surface: drafted vs accepted tokens (their
         # ratio is the accepted_rate the bench A/B and the perf sentinel
         # track).  Registered on every engine — replica-labelled in
@@ -1442,6 +1448,19 @@ class ServingEngine:
         return self.scheduler.attn_impl
 
     @property
+    def attn_kernel_paths(self) -> Dict[str, str]:
+        """Per-program resolved paths for the whole serving-kernel tier
+        (ops.paged_attention.PAGED_PROGRAMS: decode / prefill / verify /
+        adapter), each "pallas" | "interpret" | "jnp".  The stripe
+        scheduler has no paged programs — every entry is "jnp"."""
+        from trustworthy_dl_tpu.ops import paged_attention as pattn
+
+        impls = getattr(self.scheduler, "attn_impls", None)
+        if impls is None:
+            return {p: "jnp" for p in pattn.PAGED_PROGRAMS}
+        return dict(impls)
+
+    @property
     def quarantined_slots(self):
         return self.scheduler.allocator.quarantined
 
@@ -1500,10 +1519,20 @@ class ServingEngine:
             "decode_tick_fraction":
                 (self.decode_tick_s / elapsed) if elapsed > 0 else 0.0,
             "attn_kernel_path": self.attn_kernel_path,
+            "attn_kernel_paths": self.attn_kernel_paths,
         }
         if self.paged:
             sched = self.scheduler
             out["blocks_in_use"] = sched.blocks_in_use
+            # Phase-share companions to decode_tick_fraction for the
+            # two new kernel arms: wall share spent advancing prefill
+            # chunks / inside the batched spec verify (both direction
+            # LOWER in the sentinel fingerprint — a kernel arm that
+            # does not shrink them is a regression signal).
+            out["prefill_chunk_fraction"] = (
+                sched.prefill_chunk_s / elapsed if elapsed > 0 else 0.0)
+            out["spec_verify_fraction"] = (
+                sched.spec_verify_s / elapsed if elapsed > 0 else 0.0)
             out["prefix_lookups"] = sched.prefix_lookups
             out["prefix_hits"] = sched.prefix_hits
             out["prefix_tokens_reused"] = sched.prefix_tokens_reused

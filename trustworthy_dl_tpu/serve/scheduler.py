@@ -278,7 +278,7 @@ def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                       view: Any, tokens: jax.Array, table: jax.Array,
                       start: jax.Array, last_idx: jax.Array,
                       key: jax.Array, temp: jax.Array, greedy: jax.Array,
-                      attn_impl: str = "jnp",
+                      attn_impl: str = "jnp", adapter_impl: str = "jnp",
                       adapter_a: Any = None, adapter_b: Any = None,
                       adapter_as: Any = None, adapter_bs: Any = None,
                       apages: Any = None):
@@ -294,13 +294,15 @@ def _paged_chunk_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     The trailing adapter args are the paged adapter pool's device sides
     plus the single-row page table ``apages`` i32[1] (serve/adapters.py)
     — None on adapterless engines, where they contribute zero pytree
-    leaves and the trace is the pre-adapter one (bit-identity)."""
+    leaves and the trace is the pre-adapter one (bit-identity).
+    ``adapter_impl`` (static, like ``attn_impl``) routes the per-layer
+    page gather through the in-grid ``ops.adapter_delta`` kernel."""
     adapter = (None if adapter_a is None
                else (adapter_a, adapter_b, adapter_as, adapter_bs, apages))
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[None, :], pool_k, pool_v, pool_ks, pool_vs,
         table, start, cfg, last_pos=last_idx, attn_impl=attn_impl,
-        adapter=adapter,
+        adapter=adapter, adapter_impl=adapter_impl,
     )
     return new_k, new_v, new_ks, new_vs, _sample_pack(logits, key, temp,
                                                       greedy, attn_impl)
@@ -311,7 +313,7 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                        view: Any, tokens: jax.Array, tables: jax.Array,
                        lengths: jax.Array, keys: jax.Array,
                        temps: jax.Array, greedy: jax.Array,
-                       attn_impl: str = "jnp",
+                       attn_impl: str = "jnp", adapter_impl: str = "jnp",
                        adapter_a: Any = None, adapter_b: Any = None,
                        adapter_as: Any = None, adapter_bs: Any = None,
                        apages: Any = None):
@@ -335,6 +337,7 @@ def _paged_decode_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
         view, tokens[:, None], pool_k, pool_v, pool_ks, pool_vs,
         tables, lengths, cfg, attn_impl=attn_impl, adapter=adapter,
+        adapter_impl=adapter_impl,
     )
     next_tok = _sample_tokens(logits, keys, temps, greedy)
     ent, margin = _logit_signals(logits, attn_impl)
@@ -369,7 +372,7 @@ def _spec_verify_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
                       view: Any, tokens: jax.Array, tables: jax.Array,
                       lengths: jax.Array, keys: jax.Array,
                       temps: jax.Array, greedy: jax.Array,
-                      attn_impl: str = "jnp"):
+                      attn_impl: str = "jnp", verify_impl: str = "jnp"):
     """THE batched verify: one MODEL-dtype forward over every slot's
     whole draft window ``tokens`` [R, k+1] = [last emitted, d_1 .. d_k],
     attending through the same paged cache at the PRE-draft lengths and
@@ -381,16 +384,35 @@ def _spec_verify_impl(cfg: gpt2.GPT2Config, pool_k: jax.Array,
     emission index emitted+i), so the target tokens ARE the spec-off
     stream, greedy and sampled alike; per-position entropy/margin ride
     the packed output for the trust monitor and the near-tie acceptance
-    rule.  Returns (packed f32[3, R, k+1], updated pool arrays)."""
+    rule.  Returns (packed f32[3, R, k+1], updated pool arrays).
+
+    ``verify_impl`` (static, resolved per-program like ``attn_impl``)
+    selects the tail: "jnp" materialises the [R, T, V] logits
+    (``all_logits``) and re-reads them for the trust reductions;
+    "pallas"/"interpret" runs the fused verify tail — the layer scan
+    returns pre-``ln_f`` activations and ``gen.fused_verify_logits``
+    streams each vocab tile ONCE for the logits write AND the
+    entropy/margin fold (bit-identical logits, pinned epilogue
+    algebra), so the all-positions projection never does a second
+    HBM round-trip."""
     r, t = tokens.shape
-    logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
-        view, tokens, pool_k, pool_v, pool_ks, pool_vs,
-        tables, lengths, cfg, all_logits=True, attn_impl=attn_impl,
-    )
-    flat = logits.reshape(r * t, -1)
+    if verify_impl != "jnp":
+        x, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+            view, tokens, pool_k, pool_v, pool_ks, pool_vs,
+            tables, lengths, cfg, hidden=True, attn_impl=attn_impl,
+        )
+        logits, ent, margin = gen.fused_verify_logits(
+            view, x, cfg, interpret=(verify_impl == "interpret"))
+        flat = logits.reshape(r * t, -1)
+    else:
+        logits, new_k, new_v, new_ks, new_vs = gen._apply_with_cache_paged(
+            view, tokens, pool_k, pool_v, pool_ks, pool_vs,
+            tables, lengths, cfg, all_logits=True, attn_impl=attn_impl,
+        )
+        flat = logits.reshape(r * t, -1)
+        ent, margin = _logit_signals(flat, attn_impl)
     tok = _sample_tokens(flat, keys.reshape(r * t, 2),
                          jnp.repeat(temps, t), jnp.repeat(greedy, t))
-    ent, margin = _logit_signals(flat, attn_impl)
     packed = jnp.stack([tok.astype(jnp.float32), ent, margin])
     return packed.reshape(3, r, t), new_k, new_v, new_ks, new_vs
 
@@ -410,23 +432,27 @@ def _programs() -> Dict[str, Any]:
         _PROGRAMS["decode"] = jax.jit(
             _decode_impl, static_argnums=(0,), donate_argnums=donate
         )
-        # The paged programs also take ``attn_impl`` as a STATIC keyword
-        # (the scheduler's construction-resolved attention path): the jit
-        # cache keys on it, so a kernel-on engine and a jnp-fallback
-        # engine with identical geometry trace separate programs instead
-        # of silently aliasing each other through this process-global
-        # table (bench A/B arms and the kernel tests depend on that).
+        # The paged programs also take ``attn_impl`` (and, where the
+        # program touches adapters or the verify tail, ``adapter_impl``/
+        # ``verify_impl``) as STATIC keywords — the scheduler's
+        # construction-resolved per-program paths: the jit cache keys on
+        # them, so a kernel-on engine and a jnp-fallback engine with
+        # identical geometry trace separate programs instead of silently
+        # aliasing each other through this process-global table (bench
+        # A/B arms and the kernel tests depend on that).
         _PROGRAMS["paged_prefill"] = jax.jit(
             _paged_prefill_impl, static_argnums=(0,),
             static_argnames=("attn_impl",), donate_argnums=donate
         )
         _PROGRAMS["paged_chunk"] = jax.jit(
             _paged_chunk_impl, static_argnums=(0,),
-            static_argnames=("attn_impl",), donate_argnums=donate
+            static_argnames=("attn_impl", "adapter_impl"),
+            donate_argnums=donate
         )
         _PROGRAMS["paged_decode"] = jax.jit(
             _paged_decode_impl, static_argnums=(0,),
-            static_argnames=("attn_impl",), donate_argnums=donate
+            static_argnames=("attn_impl", "adapter_impl"),
+            donate_argnums=donate
         )
         # Speculative tier: draft + verify get their OWN jit wrappers so
         # the fused-decode compile-once pin (decode_cache_size == 1)
@@ -440,7 +466,8 @@ def _programs() -> Dict[str, Any]:
         )
         _PROGRAMS["spec_verify"] = jax.jit(
             _spec_verify_impl, static_argnums=(0,),
-            static_argnames=("attn_impl",), donate_argnums=donate
+            static_argnames=("attn_impl", "verify_impl"),
+            donate_argnums=donate
         )
     return _PROGRAMS
 
@@ -804,20 +831,27 @@ class PagedBatchingScheduler:
         self.kv = init_paged_pool(cfg, self.num_blocks, block_size,
                                   kv_dtype=q8.resolve_kv_dtype(kv_dtype,
                                                                cfg))
-        # Decode-attention path, resolved ONCE here (never inside a
-        # traced program) and baked into every paged program as a static:
-        # "pallas" (compiled Mosaic kernel, TPU), "interpret" (same
-        # kernel through the Pallas interpreter — tests), or "jnp" (the
-        # gather fallback, the default wherever the gate is off or the
-        # geometry cannot tile).  ops/paged_attention.py documents the
-        # gate (TDDL_PAGED_ATTN) and tiling rules.
+        # Serving-kernel paths, resolved ONCE here (never inside a
+        # traced program) and baked into the paged programs as statics:
+        # "pallas" (compiled Mosaic kernels, TPU), "interpret" (same
+        # kernels through the Pallas interpreter — tests), or "jnp"
+        # (the gather/materialise fallbacks, the default wherever the
+        # gate is off or the geometry cannot tile).  One dict covers the
+        # whole tier — decode attention, chunked-prefill attention, the
+        # fused verify tail, the in-grid adapter gather — each program
+        # downgrading independently (ops/paged_attention.py documents
+        # the gate TDDL_PAGED_ATTN and the per-program tiling rules);
+        # ``self.attn_impl`` stays the decode path, the tier's anchor.
         from trustworthy_dl_tpu.ops import paged_attention as pattn
 
-        self.attn_impl = pattn.resolve_attn_impl(
+        self.attn_impls = pattn.resolve_attn_impls(
             attn_impl, head_dim=cfg.n_embd // cfg.n_head,
             block_size=block_size,
             kv_dtype=q8.resolve_kv_dtype(kv_dtype, cfg),
+            n_embd=cfg.n_embd,
+            adapter_rank=getattr(adapters, "rank", None),
         )
+        self.attn_impl = self.attn_impls["decode"]
         self.allocator = SlotAllocator(max_slots)  # decode rows
         self.blocks = BlockAllocator(self.num_blocks)
         self.prefix = (PrefixCache(block_size, self.blocks)
@@ -884,6 +918,10 @@ class PagedBatchingScheduler:
         # — the bench A/B's draft/verify tick fractions.
         self.spec_draft_s = 0.0
         self.spec_verify_s = 0.0
+        # Host-observed wall time advancing prefills (chunk dispatches
+        # plus the final chunk's packed pull) — the bench prefill-arm
+        # A/B's ``prefill_chunk_fraction`` numerator.
+        self.prefill_chunk_s = 0.0
 
     # -- admission ---------------------------------------------------------
 
@@ -1067,10 +1105,12 @@ class PagedBatchingScheduler:
                 jnp.asarray(task.keys[0], jnp.uint32),
                 jnp.asarray(max(task.temperature, 1e-6), jnp.float32),
                 jnp.asarray(task.greedy),
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impls["prefill"],
+                adapter_impl=self.attn_impls["adapter"],
                 **extra,
             )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+        self.prefill_chunk_s += _time.perf_counter() - t_chunk
         if self.spans is not None:
             self.spans.add("serve.prefill_chunk", t_chunk,
                            _time.perf_counter(), kind="serve",
@@ -1162,6 +1202,7 @@ class PagedBatchingScheduler:
                     jnp.asarray(keys), jnp.asarray(temps),
                     jnp.asarray(greedy),
                     attn_impl=self.attn_impl,
+                    adapter_impl=self.attn_impls["adapter"],
                     **extra,
                 )
         self.kv = PagedKV(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
@@ -1255,6 +1296,7 @@ class PagedBatchingScheduler:
                 self.cfg, *pool, self.view, jnp.asarray(tokens_v),
                 tables_dev, jnp.asarray(lengths0), jnp.asarray(keys),
                 temps_dev, greedy_dev, attn_impl=self.attn_impl,
+                verify_impl=self.attn_impls["verify"],
             )
         self.kv = PagedKV(k=pk, v=pv, k_scale=pks, v_scale=pvs)
         # tddl-lint: disable=host-sync — verify lands all windows in one pull
@@ -1541,7 +1583,8 @@ class PagedBatchingScheduler:
             jnp.zeros((1, self.nbps), jnp.int32),
             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
             jnp.zeros(2, jnp.uint32), jnp.asarray(1.0, jnp.float32),
-            jnp.asarray(True), memory=memory, attn_impl=self.attn_impl,
+            jnp.asarray(True), memory=memory,
+            attn_impl=self.attn_impls["prefill"],
         )
         ledger.analyze(
             "serve.paged_decode", prog["paged_decode"], self.cfg,
